@@ -1,0 +1,32 @@
+"""sartsolver_tpu — TPU-native constrained SART tomographic solver.
+
+A from-scratch JAX/XLA re-design of the capabilities of the reference
+MPI+CUDA solver (vsnever/mpi-cuda-sartsolver): constrained SART
+reconstruction for large *dense* ray-transfer matrices (RTMs), as used for
+ITER plasma-emissivity reconstruction where wall reflections densify the RTM
+to tens-to-hundreds of GB.
+
+Architecture (TPU-first, not a port):
+
+- The reference's per-iteration MPI+CUDA structure (reference
+  ``source/sartsolver.cpp:180-229`` / ``sartsolver_cuda.cpp:231-262``) becomes a
+  single jit-compiled ``lax.while_loop`` — no per-iteration host round trips.
+- The reference's row-block MPI distribution of the RTM
+  (``source/main.cpp:67-68``) becomes ``shard_map`` over a ``('pixels',)``
+  (optionally ``('pixels','voxels')``) ``jax.sharding.Mesh``; every
+  ``MPI_Allreduce`` site becomes an on-device ``lax.psum`` riding ICI.
+- The reference's CUDA kernels (``source/cuda/sart_kernels.cu``) become XLA
+  matmuls on the MXU plus fused elementwise ops.
+- HDF5 ingest/egress stays on host (``sartsolver_tpu.io``), mirroring the
+  reference's file schemas and validation semantics exactly.
+"""
+
+__version__ = "0.1.0"
+
+from sartsolver_tpu.config import (  # noqa: F401
+    SolverOptions,
+    parse_time_intervals,
+    SUCCESS,
+    MAX_ITERATIONS_EXCEEDED,
+)
+from sartsolver_tpu.models.sart import SARTProblem, solve  # noqa: F401

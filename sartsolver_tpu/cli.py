@@ -1,0 +1,238 @@
+"""``sartsolve`` — end-to-end CLI entrypoint.
+
+Replicates the reference binary's orchestration (main.cpp:25-151): parse and
+validate flags, classify and cross-validate input files, build the composite
+measurement stream, load the RTM and optional Laplacian, construct the
+solver, then run the frame loop (warm-starting each frame from the previous
+solution unless ``--no_guess``) and write the incrementally-flushed solution
+file plus the voxel-map round trip.
+
+Flag set and defaults match the reference CLI (arguments.cpp:86-171);
+``--use_cpu`` selects the fp64 CPU-parity profile on the host CPU backend
+(the reference's fp64 CPU solver), the default profile is fp32 on
+accelerator devices (the reference's CUDA path). TPU-specific extensions are
+grouped under "tpu options".
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time as _time
+from typing import List, Optional
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sartsolve",
+        description="Impurity flux reconstruction for ITER: emissivity",
+    )
+    p.add_argument("-o", "--output_file", default="solution.h5",
+                   help="Filename to save the solution.")
+    p.add_argument("-t", "--time_range", default="",
+                   help="Time intervals in s to process in a form: "
+                        "start:stop:(step):(synch_threshold), e.g. "
+                        "'20.5:40.1, 45.2:51:1.5:0.05'. The step and the "
+                        "synchronization threshold are optional.")
+    p.add_argument("-w", "--wavelength_threshold", type=float, default=50.0,
+                   help="An RTM is considered valid if its wavelength is within "
+                        "this threshold of the image wavelength (in nm).")
+    p.add_argument("-d", "--ray_density_threshold", type=float, default=1.0e-6,
+                   help="Voxels with ray density lesser than this threshold are ignored.")
+    p.add_argument("-r", "--ray_length_threshold", type=float, default=1.0e-6,
+                   help="Pixels with ray length lesser than this threshold are ignored.")
+    p.add_argument("-m", "--max_iterations", type=int, default=2000,
+                   help="Maximum number of SART iterations.")
+    p.add_argument("-c", "--conv_tolerance", type=float, default=1.0e-5,
+                   help="SART convolution relative tolerance.")
+    p.add_argument("-l", "--laplacian_file", default="",
+                   help="File with laplacian regularization matrix.")
+    p.add_argument("-b", "--beta_laplace", type=float, default=2.0e-2,
+                   help="Weight of the regularization factor.")
+    p.add_argument("-R", "--relaxation", type=float, default=1.0,
+                   help="Relaxation parameter.")
+    p.add_argument("-n", "--raytransfer_name", default="with_reflections",
+                   help="Ray transfer matrix dataset name.")
+    p.add_argument("-L", "--logarithmic", action="store_true",
+                   help="Use logarithmic SART solver.")
+    p.add_argument("--max_cached_frames", type=int, default=100,
+                   help="Maximum number of cached image frames.")
+    p.add_argument("--max_cached_solutions", type=int, default=100,
+                   help="Maximum number of cached solutions.")
+    p.add_argument("--no_guess", action="store_true",
+                   help="Do not use solution found on previous time moment as "
+                        "initial guess for the next one.")
+    p.add_argument("--use_cpu", action="store_true",
+                   help="Perform all calculations on CPUs (fp64 parity profile).")
+    p.add_argument("--parallel_read", action="store_true",
+                   help="Accepted for reference-CLI compatibility (host reads "
+                        "are always direct here).")
+    p.add_argument("input_files", nargs="*",
+                   help="List of ray transfer matrix and camera image hdf5 files.")
+
+    tpu = p.add_argument_group("tpu options")
+    tpu.add_argument("--pixel_shards", type=int, default=None,
+                     help="Number of mesh shards along the pixel axis "
+                          "(default: all visible devices).")
+    tpu.add_argument("--rtm_dtype", default=None,
+                     choices=["float32", "bfloat16", "float64"],
+                     help="On-device RTM storage dtype (bfloat16 halves HBM "
+                          "traffic of the two dominant sweeps).")
+    tpu.add_argument("--profile_dir", default=None,
+                     help="Write a jax.profiler trace of the frame loop here.")
+    return p
+
+
+def _validate(args) -> None:
+    """Range validation mirroring arguments.cpp:184-236."""
+    def fail(msg: str) -> None:
+        print(msg, file=sys.stderr)
+        raise SystemExit(1)
+
+    if args.ray_density_threshold < 0:
+        fail(f"Argument ray_density_threshold must be >= 0, {args.ray_density_threshold} given.")
+    if args.ray_length_threshold < 0:
+        fail(f"Argument ray_length_threshold must be >= 0, {args.ray_length_threshold} given.")
+    if args.max_iterations < 1:
+        fail(f"Argument max_iterations must be >= 1, {args.max_iterations} given.")
+    if args.conv_tolerance <= 0:
+        fail(f"Argument conv_tolerance must be > 0, {args.conv_tolerance} given.")
+    if not (0 < args.relaxation <= 1.0):
+        fail(f"Argument relaxation must be within (0, 1] interval, {args.relaxation} given.")
+    if args.beta_laplace < 0:
+        fail("Argument beta_laplace must be positive.")
+    if args.max_cached_frames <= 0:
+        fail("Argument max_cached_frames must be positive.")
+    if args.max_cached_solutions <= 0:
+        fail("Argument max_cached_solutions must be positive.")
+    if len(args.input_files) < 2:
+        fail("At least two input file, one with RTM and one with image, are "
+             f"required, {len(args.input_files)} given.")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    _validate(args)
+
+    # Heavy imports deferred so `--help` stays instant.
+    import jax
+
+    from sartsolver_tpu.config import SolverOptions, parse_time_intervals
+    from sartsolver_tpu.io import hdf5files as hf
+    from sartsolver_tpu.io.image import CompositeImage
+    from sartsolver_tpu.io.laplacian_io import read_laplacian
+    from sartsolver_tpu.io.raytransfer import read_rtm_block
+    from sartsolver_tpu.io.solution import SolutionWriter
+    from sartsolver_tpu.io.voxelgrid import make_voxel_grid
+    from sartsolver_tpu.ops.laplacian import make_laplacian
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    try:
+        time_intervals = parse_time_intervals(args.time_range)
+
+        # ---- pre-flight validation gate (main.cpp:30-59) -----------------
+        matrix_files, image_files = hf.categorize_input_files(args.input_files)
+        rtm_name = args.raytransfer_name
+        hf.check_group_attribute_consistency(matrix_files, f"rtm/{rtm_name}", ["wavelength"])
+        hf.check_group_attribute_consistency(matrix_files, "rtm/voxel_map", ["nx", "ny", "nz"])
+        sorted_matrix_files = hf.sort_rtm_files(matrix_files)
+        hf.check_rtm_frame_consistency(sorted_matrix_files)
+        hf.check_rtm_voxel_consistency(sorted_matrix_files)
+        hf.check_group_attribute_consistency(image_files, "image", ["wavelength"])
+        sorted_image_files = hf.sort_image_files(image_files)
+        camera_names = list(sorted_image_files)
+        hf.check_rtm_image_consistency(
+            sorted_matrix_files, sorted_image_files, rtm_name, args.wavelength_threshold
+        )
+        npixel, nvoxel = hf.get_total_rtm_size(sorted_matrix_files)
+        rtm_frame_masks = hf.read_rtm_frame_masks(sorted_matrix_files)
+
+        # ---- data model (main.cpp:70-86) ---------------------------------
+        composite_image = CompositeImage(
+            sorted_image_files, rtm_frame_masks, time_intervals,
+            npixel, 0, max_cache_size=args.max_cached_frames,
+        )
+
+        if args.use_cpu:
+            opts = SolverOptions.cpu_parity(
+                logarithmic=args.logarithmic,
+                ray_density_threshold=args.ray_density_threshold,
+                ray_length_threshold=args.ray_length_threshold,
+                conv_tolerance=args.conv_tolerance,
+                beta_laplace=args.beta_laplace,
+                relaxation=args.relaxation,
+                max_iterations=args.max_iterations,
+            )
+            jax.config.update("jax_enable_x64", True)
+            devices = jax.devices("cpu")
+        else:
+            opts = SolverOptions(
+                logarithmic=args.logarithmic,
+                ray_density_threshold=args.ray_density_threshold,
+                ray_length_threshold=args.ray_length_threshold,
+                conv_tolerance=args.conv_tolerance,
+                beta_laplace=args.beta_laplace,
+                relaxation=args.relaxation,
+                max_iterations=args.max_iterations,
+                rtm_dtype=args.rtm_dtype,
+            )
+            devices = jax.devices()
+
+        lap = None
+        if args.laplacian_file:
+            rows, cols, vals = read_laplacian(args.laplacian_file, nvoxel)
+            lap = make_laplacian(rows, cols, vals, dtype=opts.dtype)
+
+        rtm = read_rtm_block(sorted_matrix_files, rtm_name, npixel, nvoxel, 0)
+
+        n_shards = args.pixel_shards or len(devices)
+        mesh = make_mesh(n_shards, 1, devices=devices[:n_shards])
+        solver = DistributedSARTSolver(rtm, lap, opts=opts, mesh=mesh)
+
+        grid = make_voxel_grid(
+            next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
+        )
+
+        # ---- frame loop (main.cpp:131-140) -------------------------------
+        import contextlib
+
+        profiler_ctx = (
+            jax.profiler.trace(args.profile_dir) if args.profile_dir
+            else contextlib.nullcontext()
+        )
+        with profiler_ctx, SolutionWriter(
+            args.output_file, camera_names, nvoxel,
+            max_cache_size=args.max_cached_solutions,
+        ) as writer:
+            warm: Optional[np.ndarray] = None
+            while (frame := composite_image.next_frame()) is not None:
+                t0 = _time.perf_counter()
+                result = solver.solve(frame, f0=warm)
+                writer.add(
+                    result.solution, result.status,
+                    composite_image.frame_time(),
+                    composite_image.camera_frame_time(),
+                )
+                elapsed_ms = (_time.perf_counter() - t0) * 1e3
+                print(f"Processed in: {elapsed_ms} ms")
+                warm = None if args.no_guess else result.solution
+
+        grid.write_hdf5(args.output_file, "voxel_map")
+    except KeyError as err:
+        # h5py raises KeyError for missing datasets/attributes in otherwise
+        # openable files; surface it as the fail-fast message + exit 1 the
+        # reference contract promises.
+        print(f"Missing dataset or attribute in input files: {err}", file=sys.stderr)
+        return 1
+    except (ValueError, OSError) as err:
+        print(err, file=sys.stderr)
+        return 1
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,160 @@
+"""Configuration and CLI-facing parameter parsing.
+
+Mirrors the semantics of the reference's ``source/arguments.cpp`` (flag set,
+defaults, range validation, time-interval grammar) while staying a plain
+Python library layer: invalid values raise ``ValueError`` here and the CLI
+turns them into exit(1), matching the reference's fail-fast behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Tuple
+
+# Solver status codes (reference source/sartsolver.cpp:16-17).
+SUCCESS = 0
+MAX_ITERATIONS_EXCEEDED = -1
+
+
+def parse_time_intervals(time_string: str) -> List[Tuple[float, float, float, float]]:
+    """Parse a multi-interval time-range string.
+
+    Grammar (reference source/arguments.cpp:12-79):
+    ``start:stop[:step[:threshold]],...`` — e.g. ``"20.5:40.1, 45.2:51:15:0.05"``.
+    A trailing ``,`` is allowed. An empty string means "all times":
+    ``[(0, inf, 0, 0)]``. ``step == 0`` means auto-derive; ``threshold == 0``
+    means "use the step".
+
+    Validation, matching the reference exactly:
+    - 2..4 fields per interval,
+    - ``start >= 0``, ``stop > start``, ``step <= stop - start``,
+      ``threshold <= step``.
+    """
+    if not time_string:
+        return [(0.0, math.inf, 0.0, 0.0)]
+
+    intervals: List[Tuple[float, float, float, float]] = []
+    segments = time_string.split(",")
+    for pos, interval_string in enumerate(segments):
+        if not interval_string.strip():
+            if pos == len(segments) - 1:
+                continue  # trailing "," is allowed (arguments.cpp:24)
+            raise ValueError(
+                f"Unable to recognize a time interval in {interval_string}."
+            )
+        fields = interval_string.split(":")
+        if len(fields) < 2:
+            raise ValueError(
+                f"Unable to recognize a time interval in {interval_string}."
+            )
+        if len(fields) > 4:
+            raise ValueError(
+                f"Too many values in a time interval: {interval_string}."
+            )
+        try:
+            start = float(fields[0])
+            stop = float(fields[1])
+            step = float(fields[2]) if len(fields) > 2 else 0.0
+            threshold = float(fields[3]) if len(fields) > 3 else 0.0
+        except ValueError as err:
+            raise ValueError(
+                f"Unable to convert {interval_string} to the time interval."
+            ) from err
+
+        if start < 0:
+            raise ValueError("Time limits must be positive.")
+        if stop <= start:
+            raise ValueError(
+                "The upper limit of the time interval must be higher than the lower one."
+            )
+        if step > (stop - start):
+            raise ValueError("Time step must be less or equal to the time interval.")
+        if threshold > step:
+            raise ValueError(
+                "Synchronization threshold must be less or equal to the time step."
+            )
+        intervals.append((start, stop, step, threshold))
+
+    if not intervals:
+        raise ValueError(f"Unable to recognize a time interval in {time_string}.")
+    return intervals
+
+
+@dataclasses.dataclass(frozen=True)
+class SolverOptions:
+    """Validated solver parameters.
+
+    Defaults and ranges follow the reference CLI (source/arguments.cpp:96-133)
+    and solver setters (source/sartsolver.cpp:61-123).
+
+    TPU-specific extensions beyond the reference's parameter set:
+
+    - ``dtype``: on-device compute dtype; ``"float32"`` mirrors the CUDA path
+      (device fp32 + global-max measurement normalization,
+      sartsolver_cuda.cpp:146-150), ``"float64"`` mirrors the CPU fp64 path
+      (requires ``jax.config.update("jax_enable_x64", True)``).
+    - ``rtm_dtype``: storage dtype for the RTM on device; ``"bfloat16"``
+      halves HBM traffic of the two dominant sweeps (accumulation stays fp32).
+    - ``guess_floor``: the CUDA path clamps any initial solution to
+      ``>= 1e-7`` for both solver variants (sartsolver_cuda.cpp:180); the CPU
+      linear path does not, and the CPU log path uses 1e-100
+      (sartsolver.cpp:14,263).
+    """
+
+    ray_density_threshold: float = 1.0e-6
+    ray_length_threshold: float = 1.0e-6
+    conv_tolerance: float = 1.0e-5
+    beta_laplace: float = 2.0e-2
+    relaxation: float = 1.0
+    max_iterations: int = 2000
+    logarithmic: bool = False
+
+    # TPU extensions
+    dtype: str = "float32"
+    rtm_dtype: str | None = None
+    guess_floor: float = 1.0e-7
+    log_epsilon: float = 1.0e-7  # EPSILON_LOG_CUDA (sart_kernels.cu:18)
+    # The CUDA path normalizes the measurement by its global max to avoid fp32
+    # overflow in ||Hf||^2 (sartsolver_cuda.cpp:146-150); the fp64 CPU path
+    # does not normalize.
+    normalize: bool = True
+    # The CUDA initial-guess kernel excludes negative (saturated) measurements
+    # (sart_kernels.cu:34); the CPU path's initial guess does not
+    # (sartsolver.cpp:149-157). Default follows the device path.
+    mask_negative_guess: bool = True
+
+    @classmethod
+    def cpu_parity(cls, *, logarithmic: bool = False, **kw) -> "SolverOptions":
+        """Options replicating the reference's fp64 CPU path: no
+        normalization, unmasked initial guess, no guess floor (linear).
+
+        The reference's log-path epsilon is 1e-100 (sartsolver.cpp:14); JAX's
+        emulated f64 has fp32 *range*, so the closest representable tiny
+        (1e-30) is used — it plays the same role (guards the 0/0 ratio on
+        masked voxels) with identical solver behavior at any realistic scale.
+        """
+        kw.setdefault("dtype", "float64")
+        kw.setdefault("normalize", False)
+        kw.setdefault("mask_negative_guess", False)
+        kw.setdefault("guess_floor", 1.0e-30 if logarithmic else 0.0)
+        kw.setdefault("log_epsilon", 1.0e-30)
+        return cls(logarithmic=logarithmic, **kw)
+
+    def __post_init__(self) -> None:
+        if self.ray_density_threshold < 0:
+            raise ValueError("Ray density threshold must be non-negative.")
+        if self.ray_length_threshold < 0:
+            raise ValueError("Ray length threshold must be non-negative.")
+        if self.conv_tolerance <= 0:
+            raise ValueError("Convolution tolerance must be positive.")
+        if self.beta_laplace < 0:
+            raise ValueError("Attribute beta_laplace must be non-negative.")
+        if not (0 < self.relaxation <= 1.0):
+            raise ValueError("Attribute relaxation must be within (0, 1] interval.")
+        if self.max_iterations <= 0:
+            raise ValueError("Attribute max_iterations must be positive.")
+        if self.dtype not in ("float32", "float64"):
+            raise ValueError("dtype must be 'float32' or 'float64'.")
+        if self.rtm_dtype not in (None, "float32", "float64", "bfloat16"):
+            raise ValueError("rtm_dtype must be None, 'float32', 'float64' or 'bfloat16'.")

@@ -1,0 +1,1 @@
+"""Host-side HDF5 pipeline: discovery/validation, readers, writers."""

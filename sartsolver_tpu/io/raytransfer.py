@@ -1,0 +1,92 @@
+"""Ray-transfer-matrix block reader.
+
+Loads one pixel row block ``[npixel_local, nvoxel]`` of the global RTM from
+the per-camera, per-segment file layout, matching the reference's
+``RayTransferMatrix::read_hdf5`` (raytransfer.cpp:27-127):
+
+- cameras (sorted order) advance the global *pixel* offset,
+- segments within a camera advance the global *voxel* offset,
+- sparse segments are COO scattered into the dense block,
+- dense segments are hyperslab-read only for rows in this block's range.
+
+The reference's two read modes (``--parallel_read`` vs barrier-serialized,
+main.cpp:78-86) are an HDD-era MPI concern; here each host process reads its
+own stripes directly (single process reads everything).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import h5py
+import numpy as np
+
+
+def read_rtm_block(
+    sorted_matrix_files: Dict[str, List[str]],
+    rtm_name: str,
+    npixel_local: int,
+    nvoxel: int,
+    offset_pixel: int,
+    *,
+    dtype=np.float32,
+    scatter_coo=None,
+) -> np.ndarray:
+    """Read rows [offset_pixel, offset_pixel + npixel_local) of the global RTM.
+
+    ``scatter_coo(mat, rows, cols, vals)`` may be supplied to accelerate the
+    sparse scatter (the native C++ helper); defaults to NumPy fancy indexing.
+    """
+    if npixel_local <= 0 or nvoxel <= 0:
+        raise ValueError("To read a ray-transfer block, its size must be non-zero.")
+
+    mat = np.zeros((npixel_local, nvoxel), dtype=dtype)
+    last_pixel = offset_pixel + npixel_local
+
+    start_pixel = 0
+    for camera, filenames in sorted_matrix_files.items():
+        with h5py.File(filenames[0], "r") as f0:
+            npixel_data = int(f0["rtm"].attrs["npixel"])
+
+        if offset_pixel < start_pixel + npixel_data:
+            start_voxel = 0
+            for filename in filenames:
+                with h5py.File(filename, "r") as f:
+                    rtm_group = f["rtm"]
+                    nvoxel_data = int(rtm_group.attrs["nvoxel"])
+                    group = rtm_group[rtm_name]
+                    is_sparse = int(group.attrs["is_sparse"])
+
+                    if is_sparse:
+                        pixel_index = np.asarray(group["pixel_index"], np.int64) + start_pixel
+                        voxel_index = np.asarray(group["voxel_index"], np.int64) + start_voxel
+                        value = np.asarray(group["value"], dtype)
+                        sel = (pixel_index >= offset_pixel) & (pixel_index < last_pixel)
+                        rows = pixel_index[sel] - offset_pixel
+                        cols = voxel_index[sel]
+                        vals = value[sel]
+                        if scatter_coo is not None:
+                            scatter_coo(mat, rows, cols, vals)
+                        else:
+                            mat[rows, cols] = vals
+                    else:
+                        dset = group["value"]
+                        # rows of this camera's matrix that fall in our block
+                        ipix_begin = max(offset_pixel - start_pixel, 0)
+                        ipix_end = min(npixel_data, offset_pixel + npixel_local - start_pixel)
+                        pix_offset = 0 if offset_pixel > start_pixel else start_pixel - offset_pixel
+                        if ipix_end > ipix_begin:
+                            out_rows = slice(
+                                pix_offset, pix_offset + (ipix_end - ipix_begin)
+                            )
+                            mat[out_rows, start_voxel:start_voxel + nvoxel_data] = dset[
+                                ipix_begin:ipix_end, :
+                            ]
+
+                start_voxel += nvoxel_data
+
+        start_pixel += npixel_data
+        if last_pixel < start_pixel:
+            break
+
+    return mat

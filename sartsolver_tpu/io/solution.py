@@ -1,0 +1,135 @@
+"""Solution writer: buffered, incrementally-flushed HDF5 output.
+
+Mirrors the reference's ``Solution`` (solution.cpp): solutions, statuses and
+times are buffered per frame and flushed every ``max_cache_size`` frames and
+on close; the first flush creates extendible chunked datasets
+(``solution/value [T, nvoxel]``, ``time``, ``time_<camera>``, ``status``),
+later flushes extend + append. Incremental flushing is the reference's only
+resilience mechanism (a crash loses at most one cache window).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import h5py
+import numpy as np
+
+
+class SolutionWriter:
+    def __init__(
+        self,
+        filename: str,
+        camera_names: Sequence[str],
+        nvoxel: int,
+        max_cache_size: int = 100,
+    ):
+        if nvoxel == 0:
+            raise ValueError("Argument nvoxel must be positive.")
+        if max_cache_size == 0:
+            raise ValueError("Attribute max_cache_size must be positive.")
+        self.filename = filename
+        self.nvox = nvoxel
+        self.max_cache_size = max_cache_size
+        self.first_flush = True
+        self._solutions: List[np.ndarray] = []
+        self._status: List[int] = []
+        self._time: List[float] = []
+        self._camera_time: Dict[str, List[float]] = {name: [] for name in camera_names}
+
+    # -- API ---------------------------------------------------------------
+    def add(
+        self,
+        solution: np.ndarray,
+        status: int,
+        time: float,
+        camera_time: Sequence[float],
+    ) -> None:
+        """Buffer one frame's result (solution.cpp:44-58). ``camera_time``
+        is ordered like the camera-name list."""
+        self._status.append(int(status))
+        self._solutions.append(np.asarray(solution, np.float64))
+        self._time.append(float(time))
+        for name, t in zip(self._camera_time, camera_time):
+            self._camera_time[name].append(float(t))
+        if len(self._solutions) >= self.max_cache_size:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self._solutions:
+            return
+        if self.first_flush:
+            self._create()
+        else:
+            self._update()
+        self.first_flush = False
+        self._solutions.clear()
+        self._status.clear()
+        self._time.clear()
+        for v in self._camera_time.values():
+            v.clear()
+
+    def close(self) -> None:
+        self.flush()
+
+    def __enter__(self) -> "SolutionWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- HDF5 --------------------------------------------------------------
+    def _create(self) -> None:
+        """First flush: new file with extendible datasets (solution.cpp:60-112).
+
+        (The reference sets the integer status fill value with a
+        NATIVE_DOUBLE type tag, solution.cpp:102 — a defect not replicated.)
+        """
+        n = len(self._solutions)
+        with h5py.File(self.filename, "w") as f:
+            group = f.create_group("solution")
+            group.create_dataset(
+                "value",
+                data=np.stack(self._solutions),
+                maxshape=(None, self.nvox),
+                chunks=(1, self.nvox),
+                dtype=np.float64,
+                fillvalue=0.0,
+            )
+            group.create_dataset(
+                "time", data=np.asarray(self._time), maxshape=(None,),
+                chunks=(n,), dtype=np.float64, fillvalue=0.0,
+            )
+            for name, times in self._camera_time.items():
+                group.create_dataset(
+                    f"time_{name}", data=np.asarray(times), maxshape=(None,),
+                    chunks=(n,), dtype=np.float64, fillvalue=0.0,
+                )
+            group.create_dataset(
+                "status", data=np.asarray(self._status, np.int32),
+                maxshape=(None,), chunks=(n,), dtype=np.int32, fillvalue=0,
+            )
+
+    def _update(self) -> None:
+        """Later flushes: extend + append (solution.cpp:114-165)."""
+        n = len(self._solutions)
+        with h5py.File(self.filename, "r+") as f:
+            offset = f["solution/time"].shape[0]
+            new_size = offset + n
+
+            dset = f["solution/time"]
+            dset.resize((new_size,))
+            dset[offset:] = np.asarray(self._time)
+
+            dset = f["solution/status"]
+            dset.resize((new_size,))
+            dset[offset:] = np.asarray(self._status, np.int32)
+
+            for name, times in self._camera_time.items():
+                dset = f[f"solution/time_{name}"]
+                dset.resize((new_size,))
+                dset[offset:] = np.asarray(times)
+
+            dset = f["solution/value"]
+            dset.resize((new_size, self.nvox))
+            dset[offset:] = np.stack(self._solutions)

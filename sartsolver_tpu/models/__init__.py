@@ -1,0 +1,3 @@
+"""Solver model families: linear SART and logarithmic (multiplicative) SART."""
+
+from sartsolver_tpu.models.sart import SARTProblem, make_problem, solve, SolveResult  # noqa: F401

@@ -1,0 +1,106 @@
+"""NumPy fp64 oracle implementing the reference CPU path exactly.
+
+This is the test oracle the reference itself implies (its ``--use_cpu`` fp64
+solver doubles as the correctness reference for the fp32 CUDA solver). The
+semantics here follow sartsolver.cpp:133-339 line by line:
+
+- initial guess does NOT exclude negative measurements (sartsolver.cpp:153),
+- linear path applies no floor to the starting solution; log path floors at
+  1e-100 (sartsolver.cpp:14,263),
+- ``||g||^2`` excludes non-positive measurements (sartsolver.cpp:163),
+- back-projection skips pixels with ``ray_length <= threshold`` or negative
+  measurements and voxels with ``ray_density <= threshold``
+  (sartsolver.cpp:193-202), while the Laplacian penalty applies to all voxels
+  (sartsolver.cpp:204),
+- convergence ``C = (||g||^2 - ||Hf||^2)/||g||^2`` checked from iteration 1
+  (sartsolver.cpp:224-228).
+
+No JAX here on purpose: an independent implementation in a different
+framework and precision is what makes it an oracle.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from sartsolver_tpu.config import MAX_ITERATIONS_EXCEEDED, SUCCESS
+
+EPSILON_LOG = 1.0e-100  # sartsolver.cpp:14
+
+
+def solve_oracle(
+    rtm: np.ndarray,  # [P, V] (full matrix)
+    measurement: np.ndarray,  # [P]
+    laplacian: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]] = None,  # (rows, cols, vals)
+    f0: Optional[np.ndarray] = None,
+    *,
+    logarithmic: bool = False,
+    ray_density_threshold: float = 1.0e-6,
+    ray_length_threshold: float = 1.0e-6,
+    conv_tolerance: float = 1.0e-5,
+    beta_laplace: float = 2.0e-2,
+    relaxation: float = 1.0,
+    max_iterations: int = 2000,
+    log_epsilon: float = EPSILON_LOG,
+):
+    """Returns (f, status, iterations, conv_history)."""
+    H = np.asarray(rtm, np.float64)
+    g = np.asarray(measurement, np.float64)
+    P, V = H.shape
+
+    ray_density = H.sum(axis=0)
+    ray_length = H.sum(axis=1)
+    vmask = ray_density > ray_density_threshold
+    pmask = (ray_length > ray_length_threshold) & (g >= 0)
+
+    if laplacian is not None:
+        lr, lc, lv = (np.asarray(a) for a in laplacian)
+        L = np.zeros((V, V))
+        np.add.at(L, (lr, lc), lv)
+    else:
+        L = None
+
+    if f0 is None:
+        f = np.zeros(V)
+        # Initial guess without negative-measurement masking (sartsolver.cpp:149-157).
+        f[vmask] = (H.T @ g)[vmask] / ray_density[vmask]
+    else:
+        f = np.asarray(f0, np.float64).copy()
+
+    if logarithmic:
+        f = np.maximum(f, log_epsilon)
+
+    msq = float(np.sum(np.where(g > 0, g, 0.0) ** 2))
+    fitted = H @ f
+
+    inv_length = np.where(pmask, 1.0 / np.where(pmask, ray_length, 1.0), 0.0)
+
+    conv_history = []
+    conv_prev = 0.0
+    for it in range(max_iterations):
+        if logarithmic:
+            penalty = beta_laplace * (L @ np.log(f)) if L is not None else np.zeros(V)
+            w = inv_length
+            obs = H.T @ (np.where(pmask, g, 0.0) * w)
+            fit = H.T @ (np.where(pmask, fitted, 0.0) * w)
+            obs = np.where(vmask, obs, 0.0)
+            fit = np.where(vmask, fit, 0.0)
+            ratio = ((obs + log_epsilon) / (fit + log_epsilon)) ** relaxation
+            f = f * ratio * np.exp(-penalty)
+        else:
+            penalty = beta_laplace * (L @ f) if L is not None else np.zeros(V)
+            w = np.where(pmask, g - fitted, 0.0) * inv_length
+            diff = np.where(vmask, relaxation / np.where(vmask, ray_density, 1.0) * (H.T @ w), 0.0)
+            f = np.maximum(f + diff - penalty, 0.0)
+
+        fitted = H @ f
+        fsq = float(np.sum(fitted * fitted))
+        conv = (msq - fsq) / msq
+        conv_history.append(conv)
+        if it >= 1 and abs(conv - conv_prev) < conv_tolerance:
+            return f, SUCCESS, it + 1, conv_history
+        conv_prev = conv
+
+    return f, MAX_ITERATIONS_EXCEEDED, max_iterations, conv_history

@@ -1,0 +1,258 @@
+"""Constrained SART solvers, TPU-native.
+
+Implements the reference's two solver families (manual Eq. 2-6):
+
+- **Linear SART** (additive, non-negativity-constrained) — reference CPU path
+  sartsolver.cpp:133-232, CUDA path sartsolver_cuda.cpp:197-274.
+- **Logarithmic SART** (multiplicative) — sartsolver.cpp:235-339,
+  sartsolver_cuda.cpp:277-354.
+
+Design: one code path with a swappable update rule (the reference maintains
+four near-duplicate solvers). The entire iteration loop is a single
+jit-compiled ``lax.while_loop``; per-iteration global reductions are
+``lax.psum`` over the ``'pixels'`` mesh axis when running sharded (the
+reference's 16 ``MPI_Allreduce`` sites, e.g. sartsolver.cpp:206,222), and
+identity when running on one device. Unlike the reference's CUDA path there
+is **no** per-iteration device->host->network->device staging
+(sartsolver_cuda.cpp:242-244) — reductions ride the ICI.
+
+Precision policy mirrors the CUDA path by default: fp32 on device, with the
+measurement normalized by its global max to keep ``||Hf||^2`` inside fp32
+range (sartsolver_cuda.cpp:146-157); ``SolverOptions.cpu_parity()`` instead
+reproduces the fp64 CPU path (requires x64).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+from sartsolver_tpu.config import MAX_ITERATIONS_EXCEEDED, SUCCESS, SolverOptions
+from sartsolver_tpu.ops.laplacian import LaplacianCOO, coo_matvec
+from sartsolver_tpu.ops.projection import back_project, forward_project
+
+
+class SARTProblem(NamedTuple):
+    """Device-resident problem state (the reference's solver-ctor uploads,
+    sartsolver_cuda.cpp:103-124).
+
+    ``rtm`` is the local row block ``[npixel_local, nvoxel]`` of the global
+    RTM (row-block distribution, main.cpp:67-68). ``ray_density`` is the
+    *global* per-voxel column sum (allreduced, sartsolver.cpp:38-47);
+    ``ray_length`` is the *local* per-pixel row sum (sartsolver.cpp:49-56).
+    """
+
+    rtm: Array  # [P_local, V], opts.rtm_dtype
+    ray_density: Array  # [V], opts.dtype
+    ray_length: Array  # [P_local], opts.dtype
+    laplacian: Optional[LaplacianCOO]  # COO over [V, V], or None
+
+
+class SolveResult(NamedTuple):
+    solution: Array  # [V] (denormalized, opts.dtype)
+    status: Array  # int32 scalar: SUCCESS / MAX_ITERATIONS_EXCEEDED
+    iterations: Array  # int32 scalar: completed iterations
+    convergence: Array  # final residual metric C^k (Eq. 5)
+
+
+def _psum(x, axis_name):
+    return lax.psum(x, axis_name) if axis_name is not None else x
+
+
+# This JAX build emulates float64 as float32 pairs: full ~2x-fp32 precision
+# but *fp32 range* — magnitudes below ~1.2e-38 flush to zero. The reference's
+# EPSILON_LOG = 1e-100 (sartsolver.cpp:14) is therefore unrepresentable on
+# device; positive tiny constants are clamped to the smallest safe normal.
+MIN_POSITIVE = 1.2e-37
+
+
+def _tiny(value: float, dtype) -> Array:
+    if 0.0 < value < MIN_POSITIVE:
+        value = MIN_POSITIVE
+    return jnp.asarray(value, dtype)
+
+
+def compute_ray_stats(rtm: Array, *, dtype, axis_name=None) -> Tuple[Array, Array]:
+    """Per-voxel ray density (global) and per-pixel ray length (local).
+
+    Reference: sartsolver.cpp:38-56 — column sums allreduced over ranks, row
+    sums kept local.
+    """
+    dens = _psum(jnp.sum(rtm, axis=0, dtype=dtype), axis_name)
+    length = jnp.sum(rtm, axis=1, dtype=dtype)
+    return dens, length.astype(dtype)
+
+
+def make_problem(
+    rtm,
+    laplacian: Optional[LaplacianCOO] = None,
+    *,
+    opts: SolverOptions,
+    axis_name=None,
+) -> SARTProblem:
+    """Build device problem state from a (local block of the) RTM."""
+    dtype = jnp.dtype(opts.dtype)
+    rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
+    rtm = jnp.asarray(rtm)
+    dens, length = compute_ray_stats(rtm, dtype=dtype, axis_name=axis_name)
+    return SARTProblem(rtm.astype(rtm_dtype), dens, length, laplacian)
+
+
+def _initial_guess(problem: SARTProblem, g: Array, opts: SolverOptions, axis_name) -> Array:
+    """Default initial guess f0 = H^T g / rho on unmasked voxels (Eq. 4;
+    sartsolver.cpp:144-159, sart_kernels.cu:22-60)."""
+    vmask = problem.ray_density > opts.ray_density_threshold
+    g_guess = jnp.where(g > 0, g, 0) if opts.mask_negative_guess else g
+    accum = _psum(back_project(problem.rtm, g_guess, accum_dtype=g.dtype), axis_name)
+    safe_dens = jnp.where(vmask, problem.ray_density, 1)
+    return jnp.where(vmask, accum / safe_dens, 0)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("opts", "axis_name", "use_guess")
+)
+def solve_normalized(
+    problem: SARTProblem,
+    g: Array,
+    msq: Array,
+    f0: Array,
+    *,
+    opts: SolverOptions,
+    axis_name=None,
+    use_guess: bool,
+) -> SolveResult:
+    """Jit-compiled solver core on a pre-normalized measurement.
+
+    ``g``/``f0`` are already divided by the global norm; ``msq`` is the
+    normalized ``||g||^2`` with negative (saturated) measurements excluded
+    (sartsolver.cpp:161-164). When running under ``shard_map``, ``g``,
+    ``problem.rtm`` and ``problem.ray_length`` hold this device's pixel block
+    and ``axis_name`` names the pixel mesh axis.
+    """
+    dtype = jnp.dtype(opts.dtype)
+    rtm = problem.rtm
+    nvoxel = rtm.shape[1]
+    eps = _tiny(opts.log_epsilon, dtype)
+
+    vmask = problem.ray_density > opts.ray_density_threshold
+    safe_dens = jnp.where(vmask, problem.ray_density, 1)
+    inv_density = jnp.where(vmask, opts.relaxation / safe_dens, 0).astype(dtype)
+    lmask = problem.ray_length > opts.ray_length_threshold
+    inv_length = jnp.where(lmask, 1 / jnp.where(lmask, problem.ray_length, 1), 0).astype(dtype)
+    meas_mask = g >= 0  # negative measurements mark saturated detectors (Eq. 6)
+
+    if use_guess:
+        f0 = _initial_guess(problem, g, opts, axis_name)
+    if opts.guess_floor > 0:
+        # CUDA path floors *any* starting solution at 1e-7 for both variants
+        # (sartsolver_cuda.cpp:180); CPU log path floors at 1e-100
+        # (sartsolver.cpp:263); CPU linear path does not floor.
+        f0 = jnp.maximum(f0, _tiny(opts.guess_floor, dtype))
+    if opts.logarithmic:
+        # The log path must floor unconditionally (both reference backends
+        # do): a zero voxel would give log(0) = -inf in the penalty and can
+        # never recover under the multiplicative update.
+        f0 = jnp.maximum(f0, _tiny(max(opts.guess_floor, opts.log_epsilon), dtype))
+    f0 = f0.astype(dtype)
+
+    fitted0 = forward_project(rtm, f0, accum_dtype=dtype)
+
+    beta = jnp.asarray(opts.beta_laplace, dtype)
+    tol = jnp.asarray(opts.conv_tolerance, dtype)
+    msq = jnp.asarray(msq, dtype)
+
+    if opts.logarithmic:
+        # obs = H~^T g is iteration-invariant (the reference recomputes it in
+        # every LogPropagateKernel pass, sart_kernels.cu:113-176; hoisting it
+        # halves that kernel's work with identical math).
+        obs = _psum(
+            back_project(rtm, jnp.where(meas_mask, g, 0) * inv_length, accum_dtype=dtype),
+            axis_name,
+        )
+        obs = jnp.where(vmask, obs, 0)
+
+    def body(carry):
+        f, fitted, conv_prev, it, _ = carry
+        if opts.logarithmic:
+            # Multiplicative update (Eq. 3; sartsolver.cpp:287-316).
+            penalty = beta * coo_matvec(problem.laplacian, jnp.log(f), nvoxel)
+            fit = _psum(
+                back_project(rtm, jnp.where(meas_mask, fitted, 0) * inv_length, accum_dtype=dtype),
+                axis_name,
+            )
+            fit = jnp.where(vmask, fit, 0)
+            ratio = ((obs + eps) / (fit + eps)) ** jnp.asarray(opts.relaxation, dtype)
+            f_new = f * ratio * jnp.exp(-penalty)
+        else:
+            # Additive update + non-negativity clamp (Eq. 2;
+            # sartsolver.cpp:183-209, sart_kernels.cu:63-110).
+            penalty = beta * coo_matvec(problem.laplacian, f, nvoxel)
+            w = jnp.where(meas_mask, g - fitted, 0) * inv_length
+            bp = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
+            f_new = jnp.maximum(f + inv_density * bp - penalty, 0)
+
+        fitted_new = forward_project(rtm, f_new, accum_dtype=dtype)
+        fsq = _psum(jnp.sum(fitted_new * fitted_new), axis_name)
+        conv = (msq - fsq) / msq  # Eq. 5 (sartsolver.cpp:224)
+        converged = (it >= 1) & (jnp.abs(conv - conv_prev) < tol)
+        return (f_new, fitted_new, conv, it + 1, converged)
+
+    def cond(carry):
+        _, _, _, it, converged = carry
+        return (it < opts.max_iterations) & ~converged
+
+    init = (
+        f0,
+        fitted0,
+        jnp.asarray(0, dtype),
+        jnp.asarray(0, jnp.int32),
+        jnp.asarray(False),
+    )
+    f, _, conv, it, converged = lax.while_loop(cond, body, init)
+    status = jnp.where(converged, SUCCESS, MAX_ITERATIONS_EXCEEDED).astype(jnp.int32)
+    return SolveResult(f, status, it, conv)
+
+
+def solve(
+    problem: SARTProblem,
+    measurement,
+    f0=None,
+    *,
+    opts: SolverOptions,
+) -> SolveResult:
+    """Single-device solve on a full (unsharded) problem.
+
+    Host-side pre-step mirrors the reference's ``pre_iteration_setup``
+    (sartsolver_cuda.cpp:138-194): the norm and ``||g||^2`` are computed in
+    fp64 on host, the measurement is normalized, and the result is
+    denormalized on the way out. The sharded equivalent lives in
+    ``sartsolver_tpu.parallel.sharded``.
+    """
+    dtype = jnp.dtype(opts.dtype)
+    g64 = np.asarray(measurement, dtype=np.float64)
+
+    if opts.normalize:
+        norm = float(np.max(g64))
+        if norm <= 0:
+            norm = 1.0  # fully dark/saturated frame: nothing to normalize by
+    else:
+        norm = 1.0
+    msq = float(np.sum(np.where(g64 > 0, g64, 0.0) ** 2)) / (norm * norm)
+
+    g = jnp.asarray(g64 / norm, dtype)
+    use_guess = f0 is None
+    if use_guess:
+        f0 = jnp.zeros((problem.rtm.shape[1],), dtype)
+    else:
+        f0 = jnp.asarray(np.asarray(f0, np.float64) / norm, dtype)
+
+    res = solve_normalized(
+        problem, g, jnp.asarray(msq, dtype), f0,
+        opts=opts, axis_name=None, use_guess=use_guess,
+    )
+    return SolveResult(res.solution * jnp.asarray(norm, dtype), res.status, res.iterations, res.convergence)

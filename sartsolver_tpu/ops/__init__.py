@@ -1,0 +1,4 @@
+"""Device-side math ops: projections (MXU matmuls) and sparse Laplacian."""
+
+from sartsolver_tpu.ops.projection import forward_project, back_project  # noqa: F401
+from sartsolver_tpu.ops.laplacian import coo_matvec  # noqa: F401

@@ -1,0 +1,59 @@
+"""Sparse Laplacian regularizer ops.
+
+The reference stores the Laplacian as flattened-index COO sorted by
+``i*nvoxel + j`` (laplacian.cpp:67-82) and gathers it with scalar loops
+(CPU, sartsolver.cpp:183-189) or an atomicAdd grid-stride kernel
+(GradPenaltyKernel, sart_kernels.cu:179-202). The TPU-native equivalent is a
+static-shape COO scatter-add: XLA lowers ``.at[rows].add`` to an on-device
+scatter; rows/cols/vals are padded to a static size so the op stays
+jit-stable across frames.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+class LaplacianCOO(NamedTuple):
+    """Static-shape COO triplets (padded entries have ``vals == 0``)."""
+
+    rows: Array  # [nnz] int32
+    cols: Array  # [nnz] int32
+    vals: Array  # [nnz] float
+
+    @property
+    def nnz(self) -> int:
+        return self.rows.shape[0]
+
+
+def make_laplacian(rows, cols, vals, *, dtype=jnp.float32, pad_to: int | None = None) -> LaplacianCOO:
+    """Build a device-ready COO Laplacian from host triplets.
+
+    Padding keeps the nnz static under jit when streams of problems have
+    slightly different sparsity (pad entries scatter 0 into row 0).
+    """
+    rows = np.asarray(rows, dtype=np.int32)
+    cols = np.asarray(cols, dtype=np.int32)
+    vals = np.asarray(vals)
+    if pad_to is not None and pad_to > rows.shape[0]:
+        pad = pad_to - rows.shape[0]
+        rows = np.concatenate([rows, np.zeros(pad, np.int32)])
+        cols = np.concatenate([cols, np.zeros(pad, np.int32)])
+        vals = np.concatenate([vals, np.zeros(pad, vals.dtype)])
+    return LaplacianCOO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals, dtype=dtype))
+
+
+def coo_matvec(lap: LaplacianCOO | None, x: Array, nvoxel: int) -> Array:
+    """``L @ x`` for the COO Laplacian; zeros when no regularizer is set.
+
+    Matches the gather semantics of sartsolver.cpp:184-189: for every stored
+    triplet ``(i, j, v)``, accumulate ``v * x[j]`` into output row ``i``.
+    """
+    if lap is None:
+        return jnp.zeros((nvoxel,), dtype=x.dtype)
+    contrib = lap.vals.astype(x.dtype) * x[lap.cols]
+    return jnp.zeros((nvoxel,), dtype=x.dtype).at[lap.rows].add(contrib)

@@ -1,0 +1,36 @@
+"""Forward/back projection as XLA matmuls.
+
+The reference implements these as cuBLAS ``Sgemv`` (forward,
+sartsolver_cuda.cpp:188,248) and a custom fused CUDA kernel (backward,
+sart_kernels.cu:63-110). On TPU both are expressed as contractions so XLA
+tiles them onto the MXU; masking/scaling stay elementwise and fuse into the
+surrounding ops. Both support a reduced-precision RTM (e.g. bfloat16) with
+fp32 accumulation via ``preferred_element_type``.
+
+Shapes use the row-block convention of the reference's MPI distribution
+(main.cpp:67-68): ``rtm`` is the local block ``[npixel_local, nvoxel]``;
+pixel-axis vectors are local, voxel-axis vectors are global/replicated.
+``measurement`` may also carry a leading batch axis ``[B, npixel_local]``
+(multi-frame batched solve), in which case results carry the same batch axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def forward_project(rtm: Array, solution: Array, *, accum_dtype=jnp.float32) -> Array:
+    """``fitted = H @ f`` — per-pixel line integrals of the emissivity.
+
+    rtm: [P, V]; solution: [V] or [B, V] -> fitted: [P] or [B, P].
+    """
+    return jnp.matmul(solution, rtm.T, preferred_element_type=accum_dtype)
+
+
+def back_project(rtm: Array, pixel_values: Array, *, accum_dtype=jnp.float32) -> Array:
+    """``H^T @ w`` — accumulate per-pixel values into voxels.
+
+    rtm: [P, V]; pixel_values: [P] or [B, P] -> [V] or [B, V].
+    """
+    return jnp.matmul(pixel_values, rtm, preferred_element_type=accum_dtype)

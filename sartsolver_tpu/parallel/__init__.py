@@ -1,0 +1,3 @@
+"""Device-mesh parallelism: sharding specs, partition math, sharded solve."""
+
+from sartsolver_tpu.parallel.mesh import row_block_partition, make_mesh  # noqa: F401

@@ -1,0 +1,77 @@
+"""Mesh construction and row-block partition arithmetic.
+
+The reference distributes the RTM by pixel row blocks across MPI ranks with
+the balanced-remainder formula at main.cpp:67-68. On TPU the same 1-D
+distribution becomes a ``jax.sharding.Mesh`` axis ``'pixels'``; an optional
+second axis ``'voxels'`` column-shards the matrix when the voxel-sized state
+itself outgrows one chip.
+
+SPMD sharding wants equal block sizes, so instead of the reference's
+uneven-remainder split we zero-pad the pixel axis to a multiple of the shard
+count: padded rows have ``ray_length == 0`` (=> pixel masked out,
+sartsolver.cpp:196) and their measurements are set negative (=> treated as
+saturated and excluded everywhere, Eq. 6), making padding exactly inert.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+PIXEL_AXIS = "pixels"
+VOXEL_AXIS = "voxels"
+
+
+def row_block_partition(npixel: int, nshards: int) -> List[Tuple[int, int]]:
+    """(offset, count) per shard — the reference's MPI split (main.cpp:67-68).
+
+    Used for host-side striped HDF5 reads (each process reads only its rows);
+    the device-side layout uses :func:`padded_block` instead.
+    """
+    base, rem = divmod(npixel, nshards)
+    out = []
+    for rank in range(nshards):
+        offset = rank * base + min(rank, rem)
+        count = base + (1 if rank < rem else 0)
+        out.append((offset, count))
+    return out
+
+
+def padded_size(n: int, nshards: int) -> int:
+    """Smallest multiple of ``nshards`` >= n."""
+    return ((n + nshards - 1) // nshards) * nshards
+
+
+def pad_pixel_axis(rtm: np.ndarray, nshards: int) -> np.ndarray:
+    """Zero-pad RTM rows to a multiple of the pixel-shard count."""
+    target = padded_size(rtm.shape[0], nshards)
+    if target == rtm.shape[0]:
+        return rtm
+    pad = np.zeros((target - rtm.shape[0], rtm.shape[1]), dtype=rtm.dtype)
+    return np.concatenate([rtm, pad], axis=0)
+
+
+def pad_measurement(g: np.ndarray, nshards: int) -> np.ndarray:
+    """Pad the measurement with -1 (saturated => excluded everywhere)."""
+    target = padded_size(g.shape[0], nshards)
+    if target == g.shape[0]:
+        return g
+    return np.concatenate([g, np.full(target - g.shape[0], -1.0, dtype=g.dtype)])
+
+
+def make_mesh(n_pixel_shards: int | None = None, n_voxel_shards: int = 1, devices=None) -> Mesh:
+    """Build a ('pixels',) or ('pixels', 'voxels') mesh over local devices."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if n_pixel_shards is None:
+        n_pixel_shards = len(devices) // n_voxel_shards
+    ndev = n_pixel_shards * n_voxel_shards
+    if ndev > len(devices):
+        raise ValueError(
+            f"Mesh {n_pixel_shards}x{n_voxel_shards} needs {ndev} devices, "
+            f"have {len(devices)}."
+        )
+    arr = np.array(devices[:ndev]).reshape(n_pixel_shards, n_voxel_shards)
+    return Mesh(arr, (PIXEL_AXIS, VOXEL_AXIS))

@@ -1,0 +1,1 @@
+"""Host utilities: timing, logging."""

@@ -1,0 +1,28 @@
+"""Test environment: force CPU backend with 8 virtual devices so the
+sharded ('pixels',) / ('pixels','voxels') code paths run without TPU
+hardware (the JAX equivalent of testing mpirun -np 8 on one box), and enable
+x64 so the fp64 CPU-parity path is exercisable."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon TPU-tunnel plugin registers itself in every interpreter via
+# sitecustomize and intercepts backend lookup; when the tunnel is slow or
+# down it can block even pure-CPU runs. Tests are CPU-only by design, so
+# drop the non-CPU factories before any backend is instantiated.
+from jax._src import xla_bridge as _xb  # noqa: E402
+
+for _name in list(_xb._backend_factories):
+    if _name != "cpu":
+        _xb._backend_factories.pop(_name, None)
+
+# sitecustomize imports jax before this file runs, so JAX_PLATFORMS=axon from
+# the outer environment is already latched into the config — override it too.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

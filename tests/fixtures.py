@@ -1,0 +1,181 @@
+"""Synthetic HDF5 fixtures matching the reference file schemas.
+
+Builds a small multi-camera, multi-segment world:
+
+- 4x4x1 voxel grid, 16 voxels.
+- camera A: 3x4 image, 8 masked pixels, RTM split into TWO segment files
+  (voxels 0-7 dense, voxels 8-15 sparse) — exercises segment sorting,
+  voxel-offset stitching and both storage layouts.
+- camera B: 2x3 image, all 6 pixels masked, single dense RTM file.
+- asynchronous timelines: camera B's clock is offset by a small jitter.
+- a 1-D chain Laplacian over the 16 voxels.
+"""
+
+from __future__ import annotations
+
+import os
+
+import h5py
+import numpy as np
+
+NX, NY, NZ = 4, 4, 1
+NVOXEL = NX * NY * NZ
+WAVELENGTH = 500.0
+
+CAM_A = "camA"  # 3x4 image, mask keeps 8 pixels
+CAM_B = "camB"  # 2x3 image, all 6 pixels
+
+MASK_A = np.array(
+    [[1, 0, 1, 1],
+     [0, 1, 1, 0],
+     [1, 1, 0, 1]], dtype=np.int64)
+MASK_B = np.ones((2, 3), dtype=np.int64)
+
+NPIX_A = int(MASK_A.sum())  # 8
+NPIX_B = int(MASK_B.sum())  # 6
+NPIXEL = NPIX_A + NPIX_B  # 14 (camA rows first: "camA" < "camB")
+
+
+def make_rtm_matrices(seed=0):
+    rng = np.random.default_rng(seed)
+    H_a = rng.uniform(0.1, 1.0, (NPIX_A, NVOXEL)).astype(np.float32)
+    H_b = rng.uniform(0.1, 1.0, (NPIX_B, NVOXEL)).astype(np.float32)
+    # make the sparse segment actually sparse
+    H_a[:, 8:][rng.uniform(size=H_a[:, 8:].shape) < 0.4] = 0.0
+    return H_a, H_b
+
+
+def _write_voxel_map(group, cells, values, coordinate_system=None):
+    vm = group.create_group("voxel_map")
+    vm.attrs.create("nx", NX, dtype=np.uint64)
+    vm.attrs.create("ny", NY, dtype=np.uint64)
+    vm.attrs.create("nz", NZ, dtype=np.uint64)
+    for name, val in (
+        ("xmin", 0.0), ("xmax", 4.0), ("ymin", 0.0), ("ymax", 4.0),
+        ("zmin", 0.0), ("zmax", 1.0),
+    ):
+        vm.attrs.create(name, val, dtype=np.float64)
+    if coordinate_system:
+        vm.attrs["coordinate_system"] = coordinate_system
+    i = cells // (NY * NZ)
+    rem = cells % (NY * NZ)
+    vm.create_dataset("i", data=i.astype(np.uint64))
+    vm.create_dataset("j", data=(rem // NZ).astype(np.uint64))
+    vm.create_dataset("k", data=(rem % NZ).astype(np.uint64))
+    vm.create_dataset("value", data=values.astype(np.int64))
+
+
+def _write_rtm_file(path, camera, mask, block, voxel_cells, voxel_values,
+                    sparse=False, rtm_name="with_reflections",
+                    wavelength=WAVELENGTH):
+    npix, nvox = block.shape
+    with h5py.File(path, "w") as f:
+        rtm = f.create_group("rtm")
+        rtm.attrs["camera_name"] = camera
+        rtm.attrs.create("npixel", npix, dtype=np.uint64)
+        rtm.attrs.create("nvoxel", nvox, dtype=np.uint64)
+        rtm.create_dataset("frame_mask", data=mask)
+        g = rtm.create_group(rtm_name)
+        g.attrs.create("wavelength", wavelength, dtype=np.float64)
+        g.attrs.create("is_sparse", 1 if sparse else 0, dtype=np.int64)
+        if sparse:
+            rows, cols = np.nonzero(block)
+            g.create_dataset("pixel_index", data=rows.astype(np.uint64))
+            g.create_dataset("voxel_index", data=cols.astype(np.uint64))
+            g.create_dataset("value", data=block[rows, cols].astype(np.float32))
+        else:
+            g.create_dataset("value", data=block.astype(np.float32))
+        _write_voxel_map(rtm, voxel_cells, voxel_values)
+
+
+def _write_image_file(path, camera, frames, times, wavelength=WAVELENGTH):
+    with h5py.File(path, "w") as f:
+        img = f.create_group("image")
+        img.attrs["camera_name"] = camera
+        img.attrs.create("wavelength", wavelength, dtype=np.float64)
+        img.create_dataset("frame", data=np.asarray(frames, np.float64))
+        img.create_dataset("time", data=np.asarray(times, np.float64))
+
+
+def write_laplacian_file(path, nvoxel=NVOXEL, scale=0.1):
+    rows, cols, vals = [], [], []
+    for i in range(nvoxel):
+        rows.append(i); cols.append(i); vals.append(2.0 * scale)
+        if i > 0:
+            rows.append(i); cols.append(i - 1); vals.append(-scale)
+        if i < nvoxel - 1:
+            rows.append(i); cols.append(i + 1); vals.append(-scale)
+    with h5py.File(path, "w") as f:
+        g = f.create_group("laplacian")
+        g.attrs.create("nvoxel", nvoxel, dtype=np.uint64)
+        g.create_dataset("i", data=np.asarray(rows, np.uint64))
+        g.create_dataset("j", data=np.asarray(cols, np.uint64))
+        g.create_dataset("value", data=np.asarray(vals, np.float32))
+
+
+def frame_from_measurement(mask, g_cam):
+    """Embed a per-masked-pixel measurement vector into a full 2-D frame."""
+    frame = np.zeros(mask.shape)
+    frame.ravel()[np.nonzero(mask.ravel())[0]] = g_cam
+    return frame
+
+
+def write_world(
+    tmpdir,
+    *,
+    n_frames=4,
+    seed=0,
+    f_scale=None,
+    jitter_b=0.003,
+    rtm_name="with_reflections",
+    with_laplacian=False,
+):
+    """Write the full fixture world; returns (paths, H_global, f_true, times).
+
+    Measurements: g(t) = H @ (f_true * scale(t)) — each composite frame has a
+    known ground truth.
+    """
+    rng = np.random.default_rng(seed + 100)
+    H_a, H_b = make_rtm_matrices(seed)
+    H = np.concatenate([H_a, H_b], axis=0)
+    f_true = rng.uniform(0.5, 2.0, NVOXEL)
+
+    times_a = 0.1 + 0.1 * np.arange(n_frames)
+    times_b = times_a + jitter_b
+    scales = f_scale or (1.0 + 0.1 * np.arange(n_frames))
+
+    frames_a = np.stack([
+        frame_from_measurement(MASK_A, H_a @ (f_true * s)) for s in scales
+    ])
+    frames_b = np.stack([
+        frame_from_measurement(MASK_B, H_b @ (f_true * s)) for s in scales
+    ])
+
+    d = str(tmpdir)
+    paths = {
+        "rtm_a1": os.path.join(d, "rtm_a_seg1.h5"),
+        "rtm_a2": os.path.join(d, "rtm_a_seg2.h5"),
+        "rtm_b": os.path.join(d, "rtm_b.h5"),
+        "img_a": os.path.join(d, "img_a.h5"),
+        "img_b": os.path.join(d, "img_b.h5"),
+        "laplacian": os.path.join(d, "laplacian.h5"),
+        "output": os.path.join(d, "solution.h5"),
+    }
+
+    cells = np.arange(NVOXEL, dtype=np.int64)
+    # camera A: two segments (voxels 0-7 dense, 8-15 sparse)
+    _write_rtm_file(paths["rtm_a1"], CAM_A, MASK_A, H_a[:, :8],
+                    cells[:8], cells[:8], sparse=False, rtm_name=rtm_name)
+    _write_rtm_file(paths["rtm_a2"], CAM_A, MASK_A, H_a[:, 8:],
+                    cells[8:], cells[:8], sparse=True, rtm_name=rtm_name)
+    # camera B: one dense file covering all voxels
+    _write_rtm_file(paths["rtm_b"], CAM_B, MASK_B, H_b,
+                    cells, cells, sparse=False, rtm_name=rtm_name)
+
+    _write_image_file(paths["img_a"], CAM_A, frames_a, times_a)
+    _write_image_file(paths["img_b"], CAM_B, frames_b, times_b)
+
+    if with_laplacian:
+        write_laplacian_file(paths["laplacian"])
+
+    return paths, H, f_true, times_a, np.asarray(scales)

@@ -1,0 +1,112 @@
+"""End-to-end CLI integration: synthetic multi-camera fixtures -> sartsolve
+-> solution file contents (SURVEY §4.4)."""
+
+import numpy as np
+import h5py
+import pytest
+
+from sartsolver_tpu.cli import main
+
+import fixtures as fx
+
+
+@pytest.fixture
+def world(tmp_path):
+    return fx.write_world(tmp_path, with_laplacian=True)
+
+
+def run_cli(paths, *extra):
+    args = [
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu",  # fp64 parity profile on the CPU backend
+        "-m", "300", "-c", "1e-6",
+        *extra,
+    ]
+    return main(args)
+
+
+def test_end_to_end_reconstruction(world, capsys):
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths) == 0
+
+    out = capsys.readouterr().out
+    assert out.count("Processed in:") == len(times)
+
+    with h5py.File(paths["output"], "r") as f:
+        value = f["solution/value"][:]
+        status = f["solution/status"][:]
+        t = f["solution/time"][:]
+        assert value.shape == (len(times), fx.NVOXEL)
+        assert set(f["solution"]) >= {"value", "time", "status",
+                                      f"time_{fx.CAM_A}", f"time_{fx.CAM_B}"}
+        # voxel map round-trip (main.cpp:143)
+        assert "voxel_map" in f
+        assert f["voxel_map/value"].shape[0] == fx.NVOXEL
+
+    # reconstructions reproduce the measurements
+    for i, s in enumerate(scales):
+        fitted = H @ value[i]
+        np.testing.assert_allclose(fitted, H @ (f_true * s), rtol=0.05)
+    np.testing.assert_allclose(t, times, atol=0.05)
+    assert (status == 0).all()
+
+
+def test_no_guess_flag(world):
+    paths, *_ = world
+    assert run_cli(paths, "--no_guess") == 0
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/value"].shape[0] > 0
+
+
+def test_logarithmic_mode(world):
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths, "-L") == 0
+    with h5py.File(paths["output"], "r") as f:
+        value = f["solution/value"][:]
+    fitted = H @ value[0]
+    np.testing.assert_allclose(fitted, H @ (f_true * scales[0]), rtol=0.05)
+
+
+def test_laplacian_flag(world):
+    paths, *_ = world
+    assert run_cli(paths, "-l", paths["laplacian"], "-b", "0.001") == 0
+
+
+def test_time_range_flag(world):
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths, "-t", "0.15:0.35") == 0
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/value"].shape[0] == 2
+
+
+def test_pixel_shards_flag(world):
+    """Sharded run (4 virtual CPU devices) produces the same solutions."""
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths) == 0
+    with h5py.File(paths["output"], "r") as f:
+        ref = f["solution/value"][:]
+    assert run_cli(paths, "--pixel_shards", "4") == 0
+    with h5py.File(paths["output"], "r") as f:
+        sharded = f["solution/value"][:]
+    np.testing.assert_allclose(sharded, ref, rtol=1e-8, atol=1e-10)
+
+
+def test_invalid_args_exit_1(world, capsys):
+    paths, *_ = world
+    with pytest.raises(SystemExit):
+        main(["-R", "2.0", paths["rtm_b"], paths["img_b"]])
+    with pytest.raises(SystemExit):
+        main(["-m", "0", paths["rtm_b"], paths["img_b"]])
+    with pytest.raises(SystemExit):
+        main([paths["rtm_b"]])  # fewer than two inputs
+
+
+def test_bad_input_file_returns_1(world, tmp_path, capsys):
+    paths, *_ = world
+    bad = str(tmp_path / "bad.h5")
+    with h5py.File(bad, "w") as f:
+        f.create_group("mystery")
+    assert main([bad, paths["img_a"]]) == 1
+    assert "neither an RTM" in capsys.readouterr().err

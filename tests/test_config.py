@@ -1,0 +1,88 @@
+"""Time-interval grammar + parameter validation vs arguments.cpp semantics."""
+
+import math
+
+import pytest
+
+from sartsolver_tpu.config import SolverOptions, parse_time_intervals
+
+
+class TestParseTimeIntervals:
+    def test_empty_means_all_times(self):
+        assert parse_time_intervals("") == [(0.0, math.inf, 0.0, 0.0)]
+
+    def test_single_interval(self):
+        assert parse_time_intervals("20.5:40.1") == [(20.5, 40.1, 0.0, 0.0)]
+
+    def test_multi_interval_with_step_and_threshold(self):
+        # Shape of the reference's docstring example (arguments.cpp:92) with a
+        # step that passes its own validation — the literal example
+        # "45.2:51:15:0.05" violates arguments.cpp:60 (step > interval), a
+        # reference doc defect we keep rejecting.
+        out = parse_time_intervals("20.5:40.1, 45.2:51:1.5:0.05")
+        assert out == [(20.5, 40.1, 0.0, 0.0), (45.2, 51.0, 1.5, 0.05)]
+        with pytest.raises(ValueError):
+            parse_time_intervals("45.2:51:15:0.05")
+
+    def test_trailing_comma_allowed(self):
+        assert parse_time_intervals("1:2,") == [(1.0, 2.0, 0.0, 0.0)]
+
+    def test_step_only(self):
+        assert parse_time_intervals("0:10:2") == [(0.0, 10.0, 2.0, 0.0)]
+
+    @pytest.mark.parametrize(
+        "bad",
+        [
+            "5",  # fewer than 2 fields
+            "1:2:3:4:5",  # more than 4 fields
+            "-1:2",  # negative start
+            "3:2",  # stop <= start
+            "2:2",  # stop <= start
+            "0:10:11",  # step > interval
+            "0:10:2:3",  # threshold > step
+            "a:b",  # non-numeric
+        ],
+    )
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_time_intervals(bad)
+
+
+class TestSolverOptions:
+    def test_defaults_match_reference_cli(self):
+        o = SolverOptions()
+        assert o.ray_density_threshold == 1.0e-6
+        assert o.ray_length_threshold == 1.0e-6
+        assert o.max_iterations == 2000
+        assert o.conv_tolerance == 1.0e-5
+        assert o.beta_laplace == 2.0e-2
+        assert o.relaxation == 1.0
+        assert not o.logarithmic
+
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {"ray_density_threshold": -1},
+            {"ray_length_threshold": -0.5},
+            {"conv_tolerance": 0},
+            {"beta_laplace": -1e-3},
+            {"relaxation": 0},
+            {"relaxation": 1.5},
+            {"max_iterations": 0},
+            {"dtype": "int8"},
+        ],
+    )
+    def test_validation(self, kw):
+        with pytest.raises(ValueError):
+            SolverOptions(**kw)
+
+    def test_cpu_parity_profile(self):
+        o = SolverOptions.cpu_parity()
+        assert o.dtype == "float64" and not o.normalize
+        assert o.guess_floor == 0.0 and not o.mask_negative_guess
+        olog = SolverOptions.cpu_parity(logarithmic=True)
+        # 1e-30, not the reference's 1e-100: emulated f64 has fp32 range.
+        assert olog.guess_floor == 1.0e-30 and olog.log_epsilon == 1.0e-30
+
+    def test_hashable_for_jit_static(self):
+        assert hash(SolverOptions()) == hash(SolverOptions())

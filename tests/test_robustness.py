@@ -1,0 +1,79 @@
+"""Regression tests for edge cases found in review: degenerate inputs must
+fail fast (or degrade gracefully), never traceback."""
+
+import numpy as np
+import h5py
+import pytest
+
+from sartsolver_tpu.config import SolverOptions, parse_time_intervals
+from sartsolver_tpu.models.sart import make_problem, solve
+from sartsolver_tpu.io.image import CompositeImage
+from sartsolver_tpu.cli import main
+
+from test_sart_core import make_case
+import fixtures as fx
+
+
+def test_all_zero_frame_does_not_crash():
+    """Dark frame (all zeros): norm guard must avoid 0/0 (the reference
+    NaNs such a frame; we degrade to a zero solution)."""
+    H, _, _ = make_case(seed=21)
+    g = np.zeros(H.shape[0])
+    opts = SolverOptions(max_iterations=3, conv_tolerance=1e-6)
+    res = solve(make_problem(H, opts=opts), g, opts=opts)
+    assert np.isfinite(np.asarray(res.solution)).all()
+
+
+def test_all_negative_frame_does_not_crash():
+    H, _, _ = make_case(seed=22)
+    g = np.full(H.shape[0], -1.0)
+    opts = SolverOptions(max_iterations=3, conv_tolerance=1e-6)
+    res = solve(make_problem(H, opts=opts), g, opts=opts)
+    assert np.isfinite(np.asarray(res.solution)).all()
+
+
+def test_log_warm_start_with_zeros_is_floored():
+    """Log path must floor a warm start containing exact zeros — otherwise
+    log(0) = -inf poisons the Laplacian penalty and zero voxels can never
+    recover multiplicatively (reference floors unconditionally,
+    sartsolver.cpp:263)."""
+    H, g, _ = make_case(seed=23, neg_pixels=0)
+    f0 = np.zeros(H.shape[1])  # e.g. clamped linear solution
+    opts = SolverOptions(logarithmic=True, guess_floor=0.0,
+                         max_iterations=5, conv_tolerance=1e-12)
+    res = solve(make_problem(H, opts=opts), g, f0=f0, opts=opts)
+    sol = np.asarray(res.solution)
+    assert np.isfinite(sol).all()
+    assert (sol > 0).any()
+
+
+def test_degenerate_timelines_fail_fast(tmp_path):
+    """Single-frame cameras at different times: no step can be derived;
+    must raise a clean error, not ZeroDivisionError."""
+    paths, *_ = fx.write_world(tmp_path, n_frames=1, jitter_b=0.05)
+    from sartsolver_tpu.io import hdf5files as hf
+    m, i = hf.categorize_input_files(
+        [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+         paths["img_a"], paths["img_b"]])
+    sm, si = hf.sort_rtm_files(m), hf.sort_image_files(i)
+    masks = hf.read_rtm_frame_masks(sm)
+    with pytest.raises(ValueError, match="time step"):
+        CompositeImage(si, masks, [(0.0, 10.0, 0.0, 0.0)], fx.NPIXEL, 0)
+
+
+def test_empty_middle_time_segment_rejected():
+    with pytest.raises(ValueError, match="Unable to recognize"):
+        parse_time_intervals("20:30,,40:50")
+    # trailing comma still fine
+    assert len(parse_time_intervals("20:30,")) == 1
+
+
+def test_cli_missing_attr_exits_1(tmp_path, capsys):
+    """Openable HDF5 file with a missing attribute: message + exit 1, not a
+    KeyError traceback."""
+    paths, *_ = fx.write_world(tmp_path)
+    with h5py.File(paths["rtm_b"], "r+") as f:
+        del f["rtm"].attrs["camera_name"]
+    rc = main([paths["rtm_b"], paths["img_b"]])
+    assert rc == 1
+    assert "Missing dataset or attribute" in capsys.readouterr().err

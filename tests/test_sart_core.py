@@ -1,0 +1,154 @@
+"""Numerical tests: jitted solver vs the independent NumPy fp64 oracle.
+
+Mirrors the reference's implicit dual-backend oracle strategy (its fp64 CPU
+solver validates its fp32 CUDA solver on identical inputs)."""
+
+import numpy as np
+import pytest
+
+from sartsolver_tpu.config import MAX_ITERATIONS_EXCEEDED, SUCCESS, SolverOptions
+from sartsolver_tpu.models.oracle import solve_oracle
+from sartsolver_tpu.models.sart import make_problem, solve
+from sartsolver_tpu.ops.laplacian import make_laplacian
+
+
+def make_case(seed=0, P=48, V=32, neg_pixels=3, zero_voxels=2, zero_pixels=2, noise=0.01):
+    """Random dense RTM with masked voxels/pixels and saturated detectors."""
+    rng = np.random.default_rng(seed)
+    H = rng.uniform(0.0, 1.0, (P, V))
+    H[:, rng.choice(V, zero_voxels, replace=False)] = 0.0  # dead voxels
+    H[rng.choice(P, zero_pixels, replace=False), :] = 0.0  # dead pixels
+    f_true = rng.uniform(0.5, 2.0, V)
+    g = H @ f_true + noise * rng.standard_normal(P)
+    g = np.abs(g)
+    g[rng.choice(P, neg_pixels, replace=False)] = -1.0  # saturated
+    return H, g, f_true
+
+
+def laplacian_1d_chain(V, scale=1.0):
+    """Simple second-difference chain over voxel index as COO triplets."""
+    rows, cols, vals = [], [], []
+    for i in range(V):
+        rows.append(i); cols.append(i); vals.append(2.0 * scale)
+        if i > 0:
+            rows.append(i); cols.append(i - 1); vals.append(-1.0 * scale)
+        if i < V - 1:
+            rows.append(i); cols.append(i + 1); vals.append(-1.0 * scale)
+    return np.array(rows), np.array(cols), np.array(vals)
+
+
+@pytest.mark.parametrize("logarithmic", [False, True])
+@pytest.mark.parametrize("with_laplacian", [False, True])
+def test_fp64_parity_with_oracle(logarithmic, with_laplacian):
+    """fp64 device path must match the fp64 oracle to near machine precision."""
+    H, g, _ = make_case(seed=1)
+    lap_np = laplacian_1d_chain(H.shape[1], 0.1) if with_laplacian else None
+
+    opts = SolverOptions.cpu_parity(
+        logarithmic=logarithmic, max_iterations=40, conv_tolerance=1e-12
+    )
+    lap = make_laplacian(*lap_np, dtype="float64") if lap_np else None
+    problem = make_problem(H, lap, opts=opts)
+    res = solve(problem, g, opts=opts)
+
+    # log_epsilon matched to the device profile: the reference's 1e-100 is
+    # below emulated-f64 range, and with a Laplacian the floored voxels'
+    # log(f) couples into neighbors, so the value must agree on both sides.
+    f_ref, status_ref, iters_ref, _ = solve_oracle(
+        H, g, lap_np, logarithmic=logarithmic,
+        max_iterations=40, conv_tolerance=1e-12, log_epsilon=opts.log_epsilon,
+    )
+    np.testing.assert_allclose(np.asarray(res.solution), f_ref, rtol=1e-9, atol=1e-12)
+    assert int(res.status) == status_ref
+    assert int(res.iterations) == iters_ref
+
+
+@pytest.mark.parametrize("logarithmic", [False, True])
+def test_fp32_device_path_tracks_oracle(logarithmic):
+    """fp32 normalized path (CUDA-equivalent) stays close to the fp64 oracle."""
+    H, g, _ = make_case(seed=2)
+    opts = SolverOptions(
+        logarithmic=logarithmic, max_iterations=15, conv_tolerance=1e-12,
+        # align guess semantics with the CPU oracle; normalization itself is
+        # mathematically transparent
+        mask_negative_guess=False, guess_floor=0.0 if not logarithmic else 1e-30,
+        log_epsilon=1e-30,
+    )
+    problem = make_problem(H, opts=opts)
+    res = solve(problem, g, opts=opts)
+
+    f_ref, _, _, _ = solve_oracle(
+        H, g, logarithmic=logarithmic, max_iterations=15, conv_tolerance=1e-12
+    )
+    np.testing.assert_allclose(np.asarray(res.solution), f_ref, rtol=5e-3, atol=5e-4)
+
+
+def test_convergence_status_success():
+    H, g, f_true = make_case(seed=3, noise=0.0, neg_pixels=0)
+    opts = SolverOptions.cpu_parity(max_iterations=2000, conv_tolerance=1e-7)
+    problem = make_problem(H, opts=opts)
+    res = solve(problem, g, opts=opts)
+    assert int(res.status) == SUCCESS
+    assert int(res.iterations) < 2000
+    fitted = H @ np.asarray(res.solution)
+    # reconstruction reproduces the measurement on unmasked pixels
+    mask = (H.sum(axis=1) > 1e-6) & (g >= 0)
+    np.testing.assert_allclose(fitted[mask], g[mask], rtol=0.05, atol=0.05)
+
+
+def test_max_iterations_exceeded_status():
+    H, g, _ = make_case(seed=4)
+    opts = SolverOptions.cpu_parity(max_iterations=3, conv_tolerance=1e-15)
+    problem = make_problem(H, opts=opts)
+    res = solve(problem, g, opts=opts)
+    assert int(res.status) == MAX_ITERATIONS_EXCEEDED
+    assert int(res.iterations) == 3
+
+
+def test_warm_start_matches_oracle():
+    H, g, _ = make_case(seed=5)
+    f0 = np.full(H.shape[1], 0.7)
+    opts = SolverOptions.cpu_parity(max_iterations=20, conv_tolerance=1e-12)
+    problem = make_problem(H, opts=opts)
+    res = solve(problem, g, f0=f0, opts=opts)
+    f_ref, _, _, _ = solve_oracle(H, g, f0=f0, max_iterations=20, conv_tolerance=1e-12)
+    np.testing.assert_allclose(np.asarray(res.solution), f_ref, rtol=1e-9)
+
+
+def test_masked_voxels_stay_zero_linear():
+    H, g, _ = make_case(seed=6, zero_voxels=4)
+    opts = SolverOptions.cpu_parity(max_iterations=10, conv_tolerance=1e-12)
+    problem = make_problem(H, opts=opts)
+    res = solve(problem, g, opts=opts)
+    dead = H.sum(axis=0) <= opts.ray_density_threshold
+    assert dead.any()
+    np.testing.assert_array_equal(np.asarray(res.solution)[dead], 0.0)
+
+
+def test_saturated_pixels_excluded():
+    """A saturated (negative) measurement must not influence the solution."""
+    H, g, _ = make_case(seed=7, neg_pixels=0)
+    opts = SolverOptions.cpu_parity(max_iterations=10, conv_tolerance=1e-12)
+    g_sat = g.copy()
+    g_sat[5] = -1.0  # saturate one detector
+    H_dropped = np.delete(H, 5, axis=0)
+    g_dropped = np.delete(g_sat, 5)
+
+    res_sat = solve(make_problem(H, opts=opts), g_sat, opts=opts)
+    res_drop = solve(make_problem(H_dropped, opts=opts), g_dropped, opts=opts)
+    # ray_length/ray_density differ (they include row 5), so compare against
+    # the oracle on identical inputs instead of exact equality.
+    f_ref, _, _, _ = solve_oracle(H, g_sat, max_iterations=10, conv_tolerance=1e-12)
+    np.testing.assert_allclose(np.asarray(res_sat.solution), f_ref, rtol=1e-9)
+    # and the saturated pixel's removal only matters through ray stats:
+    assert np.isfinite(np.asarray(res_drop.solution)).all()
+
+
+def test_guess_floor_applied_on_device_profile():
+    """CUDA path floors any starting solution at 1e-7 incl. masked voxels
+    (sartsolver_cuda.cpp:180)."""
+    H, g, _ = make_case(seed=8, zero_voxels=3)
+    opts = SolverOptions(max_iterations=1, conv_tolerance=1e-12)
+    problem = make_problem(H, opts=opts)
+    res = solve(problem, g, opts=opts)
+    assert np.isfinite(np.asarray(res.solution)).all()
