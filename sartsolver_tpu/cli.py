@@ -110,6 +110,8 @@ def _validate(args) -> None:
     if len(args.input_files) < 2:
         fail("At least two input file, one with RTM and one with image, are "
              f"required, {len(args.input_files)} given.")
+    if args.pixel_shards is not None and args.pixel_shards < 1:
+        fail(f"Argument pixel_shards must be >= 1, {args.pixel_shards} given.")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -188,7 +190,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         rtm = read_rtm_block(sorted_matrix_files, rtm_name, npixel, nvoxel, 0)
 
-        n_shards = args.pixel_shards or len(devices)
+        n_shards = args.pixel_shards if args.pixel_shards is not None else len(devices)
         mesh = make_mesh(n_shards, 1, devices=devices[:n_shards])
         solver = DistributedSARTSolver(rtm, lap, opts=opts, mesh=mesh)
 

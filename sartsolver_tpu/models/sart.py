@@ -218,6 +218,34 @@ def solve_normalized(
     return SolveResult(f, status, it, conv)
 
 
+def prepare_measurement(measurement, opts: SolverOptions):
+    """Host-side pre-step shared by the single-device and sharded drivers —
+    the reference's ``pre_iteration_setup`` (sartsolver_cuda.cpp:138-194).
+
+    Returns ``(g64_normalized, msq, norm)`` with everything computed in fp64:
+
+    - ``norm``: global max of the measurement (fp32-overflow guard,
+      sartsolver_cuda.cpp:146-150); 1.0 when normalization is off or the
+      frame is fully dark/saturated (max <= 0).
+    - ``msq``: normalized ``||g||^2`` with non-positive measurements
+      excluded (sartsolver.cpp:161-164). A fully dark frame gives
+      ``msq == 0``, which would make the convergence metric 0/0 and spin
+      max_iterations; it is remapped to 1.0 so the metric degrades to
+      ``-||Hf||^2`` and the stall test still terminates.
+    """
+    g64 = np.asarray(measurement, dtype=np.float64)
+    if opts.normalize:
+        norm = float(np.max(g64, initial=0.0))
+        if norm <= 0:
+            norm = 1.0
+    else:
+        norm = 1.0
+    msq = float(np.sum(np.where(g64 > 0, g64, 0.0) ** 2)) / (norm * norm)
+    if msq <= 0:
+        msq = 1.0
+    return g64 / norm, msq, norm
+
+
 def solve(
     problem: SARTProblem,
     measurement,
@@ -225,26 +253,12 @@ def solve(
     *,
     opts: SolverOptions,
 ) -> SolveResult:
-    """Single-device solve on a full (unsharded) problem.
-
-    Host-side pre-step mirrors the reference's ``pre_iteration_setup``
-    (sartsolver_cuda.cpp:138-194): the norm and ``||g||^2`` are computed in
-    fp64 on host, the measurement is normalized, and the result is
-    denormalized on the way out. The sharded equivalent lives in
-    ``sartsolver_tpu.parallel.sharded``.
-    """
+    """Single-device solve on a full (unsharded) problem. The sharded
+    equivalent lives in ``sartsolver_tpu.parallel.sharded``."""
     dtype = jnp.dtype(opts.dtype)
-    g64 = np.asarray(measurement, dtype=np.float64)
+    g64, msq, norm = prepare_measurement(measurement, opts)
 
-    if opts.normalize:
-        norm = float(np.max(g64))
-        if norm <= 0:
-            norm = 1.0  # fully dark/saturated frame: nothing to normalize by
-    else:
-        norm = 1.0
-    msq = float(np.sum(np.where(g64 > 0, g64, 0.0) ** 2)) / (norm * norm)
-
-    g = jnp.asarray(g64 / norm, dtype)
+    g = jnp.asarray(g64, dtype)
     use_guess = f0 is None
     if use_guess:
         f0 = jnp.zeros((problem.rtm.shape[1],), dtype)

@@ -34,14 +34,16 @@ from sartsolver_tpu.models.sart import (
     SARTProblem,
     SolveResult,
     compute_ray_stats,
+    prepare_measurement,
     solve_normalized,
 )
 from sartsolver_tpu.ops.laplacian import LaplacianCOO
 from sartsolver_tpu.parallel.mesh import (
     PIXEL_AXIS,
+    VOXEL_AXIS,
     make_mesh,
     pad_measurement,
-    pad_pixel_axis,
+    padded_size,
 )
 
 
@@ -61,15 +63,27 @@ class DistributedSARTSolver:
         self.opts = opts
         self.mesh = mesh if mesh is not None else make_mesh()
         self.n_pixel_shards = self.mesh.shape[PIXEL_AXIS]
+        if self.mesh.shape.get(VOXEL_AXIS, 1) != 1:
+            raise NotImplementedError(
+                "Voxel-axis (column) sharding is not wired into the solver "
+                "yet; use a ('pixels',)-only mesh."
+            )
         self.npixel, self.nvoxel = rtm.shape
 
         dtype = jnp.dtype(opts.dtype)
         rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
 
-        rtm_padded = pad_pixel_axis(np.asarray(rtm), self.n_pixel_shards)
+        # Single-copy staging: the RTM is the dominant host allocation (the
+        # reference targets tens-to-hundreds of GB), so pad+cast in one
+        # buffer, and skip the copy entirely when layout already matches.
+        rtm_np = np.asarray(rtm)
+        target_rows = padded_size(self.npixel, self.n_pixel_shards)
+        if target_rows != self.npixel or rtm_np.dtype != np.dtype(rtm_dtype):
+            buf = np.zeros((target_rows, self.nvoxel), dtype=np.dtype(rtm_dtype))
+            buf[: self.npixel] = rtm_np
+            rtm_np = buf
         rtm_dev = jax.device_put(
-            rtm_padded.astype(rtm_dtype),
-            NamedSharding(self.mesh, P(PIXEL_AXIS, None)),
+            rtm_np, NamedSharding(self.mesh, P(PIXEL_AXIS, None))
         )
 
         stats_fn = jax.jit(
@@ -116,22 +130,18 @@ class DistributedSARTSolver:
         return self._solve_fns[use_guess]
 
     def solve(self, measurement, f0=None) -> SolveResult:
-        """Solve one frame; host-side normalization mirrors
-        ``pre_iteration_setup`` (sartsolver_cuda.cpp:138-194)."""
+        """Solve one frame; host pre-step shared with the single-device
+        driver (``models.sart.prepare_measurement``)."""
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
-        g64 = np.asarray(measurement, np.float64)
-        if g64.shape[0] != self.npixel:
+        if np.shape(measurement)[0] != self.npixel:
             raise ValueError(
-                f"Measurement has {g64.shape[0]} pixels, expected {self.npixel}."
+                f"Measurement has {np.shape(measurement)[0]} pixels, "
+                f"expected {self.npixel}."
             )
+        g64, msq, norm = prepare_measurement(measurement, opts)
 
-        norm = float(np.max(g64)) if opts.normalize else 1.0
-        if norm <= 0:
-            norm = 1.0  # fully dark/saturated frame: nothing to normalize by
-        msq = float(np.sum(np.where(g64 > 0, g64, 0.0) ** 2)) / (norm * norm)
-
-        g_padded = pad_measurement(g64 / norm, self.n_pixel_shards)
+        g_padded = pad_measurement(g64, self.n_pixel_shards)
         g_dev = jax.device_put(
             g_padded.astype(dtype), NamedSharding(self.mesh, P(PIXEL_AXIS))
         )
