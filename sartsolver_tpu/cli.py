@@ -205,19 +205,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             jax.profiler.trace(args.profile_dir) if args.profile_dir
             else contextlib.nullcontext()
         )
+        from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
         with profiler_ctx, SolutionWriter(
             args.output_file, camera_names, nvoxel,
             max_cache_size=args.max_cached_solutions,
-        ) as writer:
+        ) as writer, FramePrefetcher(composite_image) as frames:
             warm: Optional[np.ndarray] = None
-            while (frame := composite_image.next_frame()) is not None:
+            for frame, ftime, cam_times in frames:
                 t0 = _time.perf_counter()
                 result = solver.solve(frame, f0=warm)
-                writer.add(
-                    result.solution, result.status,
-                    composite_image.frame_time(),
-                    composite_image.camera_frame_time(),
-                )
+                writer.add(result.solution, result.status, ftime, cam_times)
                 elapsed_ms = (_time.perf_counter() - t0) * 1e3
                 print(f"Processed in: {elapsed_ms} ms")
                 warm = None if args.no_guess else result.solution

@@ -208,11 +208,13 @@ class CompositeImage:
         cache_size_t = min(self.max_cache_size, len(self.time) - itime)
         cached = np.zeros((cache_size_t, self.npix))
 
+        from sartsolver_tpu.native import masked_compact
+
         start_pixel = 0
         for icam, (camera, mask) in enumerate(self.rtm_frame_masks.items()):
             npixel_masked = int(np.sum(mask != 0))
             if self.offset_pix < start_pixel + npixel_masked:
-                mask_bool = mask != 0
+                mask_indices = np.nonzero(mask != 0)[0].astype(np.int64)
                 ipix_begin = max(self.offset_pix - start_pixel, 0)
                 ipix_end = (
                     npixel_masked
@@ -222,14 +224,15 @@ class CompositeImage:
                 pix_offset = (
                     0 if self.offset_pix > start_pixel else start_pixel - self.offset_pix
                 )
+                # this block's slice of this camera's masked pixels
+                slice_indices = mask_indices[ipix_begin:ipix_end]
                 with h5py.File(self.files[camera], "r") as f:
                     dset = f["image/frame"]
                     for it in range(cache_size_t):
                         frame_idx = self.frame_indices[itime + it][icam]
                         full = np.asarray(dset[frame_idx], np.float64).ravel()
-                        masked = full[mask_bool]
-                        cached[it, pix_offset:pix_offset + (ipix_end - ipix_begin)] = (
-                            masked[ipix_begin:ipix_end]
+                        cached[it, pix_offset:pix_offset + len(slice_indices)] = (
+                            masked_compact(full, slice_indices)
                         )
             start_pixel += npixel_masked
             if self.offset_pix + self.npix < start_pixel:

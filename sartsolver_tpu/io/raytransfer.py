@@ -34,8 +34,11 @@ def read_rtm_block(
 ) -> np.ndarray:
     """Read rows [offset_pixel, offset_pixel + npixel_local) of the global RTM.
 
-    ``scatter_coo(mat, rows, cols, vals)`` may be supplied to accelerate the
-    sparse scatter (the native C++ helper); defaults to NumPy fancy indexing.
+    ``scatter_coo(mat, rows, cols, vals)`` may be supplied to override the
+    sparse scatter; by default the native C++ helper is used when the
+    toolchain can build it (first use may compile it), with a NumPy fallback
+    otherwise. Triplets are bounds-checked here either way — the native
+    store loop is unchecked by design.
     """
     if npixel_local <= 0 or nvoxel <= 0:
         raise ValueError("To read a ray-transfer block, its size must be non-zero.")
@@ -65,10 +68,14 @@ def read_rtm_block(
                         rows = pixel_index[sel] - offset_pixel
                         cols = voxel_index[sel]
                         vals = value[sel]
-                        if scatter_coo is not None:
-                            scatter_coo(mat, rows, cols, vals)
-                        else:
-                            mat[rows, cols] = vals
+                        if cols.size and (int(cols.max()) >= nvoxel or int(cols.min()) < 0):
+                            raise ValueError(
+                                f"Sparse RTM segment {filename} has voxel "
+                                f"indices outside [0, {nvoxel})."
+                            )
+                        if scatter_coo is None:
+                            from sartsolver_tpu.native import scatter_coo
+                        scatter_coo(mat, rows, cols, vals)
                     else:
                         dset = group["value"]
                         # rows of this camera's matrix that fall in our block

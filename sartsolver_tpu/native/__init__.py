@@ -1,0 +1,95 @@
+"""ctypes bindings for the native host runtime (libsartrt).
+
+Builds the shared object on first use with the system C++ toolchain and
+caches it next to the source; every entry point has a NumPy fallback, so the
+package degrades gracefully where no compiler exists.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "sartrt.cpp")
+_SO = os.path.join(_HERE, "libsartrt.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+_i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+_f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def _build() -> Optional[str]:
+    if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None when unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.sart_native_abi_version.restype = ctypes.c_int
+        if lib.sart_native_abi_version() != 1:
+            _build_failed = True
+            return None
+        lib.sart_masked_compact_f64.argtypes = [
+            _f64p, _i64p, ctypes.c_int64, _f64p]
+        lib.sart_scatter_coo_f32.argtypes = [
+            _f32p, ctypes.c_int64, _i64p, _i64p, _f32p, ctypes.c_int64]
+        _lib = lib
+        return _lib
+
+
+# -- high-level wrappers (native when available, NumPy otherwise) ----------
+
+def masked_compact(full: np.ndarray, mask_indices: np.ndarray) -> np.ndarray:
+    """Gather frame values at masked positions (image.cpp:307-315)."""
+    full = np.ascontiguousarray(full, np.float64).ravel()
+    idx = np.ascontiguousarray(mask_indices, np.int64)
+    lib = get_lib()
+    out = np.empty(idx.shape[0], np.float64)
+    if lib is not None:
+        lib.sart_masked_compact_f64(full, idx, idx.shape[0], out)
+    else:
+        out[:] = full[idx]
+    return out
+
+
+def scatter_coo(mat: np.ndarray, rows: np.ndarray, cols: np.ndarray,
+                vals: np.ndarray) -> None:
+    """In-place dense scatter of filtered COO triplets (raytransfer.cpp:85-89)."""
+    if mat.dtype == np.float32 and mat.flags.c_contiguous:
+        lib = get_lib()
+        if lib is not None:
+            lib.sart_scatter_coo_f32(
+                mat.reshape(-1), mat.shape[1],
+                np.ascontiguousarray(rows, np.int64),
+                np.ascontiguousarray(cols, np.int64),
+                np.ascontiguousarray(vals, np.float32),
+                len(vals),
+            )
+            return
+    mat[rows, cols] = vals

@@ -1,0 +1,47 @@
+// Native host runtime for sartsolver_tpu.
+//
+// The reference implements its entire host pipeline in C++ (frame-mask
+// compaction in CompositeImage::cache_hdf5, image.cpp:307-315; sparse
+// COO->dense scatter in RayTransferMatrix::read_hdf5, raytransfer.cpp:85-89).
+// These are the per-frame / per-segment hot loops of ingest; this library
+// provides the same operations as a small C ABI consumed via ctypes, with a
+// NumPy fallback on the Python side when the shared object is unavailable.
+//
+// Design notes (deliberately different from the reference):
+// - compaction takes a precomputed index list (mask positions) instead of
+//   rescanning the boolean mask per frame: O(masked) instead of O(H*W),
+//   and the index list is computed once per camera, not once per frame.
+// - the scatter takes already-filtered/offset triplets; filtering happens
+//   where the file metadata lives (Python), the tight store loop here.
+
+#include <cstdint>
+
+extern "C" {
+
+// out[i] = full[mask_indices[i]] for i in [0, n_masked) — one camera frame.
+void sart_masked_compact_f64(const double* full,
+                             const int64_t* mask_indices,
+                             int64_t n_masked,
+                             double* out) {
+    for (int64_t i = 0; i < n_masked; ++i) {
+        out[i] = full[mask_indices[i]];
+    }
+}
+
+// mat[rows[i] * nvoxel + cols[i]] = vals[i] — dense row-block scatter of a
+// sparse RTM segment. Rows are block-local, cols global. The store loop is
+// unchecked; callers validate index ranges (io/raytransfer.py does).
+void sart_scatter_coo_f32(float* mat,
+                          int64_t nvoxel,
+                          const int64_t* rows,
+                          const int64_t* cols,
+                          const float* vals,
+                          int64_t nnz) {
+    for (int64_t i = 0; i < nnz; ++i) {
+        mat[rows[i] * nvoxel + cols[i]] = vals[i];
+    }
+}
+
+int sart_native_abi_version() { return 1; }
+
+}  // extern "C"

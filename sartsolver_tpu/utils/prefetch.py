@@ -1,0 +1,90 @@
+"""Frame prefetcher: overlap host HDF5 ingest with device compute.
+
+The reference's frame loop is strictly serial — read frame, solve, repeat
+(main.cpp:131-140); every frame pays its I/O latency in full. Here a
+background thread stays one-or-more frames ahead in the composite stream
+while the device solves, hiding ingest behind compute (h5py releases the
+GIL during reads). Depth is bounded so at most ``depth`` frames of host
+memory are in flight.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from sartsolver_tpu.io.image import CompositeImage
+
+
+class FramePrefetcher:
+    """Iterates ``(frame, time, camera_times)`` tuples ahead of the consumer.
+
+    Use as a context manager (or call :meth:`close`) when the iterator may be
+    abandoned early — e.g. the consumer raising mid-loop — so the worker
+    thread is released rather than left blocked on a full queue.
+    """
+
+    def __init__(self, composite: CompositeImage, depth: int = 2):
+        if depth < 1:
+            raise ValueError("Prefetch depth must be positive.")
+        self._composite = composite
+        self._queue: "queue.Queue[Optional[Tuple[np.ndarray, float, list]]]" = (
+            queue.Queue(maxsize=depth)
+        )
+        self._error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Bounded put that gives up once close() is requested."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _worker(self) -> None:
+        try:
+            while not self._stop.is_set():
+                frame = self._composite.next_frame()
+                if frame is None:
+                    break
+                item = (frame, self._composite.frame_time(),
+                        self._composite.camera_frame_time())
+                if not self._put(item):
+                    return
+        except BaseException as err:  # surfaced on the consumer side
+            self._error = err
+        finally:
+            self._put(None)
+
+    def close(self) -> None:
+        """Stop the worker and drop any queued frames."""
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FramePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, float, list]]:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                if self._error is not None:
+                    raise self._error
+                return
+            yield item

@@ -1,0 +1,123 @@
+"""Native runtime (libsartrt) vs NumPy fallback equivalence + prefetcher."""
+
+import numpy as np
+import pytest
+
+from sartsolver_tpu import native
+from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+import fixtures as fx
+
+
+def test_native_lib_builds():
+    lib = native.get_lib()
+    assert lib is not None, "g++ toolchain present but native build failed"
+    assert lib.sart_native_abi_version() == 1
+
+
+def test_masked_compact_matches_numpy():
+    rng = np.random.default_rng(0)
+    full = rng.uniform(size=300)
+    idx = np.sort(rng.choice(300, 120, replace=False)).astype(np.int64)
+    out = native.masked_compact(full, idx)
+    np.testing.assert_array_equal(out, full[idx])
+
+
+def test_masked_compact_empty():
+    out = native.masked_compact(np.zeros(10), np.empty(0, np.int64))
+    assert out.shape == (0,)
+
+
+def test_scatter_coo_matches_numpy():
+    rng = np.random.default_rng(1)
+    mat_native = np.zeros((40, 30), np.float32)
+    mat_np = np.zeros((40, 30), np.float32)
+    nnz = 200
+    rows = rng.integers(0, 40, nnz)
+    cols = rng.integers(0, 30, nnz)
+    vals = rng.uniform(size=nnz).astype(np.float32)
+    native.scatter_coo(mat_native, rows, cols, vals)
+    mat_np[rows, cols] = vals
+    np.testing.assert_array_equal(mat_native, mat_np)
+
+
+def test_scatter_coo_noncontiguous_falls_back():
+    mat = np.zeros((40, 60), np.float32)[:, ::2]  # non-contiguous view
+    rows = np.array([1, 2])
+    cols = np.array([3, 4])
+    vals = np.array([1.5, 2.5], np.float32)
+    native.scatter_coo(mat, rows, cols, vals)
+    assert mat[1, 3] == 1.5 and mat[2, 4] == 2.5
+
+
+def test_prefetcher_yields_all_frames_in_order(tmp_path):
+    paths, H, f_true, times, scales = fx.write_world(tmp_path)
+    from sartsolver_tpu.io import hdf5files as hf
+    from sartsolver_tpu.io.image import CompositeImage
+    m, i = hf.categorize_input_files(
+        [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+         paths["img_a"], paths["img_b"]])
+    sm, si = hf.sort_rtm_files(m), hf.sort_image_files(i)
+    masks = hf.read_rtm_frame_masks(sm)
+
+    ci = CompositeImage(si, masks, [(0.0, np.inf, 0.0, 0.0)], fx.NPIXEL, 0)
+    direct = []
+    while (fr := ci.next_frame()) is not None:
+        direct.append((fr, ci.frame_time()))
+
+    ci2 = CompositeImage(si, masks, [(0.0, np.inf, 0.0, 0.0)], fx.NPIXEL, 0)
+    fetched = list(FramePrefetcher(ci2, depth=2))
+    assert len(fetched) == len(direct)
+    for (f_direct, t_direct), (f_pre, t_pre, cam_t) in zip(direct, fetched):
+        np.testing.assert_array_equal(f_pre, f_direct)
+        assert t_pre == t_direct
+        assert len(cam_t) == 2
+
+
+def test_prefetcher_propagates_errors(tmp_path):
+    class Exploding:
+        def next_frame(self):
+            raise RuntimeError("boom")
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(FramePrefetcher(Exploding()))
+
+
+def test_prefetcher_depth_validation(tmp_path):
+    with pytest.raises(ValueError):
+        FramePrefetcher(None, depth=0)
+
+
+def test_prefetcher_early_close_releases_worker(tmp_path):
+    """Abandoning the iterator mid-stream must not leave the worker blocked."""
+    paths, *_ = fx.write_world(tmp_path, n_frames=4)
+    from sartsolver_tpu.io import hdf5files as hf
+    from sartsolver_tpu.io.image import CompositeImage
+    m, i = hf.categorize_input_files(
+        [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+         paths["img_a"], paths["img_b"]])
+    sm, si = hf.sort_rtm_files(m), hf.sort_image_files(i)
+    masks = hf.read_rtm_frame_masks(sm)
+    ci = CompositeImage(si, masks, [(0.0, np.inf, 0.0, 0.0)], fx.NPIXEL, 0)
+    pf = FramePrefetcher(ci, depth=1)
+    next(iter(pf))  # consume one frame, leave the rest queued
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_sparse_rtm_out_of_range_voxel_rejected(tmp_path):
+    """Malformed sparse voxel_index must fail cleanly, not corrupt memory."""
+    import h5py
+    from sartsolver_tpu.io import hdf5files as hf
+    from sartsolver_tpu.io.raytransfer import read_rtm_block
+    paths, *_ = fx.write_world(tmp_path)
+    with h5py.File(paths["rtm_a2"], "r+") as f:
+        vi = f["rtm/with_reflections/voxel_index"]
+        data = vi[:]
+        data[0] = 10_000  # far outside the global nvoxel
+        vi[...] = data
+    m, _ = hf.categorize_input_files(
+        [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"]])
+    sm = hf.sort_rtm_files(m)
+    with pytest.raises(ValueError, match="voxel"):
+        read_rtm_block(sm, "with_reflections", fx.NPIXEL, fx.NVOXEL, 0)
