@@ -76,6 +76,10 @@ def build_parser() -> argparse.ArgumentParser:
     tpu.add_argument("--pixel_shards", type=int, default=None,
                      help="Number of mesh shards along the pixel axis "
                           "(default: all visible devices).")
+    tpu.add_argument("--voxel_shards", type=int, default=1,
+                     help="Number of mesh shards along the voxel axis "
+                          "(column sharding; shrinks per-chip solution-state "
+                          "memory when nvoxel outgrows one chip).")
     tpu.add_argument("--rtm_dtype", default=None,
                      choices=["float32", "bfloat16", "float64"],
                      help="On-device RTM storage dtype (bfloat16 halves HBM "
@@ -112,6 +116,8 @@ def _validate(args) -> None:
              f"required, {len(args.input_files)} given.")
     if args.pixel_shards is not None and args.pixel_shards < 1:
         fail(f"Argument pixel_shards must be >= 1, {args.pixel_shards} given.")
+    if args.voxel_shards < 1:
+        fail(f"Argument voxel_shards must be >= 1, {args.voxel_shards} given.")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -190,8 +196,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         rtm = read_rtm_block(sorted_matrix_files, rtm_name, npixel, nvoxel, 0)
 
-        n_shards = args.pixel_shards if args.pixel_shards is not None else len(devices)
-        mesh = make_mesh(n_shards, 1, devices=devices[:n_shards])
+        n_vox = args.voxel_shards
+        if args.pixel_shards is not None:
+            n_pix = args.pixel_shards
+        else:
+            n_pix = max(len(devices) // n_vox, 1)
+        if n_pix * n_vox < len(devices) and args.pixel_shards is None:
+            print(
+                f"Warning: {len(devices)} devices visible but the "
+                f"{n_pix}x{n_vox} mesh uses only {n_pix * n_vox}; pick "
+                "--voxel_shards dividing the device count (or set "
+                "--pixel_shards) to use them all.",
+                file=sys.stderr,
+            )
+        mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
         solver = DistributedSARTSolver(rtm, lap, opts=opts, mesh=mesh)
 
         grid = make_voxel_grid(

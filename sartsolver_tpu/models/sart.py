@@ -77,14 +77,17 @@ def _tiny(value: float, dtype) -> Array:
     return jnp.asarray(value, dtype)
 
 
-def compute_ray_stats(rtm: Array, *, dtype, axis_name=None) -> Tuple[Array, Array]:
-    """Per-voxel ray density (global) and per-pixel ray length (local).
+def compute_ray_stats(
+    rtm: Array, *, dtype, axis_name=None, voxel_axis=None
+) -> Tuple[Array, Array]:
+    """Per-voxel ray density (global) and per-pixel ray length.
 
     Reference: sartsolver.cpp:38-56 — column sums allreduced over ranks, row
-    sums kept local.
+    sums kept local. Under a 2-D mesh the row sums additionally reduce over
+    the voxel (column-shard) axis.
     """
     dens = _psum(jnp.sum(rtm, axis=0, dtype=dtype), axis_name)
-    length = jnp.sum(rtm, axis=1, dtype=dtype)
+    length = _psum(jnp.sum(rtm, axis=1, dtype=dtype), voxel_axis)
     return dens, length.astype(dtype)
 
 
@@ -114,7 +117,7 @@ def _initial_guess(problem: SARTProblem, g: Array, opts: SolverOptions, axis_nam
 
 
 @functools.partial(
-    jax.jit, static_argnames=("opts", "axis_name", "use_guess")
+    jax.jit, static_argnames=("opts", "axis_name", "voxel_axis", "use_guess")
 )
 def solve_normalized(
     problem: SARTProblem,
@@ -124,20 +127,35 @@ def solve_normalized(
     *,
     opts: SolverOptions,
     axis_name=None,
+    voxel_axis=None,
     use_guess: bool,
 ) -> SolveResult:
     """Jit-compiled solver core on a pre-normalized measurement.
 
     ``g``/``f0`` are already divided by the global norm; ``msq`` is the
     normalized ``||g||^2`` with negative (saturated) measurements excluded
-    (sartsolver.cpp:161-164). When running under ``shard_map``, ``g``,
-    ``problem.rtm`` and ``problem.ray_length`` hold this device's pixel block
-    and ``axis_name`` names the pixel mesh axis.
+    (sartsolver.cpp:161-164).
+
+    Sharding: under ``shard_map``, ``axis_name`` names the pixel (row-block)
+    mesh axis — ``g``, ``problem.rtm`` and ``problem.ray_length`` hold this
+    device's pixel block. With ``voxel_axis`` additionally set (2-D mesh),
+    the RTM is also column-sharded: ``f0``/``ray_density`` and the returned
+    solution hold this device's voxel block, the Laplacian COO must have
+    block-local rows with global cols, and the forward projection reduces
+    over the voxel axis while the back-projection reduces over the pixel
+    axis. The replicated-solution memory footprint of the reference
+    (every rank holds all of f, sartsolver.hpp) drops to 1/n_voxel_shards.
     """
     dtype = jnp.dtype(opts.dtype)
     rtm = problem.rtm
-    nvoxel = rtm.shape[1]
+    nvoxel = rtm.shape[1]  # local voxel-block size under a 2-D mesh
     eps = _tiny(opts.log_epsilon, dtype)
+
+    def gather_voxels(x):
+        """Full voxel vector for ops that index globally (Laplacian cols)."""
+        if voxel_axis is None:
+            return x
+        return lax.all_gather(x, voxel_axis, tiled=True)
 
     vmask = problem.ray_density > opts.ray_density_threshold
     safe_dens = jnp.where(vmask, problem.ray_density, 1)
@@ -160,7 +178,7 @@ def solve_normalized(
         f0 = jnp.maximum(f0, _tiny(max(opts.guess_floor, opts.log_epsilon), dtype))
     f0 = f0.astype(dtype)
 
-    fitted0 = forward_project(rtm, f0, accum_dtype=dtype)
+    fitted0 = _psum(forward_project(rtm, f0, accum_dtype=dtype), voxel_axis)
 
     beta = jnp.asarray(opts.beta_laplace, dtype)
     tol = jnp.asarray(opts.conv_tolerance, dtype)
@@ -180,7 +198,9 @@ def solve_normalized(
         f, fitted, conv_prev, it, _ = carry
         if opts.logarithmic:
             # Multiplicative update (Eq. 3; sartsolver.cpp:287-316).
-            penalty = beta * coo_matvec(problem.laplacian, jnp.log(f), nvoxel)
+            penalty = beta * coo_matvec(
+                problem.laplacian, jnp.log(gather_voxels(f)), nvoxel
+            )
             fit = _psum(
                 back_project(rtm, jnp.where(meas_mask, fitted, 0) * inv_length, accum_dtype=dtype),
                 axis_name,
@@ -191,12 +211,12 @@ def solve_normalized(
         else:
             # Additive update + non-negativity clamp (Eq. 2;
             # sartsolver.cpp:183-209, sart_kernels.cu:63-110).
-            penalty = beta * coo_matvec(problem.laplacian, f, nvoxel)
+            penalty = beta * coo_matvec(problem.laplacian, gather_voxels(f), nvoxel)
             w = jnp.where(meas_mask, g - fitted, 0) * inv_length
             bp = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
             f_new = jnp.maximum(f + inv_density * bp - penalty, 0)
 
-        fitted_new = forward_project(rtm, f_new, accum_dtype=dtype)
+        fitted_new = _psum(forward_project(rtm, f_new, accum_dtype=dtype), voxel_axis)
         fsq = _psum(jnp.sum(fitted_new * fitted_new), axis_name)
         conv = (msq - fsq) / msq  # Eq. 5 (sartsolver.cpp:224)
         converged = (it >= 1) & (jnp.abs(conv - conv_prev) < tol)

@@ -31,12 +31,21 @@ _f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
 def _build() -> Optional[str]:
     if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
         return _SO
+    # Compile to a process-unique temp path and rename into place: a killed
+    # compiler or a concurrent builder must never leave a half-written .so
+    # that later passes the mtime check and poisons every future load.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           _SRC, "-o", _SO]
+           _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return _SO
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
 
 
@@ -46,19 +55,24 @@ def get_lib() -> Optional[ctypes.CDLL]:
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        so = _build()
-        if so is None:
+        try:
+            so = _build()
+            if so is None:
+                _build_failed = True
+                return None
+            lib = ctypes.CDLL(so)
+            lib.sart_native_abi_version.restype = ctypes.c_int
+            if lib.sart_native_abi_version() != 1:
+                _build_failed = True
+                return None
+            lib.sart_masked_compact_f64.argtypes = [
+                _f64p, _i64p, ctypes.c_int64, _f64p]
+            lib.sart_scatter_coo_f32.argtypes = [
+                _f32p, ctypes.c_int64, _i64p, _i64p, _f32p, ctypes.c_int64]
+        except (OSError, AttributeError):
+            # corrupt/incompatible shared object: fall back to NumPy paths
             _build_failed = True
             return None
-        lib = ctypes.CDLL(so)
-        lib.sart_native_abi_version.restype = ctypes.c_int
-        if lib.sart_native_abi_version() != 1:
-            _build_failed = True
-            return None
-        lib.sart_masked_compact_f64.argtypes = [
-            _f64p, _i64p, ctypes.c_int64, _f64p]
-        lib.sart_scatter_coo_f32.argtypes = [
-            _f32p, ctypes.c_int64, _i64p, _i64p, _f32p, ctypes.c_int64]
         _lib = lib
         return _lib
 

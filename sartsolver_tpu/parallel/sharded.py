@@ -5,18 +5,22 @@ strategy: 1-D row-block distribution of the RTM over ranks with a replicated
 solution vector, main.cpp:67-68) with ``jax.shard_map`` over a
 ``('pixels', 'voxels')`` mesh:
 
-- RTM sharded ``P('pixels', None)`` — each device holds a pixel row block,
-  like one MPI rank's ``RayTransferMatrix`` (raytransfer.hpp:20).
-- measurement / ray_length sharded ``P('pixels')`` (rank-local vectors).
-- solution / ray_density replicated (as in the reference, where every rank
-  holds the full ``nvoxel`` state).
-- every ``MPI_Allreduce`` site (16 in the reference, §2 of SURVEY) is a
-  ``lax.psum`` *inside* the jitted while_loop, so reductions ride ICI with no
-  per-iteration host staging (contrast sartsolver_cuda.cpp:242-244).
+- RTM sharded ``P('pixels', 'voxels')`` — each device holds a (row, column)
+  block; with one voxel shard this degenerates to the reference's layout
+  (one MPI rank's ``RayTransferMatrix``, raytransfer.hpp:20).
+- measurement / ray_length sharded ``P('pixels')``; solution / ray_density
+  sharded ``P('voxels')``. With >1 voxel shards the reference's
+  replicated-f memory cost (every rank holds all nvoxel state) drops to
+  1/n_voxel_shards — the axis to grow when nvoxel outruns one chip's HBM.
+- every ``MPI_Allreduce`` site (16 in the reference, SURVEY §2) is a
+  ``lax.psum`` *inside* the jitted while_loop, riding ICI with no
+  per-iteration host staging (contrast sartsolver_cuda.cpp:242-244);
+  the 2-D path adds a forward-projection psum over 'voxels' and an
+  all_gather of f for the Laplacian's global column indexing.
 
 Unequal MPI-style blocks become equal SPMD blocks by padding (see
-``parallel.mesh``): padded rows are exactly inert by the solver's own
-masking rules.
+``parallel.mesh``): padded pixels are excluded by the solver's own masking
+rules, padded voxels have zero ray density and are masked identically.
 """
 
 from __future__ import annotations
@@ -47,6 +51,37 @@ from sartsolver_tpu.parallel.mesh import (
 )
 
 
+def _shard_laplacian(
+    laplacian: LaplacianCOO, n_voxel_shards: int, voxel_block: int, dtype
+) -> LaplacianCOO:
+    """Partition COO triplets by output-row block for the voxel shards.
+
+    Returns arrays shaped [n_voxel_shards, nnz_max]: rows are block-local,
+    cols stay global (the solver all_gathers f for the column lookup), and
+    per-shard nnz is padded to the max with inert (0, 0, 0.0) entries.
+    """
+    rows = np.asarray(laplacian.rows, np.int64)
+    cols = np.asarray(laplacian.cols, np.int64)
+    vals = np.asarray(laplacian.vals)
+
+    shard_sel = [
+        (rows >= s * voxel_block) & (rows < (s + 1) * voxel_block)
+        for s in range(n_voxel_shards)
+    ]
+    nnz_max = max(int(sel.sum()) for sel in shard_sel) if len(rows) else 0
+    nnz_max = max(nnz_max, 1)
+
+    out_rows = np.zeros((n_voxel_shards, nnz_max), np.int32)
+    out_cols = np.zeros((n_voxel_shards, nnz_max), np.int32)
+    out_vals = np.zeros((n_voxel_shards, nnz_max), np.dtype(dtype))
+    for s, sel in enumerate(shard_sel):
+        n = int(sel.sum())
+        out_rows[s, :n] = rows[sel] - s * voxel_block
+        out_cols[s, :n] = cols[sel]
+        out_vals[s, :n] = vals[sel]
+    return LaplacianCOO(out_rows, out_cols, out_vals)
+
+
 class DistributedSARTSolver:
     """Upload-once / solve-many-frames driver (the reference's solver object
     lifecycle: matrix uploaded in the ctor, ``solve`` called per frame,
@@ -62,49 +97,59 @@ class DistributedSARTSolver:
     ):
         self.opts = opts
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.n_pixel_shards = self.mesh.shape[PIXEL_AXIS]
-        if self.mesh.shape.get(VOXEL_AXIS, 1) != 1:
-            raise NotImplementedError(
-                "Voxel-axis (column) sharding is not wired into the solver "
-                "yet; use a ('pixels',)-only mesh."
+        if PIXEL_AXIS not in self.mesh.shape or VOXEL_AXIS not in self.mesh.shape:
+            raise ValueError(
+                "Mesh must have ('pixels', 'voxels') axes; build it with "
+                "parallel.mesh.make_mesh()."
             )
+        self.n_pixel_shards = self.mesh.shape[PIXEL_AXIS]
+        self.n_voxel_shards = self.mesh.shape.get(VOXEL_AXIS, 1)
         self.npixel, self.nvoxel = rtm.shape
 
         dtype = jnp.dtype(opts.dtype)
         rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
 
+        target_rows = padded_size(self.npixel, self.n_pixel_shards)
+        target_cols = padded_size(self.nvoxel, self.n_voxel_shards)
+        self.padded_nvoxel = target_cols
+        self.voxel_block = target_cols // self.n_voxel_shards
+
         # Single-copy staging: the RTM is the dominant host allocation (the
         # reference targets tens-to-hundreds of GB), so pad+cast in one
         # buffer, and skip the copy entirely when layout already matches.
         rtm_np = np.asarray(rtm)
-        target_rows = padded_size(self.npixel, self.n_pixel_shards)
-        if target_rows != self.npixel or rtm_np.dtype != np.dtype(rtm_dtype):
-            buf = np.zeros((target_rows, self.nvoxel), dtype=np.dtype(rtm_dtype))
-            buf[: self.npixel] = rtm_np
+        if (target_rows, target_cols) != rtm_np.shape or rtm_np.dtype != np.dtype(rtm_dtype):
+            buf = np.zeros((target_rows, target_cols), dtype=np.dtype(rtm_dtype))
+            buf[: self.npixel, : self.nvoxel] = rtm_np
             rtm_np = buf
         rtm_dev = jax.device_put(
-            rtm_np, NamedSharding(self.mesh, P(PIXEL_AXIS, None))
+            rtm_np, NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
         )
 
+        self._voxel_axis = VOXEL_AXIS if self.n_voxel_shards > 1 else None
         stats_fn = jax.jit(
             jax.shard_map(
                 functools.partial(
-                    compute_ray_stats, dtype=dtype, axis_name=PIXEL_AXIS
+                    compute_ray_stats, dtype=dtype,
+                    axis_name=PIXEL_AXIS, voxel_axis=self._voxel_axis,
                 ),
                 mesh=self.mesh,
-                in_specs=P(PIXEL_AXIS, None),
-                out_specs=(P(), P(PIXEL_AXIS)),
+                in_specs=P(PIXEL_AXIS, VOXEL_AXIS),
+                out_specs=(P(VOXEL_AXIS), P(PIXEL_AXIS)),
                 check_vma=False,
             )
         )
         ray_density, ray_length = stats_fn(rtm_dev)
 
         if laplacian is not None:
-            rep = NamedSharding(self.mesh, P())
+            sharded_lap = _shard_laplacian(
+                laplacian, self.n_voxel_shards, self.voxel_block, dtype
+            )
+            lap_sharding = NamedSharding(self.mesh, P(VOXEL_AXIS, None))
             laplacian = LaplacianCOO(
-                jax.device_put(laplacian.rows, rep),
-                jax.device_put(laplacian.cols, rep),
-                jax.device_put(laplacian.vals.astype(dtype), rep),
+                jax.device_put(sharded_lap.rows, lap_sharding),
+                jax.device_put(sharded_lap.cols, lap_sharding),
+                jax.device_put(sharded_lap.vals, lap_sharding),
             )
 
         self.problem = SARTProblem(rtm_dev, ray_density, ray_length, laplacian)
@@ -112,18 +157,33 @@ class DistributedSARTSolver:
 
     def _solve_fn(self, use_guess: bool):
         if use_guess not in self._solve_fns:
-            lap_spec = None if self.problem.laplacian is None else LaplacianCOO(P(), P(), P())
-            problem_spec = SARTProblem(P(PIXEL_AXIS, None), P(), P(PIXEL_AXIS), lap_spec)
-            fn = jax.shard_map(
-                functools.partial(
-                    solve_normalized,
-                    opts=self.opts,
-                    axis_name=PIXEL_AXIS,
+            has_lap = self.problem.laplacian is not None
+            lap_spec = LaplacianCOO(P(VOXEL_AXIS, None), P(VOXEL_AXIS, None),
+                                    P(VOXEL_AXIS, None)) if has_lap else None
+            problem_spec = SARTProblem(
+                P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS), P(PIXEL_AXIS), lap_spec
+            )
+            opts = self.opts
+            voxel_axis = self._voxel_axis
+
+            def run(problem, g, msq, f0):
+                lap = problem.laplacian
+                if lap is not None:
+                    # drop the leading per-shard dim added by _shard_laplacian
+                    problem = problem._replace(
+                        laplacian=LaplacianCOO(lap.rows[0], lap.cols[0], lap.vals[0])
+                    )
+                return solve_normalized(
+                    problem, g, msq, f0,
+                    opts=opts, axis_name=PIXEL_AXIS, voxel_axis=voxel_axis,
                     use_guess=use_guess,
-                ),
+                )
+
+            fn = jax.shard_map(
+                run,
                 mesh=self.mesh,
-                in_specs=(problem_spec, P(PIXEL_AXIS), P(), P()),
-                out_specs=SolveResult(P(), P(), P(), P()),
+                in_specs=(problem_spec, P(PIXEL_AXIS), P(), P(VOXEL_AXIS)),
+                out_specs=SolveResult(P(VOXEL_AXIS), P(), P(), P()),
                 check_vma=False,
             )
             self._solve_fns[use_guess] = jax.jit(fn)
@@ -147,18 +207,16 @@ class DistributedSARTSolver:
         )
 
         use_guess = f0 is None
-        rep = NamedSharding(self.mesh, P())
-        if use_guess:
-            f0_dev = jax.device_put(np.zeros(self.nvoxel, dtype), rep)
-        else:
-            f0_dev = jax.device_put(
-                (np.asarray(f0, np.float64) / norm).astype(dtype), rep
-            )
+        f_sharding = NamedSharding(self.mesh, P(VOXEL_AXIS))
+        f0_np = np.zeros(self.padded_nvoxel, dtype)
+        if not use_guess:
+            f0_np[: self.nvoxel] = np.asarray(f0, np.float64) / norm
+        f0_dev = jax.device_put(f0_np, f_sharding)
 
         res = self._solve_fn(use_guess)(
             self.problem, g_dev, jnp.asarray(msq, dtype), f0_dev
         )
-        solution = np.asarray(res.solution, np.float64) * norm
+        solution = np.asarray(res.solution, np.float64)[: self.nvoxel] * norm
         return SolveResult(
             solution, int(res.status), int(res.iterations), float(res.convergence)
         )

@@ -89,10 +89,38 @@ def test_sharded_multiple_frames_warm_chain():
         assert np.isfinite(f).all()
 
 
-def test_mesh_with_voxel_axis_placeholder():
-    """2-D mesh (pixels x voxels) builds; voxel axis currently size 1."""
-    mesh = make_mesh(4, 2)
-    assert mesh.shape == {"pixels": 4, "voxels": 2}
-    if len(jax.devices()) >= 8:
-        mesh8 = make_mesh(8, 1)
-        assert mesh8.shape["pixels"] == 8
+@pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (1, 8)])
+@pytest.mark.parametrize("logarithmic", [False, True])
+def test_2d_mesh_equals_single_device(mesh_shape, logarithmic):
+    """Column (voxel-axis) sharding: 2-D mesh result == single device.
+
+    The voxel dimension deliberately doesn't divide the shard count in one
+    case (40 voxels over 4x2 -> padding path on both axes)."""
+    H, g, _ = make_case(seed=15, P=52, V=40)
+    lap_np = laplacian_1d_chain(H.shape[1], 0.1)
+    opts = SolverOptions.cpu_parity(
+        logarithmic=logarithmic, max_iterations=20, conv_tolerance=1e-12
+    )
+    lap = make_laplacian(*lap_np, dtype="float64")
+
+    res_single = solve(make_problem(H, lap, opts=opts), g, opts=opts)
+    solver = DistributedSARTSolver(H, lap, opts=opts, mesh=make_mesh(*mesh_shape))
+    res_shard = solver.solve(g)
+
+    np.testing.assert_allclose(
+        res_shard.solution, np.asarray(res_single.solution), rtol=1e-9, atol=1e-12
+    )
+    assert res_shard.status == int(res_single.status)
+    assert res_shard.iterations == int(res_single.iterations)
+
+
+def test_2d_mesh_warm_start_chain():
+    H, g, _ = make_case(seed=16, P=48, V=32)
+    opts = SolverOptions.cpu_parity(max_iterations=10, conv_tolerance=1e-12)
+    solver_1d = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8, 1))
+    solver_2d = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(2, 4))
+    f1 = f2 = None
+    for scale in (1.0, 1.2):
+        f1 = solver_1d.solve(g * scale, f0=f1).solution
+        f2 = solver_2d.solve(g * scale, f0=f2).solution
+        np.testing.assert_allclose(f2, f1, rtol=1e-9)
