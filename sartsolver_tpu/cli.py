@@ -80,6 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Number of mesh shards along the voxel axis "
                           "(column sharding; shrinks per-chip solution-state "
                           "memory when nvoxel outgrows one chip).")
+    tpu.add_argument("--batch_frames", type=int, default=1,
+                     help="Solve N composite frames per device program "
+                          "(gemv->gemm on the MXU; the RTM is read once per "
+                          "iteration for the whole batch). Requires "
+                          "--no_guess, since batched frames carry no "
+                          "warm-start dependency.")
     tpu.add_argument("--rtm_dtype", default=None,
                      choices=["float32", "bfloat16", "float64"],
                      help="On-device RTM storage dtype (bfloat16 halves HBM "
@@ -118,6 +124,11 @@ def _validate(args) -> None:
         fail(f"Argument pixel_shards must be >= 1, {args.pixel_shards} given.")
     if args.voxel_shards < 1:
         fail(f"Argument voxel_shards must be >= 1, {args.voxel_shards} given.")
+    if args.batch_frames < 1:
+        fail(f"Argument batch_frames must be >= 1, {args.batch_frames} given.")
+    if args.batch_frames > 1 and not args.no_guess:
+        fail("Argument batch_frames > 1 requires --no_guess (batched frames "
+             "have no warm-start dependency).")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -229,14 +240,44 @@ def main(argv: Optional[List[str]] = None) -> int:
             args.output_file, camera_names, nvoxel,
             max_cache_size=args.max_cached_solutions,
         ) as writer, FramePrefetcher(composite_image) as frames:
-            warm: Optional[np.ndarray] = None
-            for frame, ftime, cam_times in frames:
-                t0 = _time.perf_counter()
-                result = solver.solve(frame, f0=warm)
-                writer.add(result.solution, result.status, ftime, cam_times)
-                elapsed_ms = (_time.perf_counter() - t0) * 1e3
-                print(f"Processed in: {elapsed_ms} ms")
-                warm = None if args.no_guess else result.solution
+            if args.batch_frames > 1:
+                pending = []
+
+                def flush_batch():
+                    t0 = _time.perf_counter()
+                    stack = np.stack([fr for fr, _, _ in pending])
+                    if len(pending) < args.batch_frames:
+                        # pad the final partial batch with inert dark frames
+                        # so the already-compiled batch program is reused
+                        # instead of triggering a second XLA compile
+                        stack = np.concatenate([
+                            stack,
+                            np.zeros((args.batch_frames - len(pending),
+                                      stack.shape[1])),
+                        ])
+                    result = solver.solve_batch(stack)
+                    per_frame_ms = (_time.perf_counter() - t0) * 1e3 / len(pending)
+                    for b, (_, ftime, cam_times) in enumerate(pending):
+                        writer.add(result.solution[b], int(result.status[b]),
+                                   ftime, cam_times)
+                        print(f"Processed in: {per_frame_ms} ms")
+                    pending.clear()
+
+                for item in frames:
+                    pending.append(item)
+                    if len(pending) == args.batch_frames:
+                        flush_batch()
+                if pending:
+                    flush_batch()
+            else:
+                warm: Optional[np.ndarray] = None
+                for frame, ftime, cam_times in frames:
+                    t0 = _time.perf_counter()
+                    result = solver.solve(frame, f0=warm)
+                    writer.add(result.solution, result.status, ftime, cam_times)
+                    elapsed_ms = (_time.perf_counter() - t0) * 1e3
+                    print(f"Processed in: {elapsed_ms} ms")
+                    warm = None if args.no_guess else result.solution
 
         grid.write_hdf5(args.output_file, "voxel_map")
     except KeyError as err:

@@ -39,7 +39,7 @@ from sartsolver_tpu.models.sart import (
     SolveResult,
     compute_ray_stats,
     prepare_measurement,
-    solve_normalized,
+    solve_normalized_batch,
 )
 from sartsolver_tpu.ops.laplacian import LaplacianCOO
 from sartsolver_tpu.parallel.mesh import (
@@ -155,7 +155,9 @@ class DistributedSARTSolver:
         self.problem = SARTProblem(rtm_dev, ray_density, ray_length, laplacian)
         self._solve_fns = {}
 
-    def _solve_fn(self, use_guess: bool):
+    def _batch_fn(self, use_guess: bool):
+        """Compiled batched solve over the mesh (one program per use_guess;
+        XLA re-specializes per batch size on call)."""
         if use_guess not in self._solve_fns:
             has_lap = self.problem.laplacian is not None
             lap_spec = LaplacianCOO(P(VOXEL_AXIS, None), P(VOXEL_AXIS, None),
@@ -173,7 +175,7 @@ class DistributedSARTSolver:
                     problem = problem._replace(
                         laplacian=LaplacianCOO(lap.rows[0], lap.cols[0], lap.vals[0])
                     )
-                return solve_normalized(
+                return solve_normalized_batch(
                     problem, g, msq, f0,
                     opts=opts, axis_name=PIXEL_AXIS, voxel_axis=voxel_axis,
                     use_guess=use_guess,
@@ -182,41 +184,74 @@ class DistributedSARTSolver:
             fn = jax.shard_map(
                 run,
                 mesh=self.mesh,
-                in_specs=(problem_spec, P(PIXEL_AXIS), P(), P(VOXEL_AXIS)),
-                out_specs=SolveResult(P(VOXEL_AXIS), P(), P(), P()),
+                in_specs=(problem_spec, P(None, PIXEL_AXIS), P(), P(None, VOXEL_AXIS)),
+                out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
                 check_vma=False,
             )
             self._solve_fns[use_guess] = jax.jit(fn)
         return self._solve_fns[use_guess]
 
-    def solve(self, measurement, f0=None) -> SolveResult:
-        """Solve one frame; host pre-step shared with the single-device
-        driver (``models.sart.prepare_measurement``)."""
+    def solve_batch(self, measurements, f0=None) -> SolveResult:
+        """Solve B independent frames in one batched device program.
+
+        Per-frame semantics are identical to :meth:`solve`; intended for
+        ``no_guess`` workloads (no warm-start dependency between frames).
+        Returns a SolveResult of arrays: solution [B, nvoxel], status [B],
+        iterations [B], convergence [B].
+        """
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
+        G = np.asarray(measurements, np.float64)
+        if G.ndim != 2 or G.shape[1] != self.npixel:
+            raise ValueError(
+                f"Measurements must be [B, {self.npixel}], got {G.shape}."
+            )
+        B = G.shape[0]
+
+        norms = np.empty(B)
+        msqs = np.empty(B)
+        g_stage = np.empty(
+            (B, padded_size(self.npixel, self.n_pixel_shards)), dtype
+        )
+        for b in range(B):
+            g64, msq, norm = prepare_measurement(G[b], opts)
+            g_stage[b] = pad_measurement(g64, self.n_pixel_shards)
+            norms[b], msqs[b] = norm, msq
+
+        g_dev = jax.device_put(
+            g_stage, NamedSharding(self.mesh, P(None, PIXEL_AXIS))
+        )
+        use_guess = f0 is None
+        f0_np = np.zeros((B, self.padded_nvoxel), dtype)
+        if not use_guess:
+            f0_np[:, : self.nvoxel] = np.asarray(f0, np.float64) / norms[:, None]
+        f0_dev = jax.device_put(
+            f0_np, NamedSharding(self.mesh, P(None, VOXEL_AXIS))
+        )
+
+        res = self._batch_fn(use_guess)(
+            self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev
+        )
+        solution = np.asarray(res.solution, np.float64)[:, : self.nvoxel] * norms[:, None]
+        return SolveResult(
+            solution,
+            np.asarray(res.status),
+            np.asarray(res.iterations),
+            np.asarray(res.convergence, np.float64),
+        )
+
+    def solve(self, measurement, f0=None) -> SolveResult:
+        """Solve one frame — the B=1 case of :meth:`solve_batch`."""
         if np.shape(measurement)[0] != self.npixel:
             raise ValueError(
                 f"Measurement has {np.shape(measurement)[0]} pixels, "
                 f"expected {self.npixel}."
             )
-        g64, msq, norm = prepare_measurement(measurement, opts)
-
-        g_padded = pad_measurement(g64, self.n_pixel_shards)
-        g_dev = jax.device_put(
-            g_padded.astype(dtype), NamedSharding(self.mesh, P(PIXEL_AXIS))
+        res = self.solve_batch(
+            np.asarray(measurement)[None, :],
+            None if f0 is None else np.asarray(f0)[None, :],
         )
-
-        use_guess = f0 is None
-        f_sharding = NamedSharding(self.mesh, P(VOXEL_AXIS))
-        f0_np = np.zeros(self.padded_nvoxel, dtype)
-        if not use_guess:
-            f0_np[: self.nvoxel] = np.asarray(f0, np.float64) / norm
-        f0_dev = jax.device_put(f0_np, f_sharding)
-
-        res = self._solve_fn(use_guess)(
-            self.problem, g_dev, jnp.asarray(msq, dtype), f0_dev
-        )
-        solution = np.asarray(res.solution, np.float64)[: self.nvoxel] * norm
         return SolveResult(
-            solution, int(res.status), int(res.iterations), float(res.convergence)
+            res.solution[0], int(res.status[0]),
+            int(res.iterations[0]), float(res.convergence[0]),
         )

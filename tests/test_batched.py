@@ -1,0 +1,93 @@
+"""Batched multi-frame solve: per-frame results must equal serial solves."""
+
+import numpy as np
+import pytest
+
+from sartsolver_tpu.config import SolverOptions
+from sartsolver_tpu.ops.laplacian import make_laplacian
+from sartsolver_tpu.parallel.mesh import make_mesh
+from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+from test_sart_core import laplacian_1d_chain, make_case
+
+
+def make_frames(H, n_frames=3, seed=30):
+    rng = np.random.default_rng(seed)
+    f_true = rng.uniform(0.5, 2.0, H.shape[1])
+    G = np.stack([
+        np.abs(H @ (f_true * s) + 0.01 * rng.standard_normal(H.shape[0]))
+        for s in (1.0, 1.3, 0.8)[:n_frames]
+    ])
+    G[0, 3] = -1.0  # one saturated pixel in frame 0 only
+    return G
+
+
+@pytest.mark.parametrize("logarithmic", [False, True])
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (2, 4)])
+def test_batch_equals_serial(logarithmic, mesh_shape):
+    H, _, _ = make_case(seed=31, P=52, V=40)
+    lap = make_laplacian(*laplacian_1d_chain(H.shape[1], 0.1), dtype="float64")
+    G = make_frames(H)
+    opts = SolverOptions.cpu_parity(
+        logarithmic=logarithmic, max_iterations=25, conv_tolerance=1e-12
+    )
+    solver = DistributedSARTSolver(H, lap, opts=opts, mesh=make_mesh(*mesh_shape))
+
+    batch = solver.solve_batch(G)
+    for b in range(G.shape[0]):
+        serial = solver.solve(G[b])
+        np.testing.assert_allclose(
+            batch.solution[b], serial.solution, rtol=1e-9, atol=1e-12,
+            err_msg=f"frame {b}",
+        )
+        assert batch.status[b] == serial.status
+        assert batch.iterations[b] == serial.iterations
+
+
+def test_batch_per_frame_convergence_freezing():
+    """Frames converging at different iterations keep their own counts."""
+    H, _, _ = make_case(seed=32, P=48, V=32, noise=0.0, neg_pixels=0)
+    rng = np.random.default_rng(0)
+    f_true = rng.uniform(0.5, 2.0, H.shape[1])
+    # frame 1 starts much closer to convergence than frame 0
+    G = np.stack([np.abs(H @ f_true) * 3.0, np.abs(H @ f_true)])
+    opts = SolverOptions.cpu_parity(max_iterations=500, conv_tolerance=1e-6)
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8, 1))
+    batch = solver.solve_batch(G)
+    serial_iters = [solver.solve(G[b]).iterations for b in range(2)]
+    assert list(batch.iterations) == serial_iters
+
+
+def test_batch_warm_start():
+    H, _, _ = make_case(seed=33, P=48, V=32)
+    G = make_frames(H)
+    f0 = np.full((G.shape[0], H.shape[1]), 0.7)
+    opts = SolverOptions.cpu_parity(max_iterations=15, conv_tolerance=1e-12)
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(4, 2))
+    batch = solver.solve_batch(G, f0=f0)
+    for b in range(G.shape[0]):
+        serial = solver.solve(G[b], f0=f0[b])
+        np.testing.assert_allclose(batch.solution[b], serial.solution, rtol=1e-9)
+
+
+def test_batch_shape_validation():
+    H, _, _ = make_case(seed=34)
+    opts = SolverOptions.cpu_parity(max_iterations=5, conv_tolerance=1e-6)
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8, 1))
+    with pytest.raises(ValueError, match="Measurements must be"):
+        solver.solve_batch(np.zeros((2, H.shape[0] + 1)))
+
+
+def test_bfloat16_rtm_storage():
+    """bf16 RTM with fp32 accumulation stays close to the fp32 result."""
+    H, g, _ = make_case(seed=35, P=64, V=48)
+    opts32 = SolverOptions(max_iterations=10, conv_tolerance=1e-12)
+    optsbf = SolverOptions(max_iterations=10, conv_tolerance=1e-12,
+                           rtm_dtype="bfloat16")
+    s32 = DistributedSARTSolver(H, opts=opts32, mesh=make_mesh(4, 2))
+    sbf = DistributedSARTSolver(H, opts=optsbf, mesh=make_mesh(4, 2))
+    r32 = s32.solve(g)
+    rbf = sbf.solve(g)
+    assert np.isfinite(rbf.solution).all()
+    # bf16 has ~3 decimal digits; solutions should agree to ~1%
+    np.testing.assert_allclose(rbf.solution, r32.solution, rtol=0.05, atol=0.01)

@@ -93,6 +93,29 @@ def test_pixel_shards_flag(world):
     np.testing.assert_allclose(sharded, ref, rtol=1e-8, atol=1e-10)
 
 
+def test_batch_frames_flag(world):
+    """Batched no-guess run matches the serial no-guess run exactly."""
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths, "--no_guess") == 0
+    with h5py.File(paths["output"], "r") as f:
+        serial = f["solution/value"][:]
+        serial_status = f["solution/status"][:]
+    assert run_cli(paths, "--no_guess", "--batch_frames", "3") == 0
+    with h5py.File(paths["output"], "r") as f:
+        batched = f["solution/value"][:]
+        batched_status = f["solution/status"][:]
+        t = f["solution/time"][:]
+    np.testing.assert_allclose(batched, serial, rtol=1e-9, atol=1e-12)
+    np.testing.assert_array_equal(batched_status, serial_status)
+    assert t.shape[0] == len(times)  # partial final batch flushed too
+
+
+def test_batch_frames_requires_no_guess(world):
+    paths, *_ = world
+    with pytest.raises(SystemExit):
+        run_cli(paths, "--batch_frames", "2")
+
+
 def test_invalid_args_exit_1(world, capsys):
     paths, *_ = world
     with pytest.raises(SystemExit):
