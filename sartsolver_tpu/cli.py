@@ -92,6 +92,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "traffic of the two dominant sweeps).")
     tpu.add_argument("--profile_dir", default=None,
                      help="Write a jax.profiler trace of the frame loop here.")
+    tpu.add_argument("--fused_sweep", default="auto",
+                     choices=["auto", "on", "off"],
+                     help="Fused Pallas iteration sweep: one HBM read of the "
+                          "RTM per iteration instead of two (applies when "
+                          "the pixel axis is not sharded).")
     return p
 
 
@@ -184,6 +189,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                 beta_laplace=args.beta_laplace,
                 relaxation=args.relaxation,
                 max_iterations=args.max_iterations,
+                # forwarded so an explicit --fused_sweep on fails loudly
+                # (the fused sweep is fp32-only) instead of silently
+                # degrading to the unfused path
+                fused_sweep=args.fused_sweep,
             )
             jax.config.update("jax_enable_x64", True)
             devices = jax.devices("cpu")
@@ -197,6 +206,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 relaxation=args.relaxation,
                 max_iterations=args.max_iterations,
                 rtm_dtype=args.rtm_dtype,
+                fused_sweep=args.fused_sweep,
             )
             devices = jax.devices()
 

@@ -123,6 +123,11 @@ class SolverOptions:
     # (sart_kernels.cu:34); the CPU path's initial guess does not
     # (sartsolver.cpp:149-157). Default follows the device path.
     mask_negative_guess: bool = True
+    # Fused Pallas iteration sweep (ops/fused_sweep.py): one HBM read of the
+    # RTM per iteration instead of two. "auto" enables it on TPU when the
+    # problem is not pixel-sharded and shapes are tile-aligned; "interpret"
+    # runs the kernel in the Pallas interpreter (CPU testing).
+    fused_sweep: str = "auto"
 
     @classmethod
     def cpu_parity(cls, *, logarithmic: bool = False, **kw) -> "SolverOptions":
@@ -158,3 +163,5 @@ class SolverOptions:
             raise ValueError("dtype must be 'float32' or 'float64'.")
         if self.rtm_dtype not in (None, "float32", "float64", "bfloat16"):
             raise ValueError("rtm_dtype must be None, 'float32', 'float64' or 'bfloat16'.")
+        if self.fused_sweep not in ("auto", "on", "off", "interpret"):
+            raise ValueError("fused_sweep must be 'auto', 'on', 'off' or 'interpret'.")

@@ -33,6 +33,7 @@ import numpy as np
 from jax import Array, lax
 
 from sartsolver_tpu.config import MAX_ITERATIONS_EXCEEDED, SUCCESS, SolverOptions
+from sartsolver_tpu.ops.fused_sweep import fused_available, fused_sweep
 from sartsolver_tpu.ops.laplacian import LaplacianCOO, coo_matvec
 from sartsolver_tpu.ops.projection import back_project, forward_project
 
@@ -62,6 +63,50 @@ class SolveResult(NamedTuple):
 
 def _psum(x, axis_name):
     return lax.psum(x, axis_name) if axis_name is not None else x
+
+
+def _resolve_fused(opts: SolverOptions, axis_name, rtm, batch: int) -> Optional[str]:
+    """Trace-time decision for the fused Pallas sweep (ops/fused_sweep.py).
+
+    Returns None (two-matmul path), "compiled", or "interpret". Fusion needs
+    the full pixel extent on-device (no pixel-axis sharding: the
+    back-projection psum would fall between the two MXU uses of the panel)
+    and fp32 compute; "auto" additionally requires a TPU backend and
+    tile-aligned shapes. An explicitly requested mode that cannot be
+    honoured raises instead of silently degrading.
+    """
+    mode = opts.fused_sweep
+    if mode == "off":
+        return None
+    explicit = mode in ("on", "interpret")
+    if axis_name is not None:
+        if explicit:
+            raise ValueError(
+                f"fused_sweep='{mode}' requested but the pixel axis is "
+                "sharded; the back-projection psum cannot run inside the "
+                "fused panel sweep. Use voxel sharding or fused_sweep='auto'."
+            )
+        return None
+    if jnp.dtype(opts.dtype) != jnp.float32 or rtm.dtype not in (
+        jnp.float32, jnp.bfloat16
+    ):
+        if explicit:
+            raise ValueError(
+                f"fused_sweep='{mode}' requested but dtype={opts.dtype} / "
+                f"rtm dtype={rtm.dtype}; the fused sweep computes in fp32 "
+                "(fp32 or bfloat16 RTM storage)."
+            )
+        return None
+    ok = fused_available(rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, batch)
+    if mode == "auto":
+        return "compiled" if ok and jax.default_backend() == "tpu" else None
+    if not ok:
+        raise ValueError(
+            f"fused_sweep='{mode}' requested but RTM shape {tuple(rtm.shape)} "
+            f"(batch {batch}) is not tile-aligned (pixels % 8 == 0, "
+            "voxels % 128 == 0) or does not fit the VMEM budget."
+        )
+    return "interpret" if mode == "interpret" else "compiled"
 
 
 # This JAX build emulates float64 as float32 pairs: full ~2x-fp32 precision
@@ -199,11 +244,9 @@ def solve_normalized_batch(
     meas_mask = g >= 0  # [B, P]
 
     def batched_penalty(x_full):  # x_full [B, V_global]
-        if problem.laplacian is None:
-            return jnp.zeros((B, nvoxel), dtype=x_full.dtype)
-        lap = problem.laplacian
-        contrib = lap.vals.astype(x_full.dtype)[None, :] * x_full[:, lap.cols]
-        return jnp.zeros((B, nvoxel), dtype=x_full.dtype).at[:, lap.rows].add(contrib)
+        return jax.vmap(
+            lambda x: coo_matvec(problem.laplacian, x, nvoxel)
+        )(x_full)
 
     if use_guess:
         # f0 = H^T g / rho on unmasked voxels (Eq. 4; sartsolver.cpp:144-159);
@@ -237,25 +280,66 @@ def solve_normalized_batch(
         )
         obs = jnp.where(vmask[None, :], obs, 0)
 
+    # Fused Pallas sweep: one HBM pass over the RTM per iteration instead of
+    # two (ops/fused_sweep.py). The elementwise update closures use Python
+    # float constants (Pallas kernels cannot capture traced values).
+    fused = _resolve_fused(opts, axis_name, rtm, B)
+    has_pen = problem.laplacian is not None
+    if fused is not None:
+        alpha = float(opts.relaxation)
+        eps_f = float(max(opts.log_epsilon, MIN_POSITIVE))
+        if opts.logarithmic:
+            vm32 = vmask.astype(dtype)[None, :]
+
+            def update_fn(f_p, bp_p, vm_p, obs_p, *pen_p):
+                fit = bp_p * vm_p
+                ratio = (obs_p + eps_f) / (fit + eps_f)
+                if alpha != 1.0:
+                    ratio = ratio ** alpha
+                return f_p * ratio * jnp.exp(-pen_p[0]) if pen_p else f_p * ratio
+        else:
+
+            def update_fn(f_p, bp_p, invd_p, *pen_p):
+                upd = f_p + invd_p * bp_p
+                if pen_p:
+                    upd = upd - pen_p[0]
+                return jnp.maximum(upd, 0)
+
+    def run_sweep(f, fitted, penalty):
+        """(f_upd, fitted_upd or None): the iteration's two RTM sweeps."""
+        if opts.logarithmic:
+            w = jnp.where(meas_mask, fitted, 0) * inv_length
+            if fused is not None:
+                aux = [vm32, obs] + ([penalty] if has_pen else [])
+                return fused_sweep(rtm, w, f, aux, update_fn,
+                                   interpret=fused == "interpret")
+            fit = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
+            fit = jnp.where(vmask[None, :], fit, 0)
+            ratio = ((obs + eps) / (fit + eps)) ** jnp.asarray(opts.relaxation, dtype)
+            return f * ratio * jnp.exp(-penalty), None
+        w = jnp.where(meas_mask, g - fitted, 0) * inv_length
+        if fused is not None:
+            aux = [inv_density[None, :]] + ([penalty] if has_pen else [])
+            return fused_sweep(rtm, w, f, aux, update_fn,
+                               interpret=fused == "interpret")
+        bp = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
+        return jnp.maximum(f + inv_density[None, :] * bp - penalty, 0), None
+
     def body(carry):
         f, fitted, conv_prev, it, done, iters = carry
         if opts.logarithmic:
             penalty = beta * batched_penalty(jnp.log(gather_voxels(f)))
-            fit = _psum(
-                back_project(rtm, jnp.where(meas_mask, fitted, 0) * inv_length, accum_dtype=dtype),
-                axis_name,
-            )
-            fit = jnp.where(vmask[None, :], fit, 0)
-            ratio = ((obs + eps) / (fit + eps)) ** jnp.asarray(opts.relaxation, dtype)
-            f_upd = f * ratio * jnp.exp(-penalty)
         else:
             penalty = beta * batched_penalty(gather_voxels(f))
-            w = jnp.where(meas_mask, g - fitted, 0) * inv_length
-            bp = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
-            f_upd = jnp.maximum(f + inv_density[None, :] * bp - penalty, 0)
+        f_upd, fitted_upd = run_sweep(f, fitted, penalty)
 
         f_new = jnp.where(done[:, None], f, f_upd)  # converged frames freeze
-        fitted_new = _psum(forward_project(rtm, f_new, accum_dtype=dtype), voxel_axis)
+        if fitted_upd is not None:
+            fitted_new = jnp.where(
+                done[:, None], fitted, _psum(fitted_upd, voxel_axis)
+            )
+        else:
+            fitted_new = _psum(forward_project(rtm, f_new, accum_dtype=dtype), voxel_axis)
         fsq = _psum(jnp.sum(fitted_new * fitted_new, axis=1), axis_name)
         conv = (msq - fsq) / msq
         newly = (~done) & (it >= 1) & (jnp.abs(conv - conv_prev) < tol)

@@ -1,0 +1,173 @@
+"""Fused SART iteration sweep — one HBM read of the RTM per iteration.
+
+The SART loop body is two dense sweeps over the RTM separated by cheap
+elementwise math (reference: PropagateKernel then cublasSgemv,
+sartsolver_cuda.cpp:239-249):
+
+    bp     = H^T w                 (back-projection,   reads H)
+    f_new  = update(f, bp, ...)    (elementwise, O(nvoxel))
+    fitted = H f_new               (forward projection, reads H again)
+
+As two XLA matmuls the RTM — the tens-to-hundreds-of-GB operand the whole
+design revolves around — is streamed from HBM **twice** per iteration, and
+since both sweeps are gemv-shaped the MXU is bandwidth-bound, so that factor
+of two is the whole game. This Pallas kernel tiles the voxel axis and keeps
+each column panel ``H[:, j*bs:(j+1)*bs]`` resident in VMEM for *both* uses:
+
+    for each voxel panel j:              (grid, panels DMA-pipelined)
+        bp_j      = w @ H_panel          (MXU, contraction over pixels)
+        f_new_j   = update(f_j, bp_j, aux_j...)   (VPU)
+        fitted   += f_new_j @ H_panel^T  (MXU, accumulated in VMEM)
+
+halving the HBM bill of the hot loop. The elementwise ``update`` is a
+trace-time closure, so the linear (Eq. 2) and logarithmic (Eq. 3) variants
+specialize the same kernel the way the reference specializes
+UpdateSolutionKernel / UpdateLogSolutionKernel (sart_kernels.cu:205-224).
+
+Fusion requires the full pixel extent of the panel on this device, i.e. no
+pixel-axis sharding (the back-projection psum would have to run between the
+two MXU ops). Voxel-axis sharding composes fine: each device fuses over its
+column block and the forward-projection psum runs on the kernel's output.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Per-panel VMEM footprint target for the RTM panel (double-buffered by the
+# Pallas pipeline, so actual use is ~2x this plus the pixel-axis residents).
+_PANEL_BYTES_TARGET = 8 * 1024 * 1024
+# Budget for the blocks resident across all panels: w and the fitted
+# accumulator, each [B, P] fp32. Together with ~2x the panel target this
+# stays well inside the ~64 MB guaranteed VMEM of recent TPUs.
+_RESIDENT_BYTES_TARGET = 32 * 1024 * 1024
+_MIN_BLOCK_VOXELS = 128  # lane width
+_SUBLANE = 8  # fp32 sublane width
+
+
+# Conservative count of [B, bs] voxel-panel operands cycling through VMEM
+# alongside the RTM panel: f, f_new, and up to three aux inputs, each
+# double-buffered by the Pallas pipeline.
+_VOXEL_PANEL_OPERANDS = 10
+
+
+def pick_block_voxels(
+    npixel: int, nvoxel: int, itemsize: int, batch: int = 1
+) -> int:
+    """Largest voxel-panel width (multiple of 128, dividing nvoxel) whose
+    per-panel VMEM footprint — the RTM panel plus the batch-scaled
+    [B, bs] operand panels — fits the budget; 0 if even the minimum fits."""
+    if nvoxel % _MIN_BLOCK_VOXELS:
+        return 0
+    per_voxel = npixel * itemsize + _VOXEL_PANEL_OPERANDS * batch * 4
+    bs = (_PANEL_BYTES_TARGET // max(per_voxel, 1)) // 128 * 128
+    bs = min(bs, nvoxel)
+    while bs >= _MIN_BLOCK_VOXELS:
+        if nvoxel % bs == 0:
+            return bs
+        bs -= _MIN_BLOCK_VOXELS
+    return 0
+
+
+def fused_available(npixel: int, nvoxel: int, rtm_itemsize: int, batch: int = 1) -> bool:
+    """Shapes aligned for the fused sweep: pixel rows fill fp32 sublanes, a
+    voxel panel (RTM + batch-scaled operand panels) fits VMEM, and the
+    pixel-axis residents (``w`` and the ``fitted`` accumulator, [B, P]
+    each) fit their budget."""
+    return (
+        npixel % _SUBLANE == 0
+        and pick_block_voxels(npixel, nvoxel, rtm_itemsize, batch) > 0
+        and 2 * batch * npixel * 4 <= _RESIDENT_BYTES_TARGET
+    )
+
+
+def _sweep_kernel(update_fn, n_aux, rtm_ref, w_ref, f_ref, *rest):
+    aux_refs = rest[:n_aux]
+    f_new_ref, fitted_ref = rest[n_aux:]
+    panel = rtm_ref[...]
+    if panel.dtype != jnp.float32:
+        panel = panel.astype(jnp.float32)
+    # Back-projection of this panel: contraction over the full pixel axis.
+    bp = jax.lax.dot_general(
+        w_ref[...], panel,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B, bs]
+    f_new = update_fn(f_ref[...], bp, *[a[...] for a in aux_refs])
+    f_new_ref[...] = f_new
+    # Forward-projection contribution of the same panel, while it is still
+    # in VMEM — this is the read the two-matmul formulation pays twice for.
+    contrib = jax.lax.dot_general(
+        f_new, panel,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B, P]
+
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        fitted_ref[...] = contrib
+
+    @pl.when(pl.program_id(0) > 0)
+    def _():
+        fitted_ref[...] += contrib
+
+
+def fused_sweep(
+    rtm: Array,  # [P, V]
+    w: Array,  # [B, P] fp32 — back-projection pixel weights
+    f: Array,  # [B, V] fp32 — current solution
+    aux: Sequence[Array],  # each [b_i, V] (b_i in {1, B}) fp32
+    update_fn: Callable[..., Array],
+    *,
+    interpret: bool = False,
+):
+    """Run one fused SART sweep; returns ``(f_new [B, V], fitted [B, P])``.
+
+    ``update_fn(f_panel, bp_panel, *aux_panels) -> f_new_panel`` is applied
+    elementwise per voxel panel. Shapes must satisfy :func:`fused_available`.
+    """
+    P, V = rtm.shape
+    B = w.shape[0]
+    bs = pick_block_voxels(P, V, rtm.dtype.itemsize, B)
+    if bs <= 0 or not fused_available(P, V, rtm.dtype.itemsize, B):
+        raise ValueError(
+            f"fused_sweep: shapes [{P}, {V}] (batch {B}) not aligned/"
+            "VMEM-fittable; gate calls with fused_available()."
+        )
+    grid = (V // bs,)
+
+    voxel_panel = lambda b: pl.BlockSpec((b, bs), lambda j: (0, j))
+    in_specs = [
+        pl.BlockSpec((P, bs), lambda j: (0, j)),  # RTM column panel
+        pl.BlockSpec((B, P), lambda j: (0, 0)),  # w: resident across panels
+        voxel_panel(B),  # f
+        *[voxel_panel(a.shape[0]) for a in aux],
+    ]
+    out_specs = (
+        voxel_panel(B),  # f_new
+        pl.BlockSpec((B, P), lambda j: (0, 0)),  # fitted accumulator
+    )
+    kernel = functools.partial(_sweep_kernel, update_fn, len(aux))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=(
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((B, P), jnp.float32),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * P * V,
+            bytes_accessed=P * V * rtm.dtype.itemsize + 2 * B * (P + V) * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(rtm, w, f, *aux)

@@ -24,6 +24,13 @@ from jax.sharding import Mesh
 PIXEL_AXIS = "pixels"
 VOXEL_AXIS = "voxels"
 
+# TPU tile alignment (fp32): pixel blocks fill sublanes, voxel blocks fill
+# lanes. Padding to these keeps every per-device block MXU/VPU-tileable and
+# makes the fused Pallas sweep (ops/fused_sweep.py) applicable; padded
+# entries are inert by the solver's own masking rules (module docstring).
+ROW_ALIGN = 8
+COL_ALIGN = 128
+
 
 def row_block_partition(npixel: int, nshards: int) -> List[Tuple[int, int]]:
     """(offset, count) per shard — the reference's MPI split (main.cpp:67-68).
@@ -54,9 +61,10 @@ def pad_pixel_axis(rtm: np.ndarray, nshards: int) -> np.ndarray:
     return np.concatenate([rtm, pad], axis=0)
 
 
-def pad_measurement(g: np.ndarray, nshards: int) -> np.ndarray:
+def pad_measurement(g: np.ndarray, nshards: int, target: int | None = None) -> np.ndarray:
     """Pad the measurement with -1 (saturated => excluded everywhere)."""
-    target = padded_size(g.shape[0], nshards)
+    if target is None:
+        target = padded_size(g.shape[0], nshards)
     if target == g.shape[0]:
         return g
     return np.concatenate([g, np.full(target - g.shape[0], -1.0, dtype=g.dtype)])

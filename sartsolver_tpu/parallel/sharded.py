@@ -43,7 +43,9 @@ from sartsolver_tpu.models.sart import (
 )
 from sartsolver_tpu.ops.laplacian import LaplacianCOO
 from sartsolver_tpu.parallel.mesh import (
+    COL_ALIGN,
     PIXEL_AXIS,
+    ROW_ALIGN,
     VOXEL_AXIS,
     make_mesh,
     pad_measurement,
@@ -109,8 +111,9 @@ class DistributedSARTSolver:
         dtype = jnp.dtype(opts.dtype)
         rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
 
-        target_rows = padded_size(self.npixel, self.n_pixel_shards)
-        target_cols = padded_size(self.nvoxel, self.n_voxel_shards)
+        target_rows = padded_size(self.npixel, self.n_pixel_shards * ROW_ALIGN)
+        target_cols = padded_size(self.nvoxel, self.n_voxel_shards * COL_ALIGN)
+        self.padded_npixel = target_rows
         self.padded_nvoxel = target_cols
         self.voxel_block = target_cols // self.n_voxel_shards
 
@@ -126,12 +129,15 @@ class DistributedSARTSolver:
             rtm_np, NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
         )
 
+        # Size-1 mesh axes carry no reductions; dropping their names lets the
+        # solver pick the fused Pallas sweep (no pixel-axis psum in the loop).
+        self._pixel_axis = PIXEL_AXIS if self.n_pixel_shards > 1 else None
         self._voxel_axis = VOXEL_AXIS if self.n_voxel_shards > 1 else None
         stats_fn = jax.jit(
             jax.shard_map(
                 functools.partial(
                     compute_ray_stats, dtype=dtype,
-                    axis_name=PIXEL_AXIS, voxel_axis=self._voxel_axis,
+                    axis_name=self._pixel_axis, voxel_axis=self._voxel_axis,
                 ),
                 mesh=self.mesh,
                 in_specs=P(PIXEL_AXIS, VOXEL_AXIS),
@@ -166,6 +172,7 @@ class DistributedSARTSolver:
                 P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS), P(PIXEL_AXIS), lap_spec
             )
             opts = self.opts
+            pixel_axis = self._pixel_axis
             voxel_axis = self._voxel_axis
 
             def run(problem, g, msq, f0):
@@ -177,7 +184,7 @@ class DistributedSARTSolver:
                     )
                 return solve_normalized_batch(
                     problem, g, msq, f0,
-                    opts=opts, axis_name=PIXEL_AXIS, voxel_axis=voxel_axis,
+                    opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
                     use_guess=use_guess,
                 )
 
@@ -210,12 +217,12 @@ class DistributedSARTSolver:
 
         norms = np.empty(B)
         msqs = np.empty(B)
-        g_stage = np.empty(
-            (B, padded_size(self.npixel, self.n_pixel_shards)), dtype
-        )
+        g_stage = np.empty((B, self.padded_npixel), dtype)
         for b in range(B):
             g64, msq, norm = prepare_measurement(G[b], opts)
-            g_stage[b] = pad_measurement(g64, self.n_pixel_shards)
+            g_stage[b] = pad_measurement(
+                g64, self.n_pixel_shards, target=self.padded_npixel
+            )
             norms[b], msqs[b] = norm, msq
 
         g_dev = jax.device_put(

@@ -19,7 +19,10 @@ import jax  # noqa: E402
 from jax._src import xla_bridge as _xb  # noqa: E402
 
 for _name in list(_xb._backend_factories):
-    if _name != "cpu":
+    # Keep the built-in backends registered — Pallas's import registers
+    # lowering rules for platform "tpu" and fails if the platform vanished —
+    # but drop third-party tunnel plugins (axon) that can hang at init.
+    if _name not in ("cpu", "tpu", "cuda", "rocm"):
         _xb._backend_factories.pop(_name, None)
 
 # sitecustomize imports jax before this file runs, so JAX_PLATFORMS=axon from
