@@ -64,6 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no_guess", action="store_true",
                    help="Do not use solution found on previous time moment as "
                         "initial guess for the next one.")
+    p.add_argument("--resume", action="store_true",
+                   help="Resume an interrupted run: skip frames already "
+                        "present in the output file, warm-start from its "
+                        "last solution and append (requires the same inputs "
+                        "and flags as the original run).")
     p.add_argument("--use_cpu", action="store_true",
                    help="Perform all calculations on CPUs (fp64 parity profile).")
     p.add_argument("--parallel_read", action="store_true",
@@ -97,6 +102,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Fused Pallas iteration sweep: one HBM read of the "
                           "RTM per iteration instead of two (applies when "
                           "the pixel axis is not sharded).")
+    tpu.add_argument("--multihost", action="store_true",
+                     help="Multi-host run (one process per host, e.g. a TPU "
+                          "pod slice): initialize the JAX multi-controller "
+                          "runtime, mesh over ALL hosts' devices, each "
+                          "process reads only its RTM row stripes, process "
+                          "0 writes the output (with --resume the output "
+                          "file must be on a filesystem visible to every "
+                          "host).")
     return p
 
 
@@ -142,6 +155,11 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # Heavy imports deferred so `--help` stays instant.
     import jax
+
+    if args.multihost:
+        from sartsolver_tpu.parallel import multihost as mh
+
+        mh.initialize()
 
     from sartsolver_tpu.config import SolverOptions, parse_time_intervals
     from sartsolver_tpu.io import hdf5files as hf
@@ -215,8 +233,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             rows, cols, vals = read_laplacian(args.laplacian_file, nvoxel)
             lap = make_laplacian(rows, cols, vals, dtype=opts.dtype)
 
-        rtm = read_rtm_block(sorted_matrix_files, rtm_name, npixel, nvoxel, 0)
-
         n_vox = args.voxel_shards
         if args.pixel_shards is not None:
             n_pix = args.pixel_shards
@@ -231,11 +247,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
         mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
-        solver = DistributedSARTSolver(rtm, lap, opts=opts, mesh=mesh)
+        if args.multihost:
+            # striped per-process ingest: each host reads only the RTM rows
+            # its devices hold (the reference's per-rank read, main.cpp:76-86)
+            rtm = mh.read_and_shard_rtm(
+                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                dtype=opts.rtm_dtype or opts.dtype,
+            )
+            solver = DistributedSARTSolver(
+                rtm, lap, opts=opts, mesh=mesh, npixel=npixel, nvoxel=nvoxel
+            )
+        else:
+            rtm = read_rtm_block(sorted_matrix_files, rtm_name, npixel, nvoxel, 0)
+            solver = DistributedSARTSolver(rtm, lap, opts=opts, mesh=mesh)
 
         grid = make_voxel_grid(
             next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
         )
+
+        from sartsolver_tpu.io.solution import read_resume_state
+
+        resume_state = (
+            read_resume_state(args.output_file, camera_names, nvoxel)
+            if args.resume else None
+        )
+        written_times = (
+            resume_state.times if resume_state is not None else np.empty(0)
+        )
+
+        def already_written(t: float) -> bool:
+            return bool(np.any(np.abs(written_times - t) <= 1e-12))
 
         # ---- frame loop (main.cpp:131-140) -------------------------------
         import contextlib
@@ -246,10 +287,36 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         from sartsolver_tpu.utils.prefetch import FramePrefetcher
 
-        with profiler_ctx, SolutionWriter(
-            args.output_file, camera_names, nvoxel,
-            max_cache_size=args.max_cached_solutions,
-        ) as writer, FramePrefetcher(composite_image) as frames:
+        # Multi-host: every process runs the (collective) frame loop, only
+        # process 0 writes output and prints (the reference's rank-0 gating,
+        # main.cpp:134-137).
+        primary = (not args.multihost) or mh.is_primary()
+
+        class _NullWriter:
+            def add(self, *a):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *exc):
+                pass
+
+        writer_ctx = (
+            SolutionWriter(
+                args.output_file, camera_names, nvoxel,
+                max_cache_size=args.max_cached_solutions,
+                # pass the already-read state so the file is inspected once
+                resume=resume_state if resume_state is not None else False,
+            )
+            if primary else _NullWriter()
+        )
+
+        with profiler_ctx, writer_ctx as writer, FramePrefetcher(composite_image) as frames:
+            if resume_state is not None:
+                frames = (
+                    item for item in frames if not already_written(item[1])
+                )
             if args.batch_frames > 1:
                 pending = []
 
@@ -270,7 +337,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     for b, (_, ftime, cam_times) in enumerate(pending):
                         writer.add(result.solution[b], int(result.status[b]),
                                    ftime, cam_times)
-                        print(f"Processed in: {per_frame_ms} ms")
+                        if primary:
+                            print(f"Processed in: {per_frame_ms} ms")
                     pending.clear()
 
                 for item in frames:
@@ -281,15 +349,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                     flush_batch()
             else:
                 warm: Optional[np.ndarray] = None
+                if resume_state is not None and not args.no_guess:
+                    warm = resume_state.last_solution
                 for frame, ftime, cam_times in frames:
                     t0 = _time.perf_counter()
                     result = solver.solve(frame, f0=warm)
                     writer.add(result.solution, result.status, ftime, cam_times)
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
-                    print(f"Processed in: {elapsed_ms} ms")
+                    if primary:
+                        print(f"Processed in: {elapsed_ms} ms")
                     warm = None if args.no_guess else result.solution
 
-        grid.write_hdf5(args.output_file, "voxel_map")
+        if primary:
+            import h5py
+
+            with h5py.File(args.output_file, "a") as f:
+                has_grid = "voxel_map" in f
+            if not has_grid:  # resumed runs already wrote the grid
+                grid.write_hdf5(args.output_file, "voxel_map")
     except KeyError as err:
         # h5py raises KeyError for missing datasets/attributes in otherwise
         # openable files; surface it as the fail-fast message + exit 1 the

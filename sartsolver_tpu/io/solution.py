@@ -5,15 +5,77 @@ times are buffered per frame and flushed every ``max_cache_size`` frames and
 on close; the first flush creates extendible chunked datasets
 (``solution/value [T, nvoxel]``, ``time``, ``time_<camera>``, ``status``),
 later flushes extend + append. Incremental flushing is the reference's only
-resilience mechanism (a crash loses at most one cache window).
+resilience mechanism (a crash loses at most one cache window); this module
+additionally supports *resuming* into an existing output file — the
+extendible-dataset layout makes a crashed/interrupted run restartable from
+the last flushed frame, which the reference cannot do (it truncates its
+output on every start, solution.cpp:64).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+import os
+from typing import Dict, List, NamedTuple, Optional, Sequence
 
 import h5py
 import numpy as np
+
+
+class ResumeState(NamedTuple):
+    """What a previous (possibly interrupted) run already produced."""
+
+    times: np.ndarray  # [T] frame times already written
+    last_solution: Optional[np.ndarray]  # warm start for the next frame
+
+
+def read_resume_state(
+    filename: str, camera_names: Sequence[str], nvoxel: int
+) -> Optional[ResumeState]:
+    """Inspect an output file for frames written by a previous run.
+
+    Returns None when the file does not exist, holds no solutions yet, or
+    was torn mid-creation (``status`` is created last in ``_create``, so a
+    missing ``status`` marks an interrupted first flush — start fresh).
+    Raises ValueError when the file exists but is inconsistent with this
+    run's problem (different nvoxel or camera set) — resuming into it would
+    corrupt the series.
+
+    Crash consistency: ``_update`` writes the per-frame datasets one at a
+    time, so after a mid-flush kill their lengths can disagree. A frame
+    counts as completed only if EVERY dataset has it; the shortest dataset
+    is the authority and the writer truncates any torn tail before
+    appending.
+    """
+    if not os.path.exists(filename):
+        return None
+    with h5py.File(filename, "r") as f:
+        if "solution" not in f or "value" not in f["solution"]:
+            return None
+        group = f["solution"]
+        if "status" not in group or "time" not in group:
+            return None  # torn first flush — recreate from scratch
+        value = group["value"]
+        if value.shape[1] != nvoxel:
+            raise ValueError(
+                f"Cannot resume into {filename}: it holds solutions of "
+                f"{value.shape[1]} voxels, this problem has {nvoxel}."
+            )
+        expected = {f"time_{name}" for name in camera_names}
+        have = {k for k in group if k.startswith("time_")}
+        if expected != have:
+            raise ValueError(
+                f"Cannot resume into {filename}: camera set mismatch "
+                f"(file has {sorted(have)}, run has {sorted(expected)})."
+            )
+        completed = min(
+            value.shape[0],
+            group["time"].shape[0],
+            group["status"].shape[0],
+            *(group[k].shape[0] for k in expected),
+        )
+        times = group["time"][:completed]
+        last = value[completed - 1, :] if completed else None
+        return ResumeState(times, last)
 
 
 class SolutionWriter:
@@ -23,7 +85,14 @@ class SolutionWriter:
         camera_names: Sequence[str],
         nvoxel: int,
         max_cache_size: int = 100,
+        resume: "bool | ResumeState" = False,
     ):
+        """``resume`` may be True (the file is inspected here) or a
+        :class:`ResumeState` the caller already read (avoids a second pass
+        over the file). When resuming, any torn tail a mid-flush crash left
+        behind — datasets longer than the completed-frame count — is
+        truncated immediately, so appends continue from a consistent
+        state."""
         if nvoxel == 0:
             raise ValueError("Argument nvoxel must be positive.")
         if max_cache_size == 0:
@@ -31,7 +100,13 @@ class SolutionWriter:
         self.filename = filename
         self.nvox = nvoxel
         self.max_cache_size = max_cache_size
-        self.first_flush = True
+        state = (
+            read_resume_state(filename, camera_names, nvoxel)
+            if resume is True else (resume or None)
+        )
+        self.first_flush = state is None
+        if state is not None:
+            self._truncate_torn_tail(len(state.times))
         self._solutions: List[np.ndarray] = []
         self._status: List[int] = []
         self._time: List[float] = []
@@ -79,6 +154,19 @@ class SolutionWriter:
         self.close()
 
     # -- HDF5 --------------------------------------------------------------
+    def _truncate_torn_tail(self, completed: int) -> None:
+        """Shrink every per-frame dataset to the completed-frame count (a
+        mid-flush crash can leave them at different lengths)."""
+        with h5py.File(self.filename, "r+") as f:
+            group = f["solution"]
+            for key in group:
+                dset = group[key]
+                if dset.shape[0] > completed:
+                    if key == "value":
+                        dset.resize((completed, dset.shape[1]))
+                    else:
+                        dset.resize((completed,))
+
     def _create(self) -> None:
         """First flush: new file with extendible datasets (solution.cpp:60-112).
 

@@ -1,0 +1,146 @@
+"""Multi-host (multi-process) distributed execution.
+
+The reference scales across nodes with MPI: every rank reads only its pixel
+row block of the RTM (main.cpp:67-68, raytransfer.cpp:49) and reductions
+run over MPI_COMM_WORLD. The TPU-native equivalent is JAX's multi-controller
+runtime: one process per host, `jax.distributed.initialize`, a global
+``('pixels', 'voxels')`` mesh over all hosts' devices, and the same jitted
+solver program — XLA routes the psums over ICI within a slice and DCN
+across slices. Nothing in the solver changes between single- and
+multi-host; this module supplies the pieces that are host-topology-aware:
+
+- :func:`initialize` — bring up the multi-controller runtime (the
+  reference's MPI_Init, main.cpp:63).
+- :func:`read_and_shard_rtm` — every process reads only the row stripes its
+  own devices will hold (the reference's per-rank striped HDF5 read) and
+  assembles the global sharded array without any host ever materializing
+  the full matrix.
+- :func:`make_global` / :func:`fetch` — stage host data into a global
+  sharded array and gather device results back, working identically in
+  single- and multi-process runs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sartsolver_tpu.io.raytransfer import read_rtm_block
+from sartsolver_tpu.parallel.mesh import (
+    COL_ALIGN,
+    PIXEL_AXIS,
+    ROW_ALIGN,
+    VOXEL_AXIS,
+    padded_size,
+)
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Start the multi-controller runtime (no-op if already initialized).
+
+    With no arguments, coordination is discovered from the environment —
+    automatic on Cloud TPU pods, or via JAX's standard
+    ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as err:  # already initialized
+        if "already initialized" not in str(err):
+            raise
+    except ValueError as err:
+        # No coordinator discoverable (not on a pod, no JAX_COORDINATOR_*
+        # env): a single-process run needs no coordination service.
+        if "coordinator_address" not in str(err):
+            raise
+
+
+def is_primary() -> bool:
+    """The process that owns user-facing output (the reference's rank 0)."""
+    return jax.process_index() == 0
+
+
+def read_and_shard_rtm(
+    sorted_matrix_files: Dict[str, List[str]],
+    rtm_name: str,
+    npixel: int,
+    nvoxel: int,
+    mesh,
+    *,
+    dtype,
+) -> jax.Array:
+    """Assemble the global padded RTM, each process reading only its rows.
+
+    Every process reads each pixel row stripe that one of its own devices
+    will hold — the reference's per-rank block read (raytransfer.cpp:49,
+    83-88) — pads it to the device block shape, and the stripes are
+    assembled into one global array sharded ``P('pixels', 'voxels')``. No
+    process ever holds more than its devices' share (plus one transient
+    row stripe during the read).
+    """
+    n_pix = mesh.shape[PIXEL_AXIS]
+    n_vox = mesh.shape.get(VOXEL_AXIS, 1)
+    padded_rows = padded_size(npixel, n_pix * ROW_ALIGN)
+    padded_cols = padded_size(nvoxel, n_vox * COL_ALIGN)
+    row_block = padded_rows // n_pix
+    col_block = padded_cols // n_vox
+    sharding = NamedSharding(mesh, P(PIXEL_AXIS, VOXEL_AXIS))
+
+    # Group this process's devices by row block so each stripe is read once.
+    mine: Dict[int, List] = {}
+    for (i, j), dev in np.ndenumerate(mesh.devices):
+        if dev.process_index == jax.process_index():
+            mine.setdefault(int(i), []).append((int(j), dev))
+
+    arrays = []
+    np_dtype = np.dtype(dtype)
+    for i, cols in sorted(mine.items()):
+        r0 = i * row_block
+        rows_have = max(0, min(npixel - r0, row_block))
+        stripe = None
+        if rows_have > 0:
+            stripe = read_rtm_block(
+                sorted_matrix_files, rtm_name, rows_have, nvoxel, r0,
+                dtype=np.float32,
+            )
+        for j, dev in sorted(cols):
+            c0 = j * col_block
+            block = np.zeros((row_block, col_block), np_dtype)
+            if stripe is not None:
+                cols_have = max(0, min(nvoxel - c0, col_block))
+                if cols_have > 0:
+                    block[:rows_have, :cols_have] = stripe[:, c0:c0 + cols_have]
+            arrays.append(jax.device_put(block, dev))
+
+    return jax.make_array_from_single_device_arrays(
+        (padded_rows, padded_cols), sharding, arrays
+    )
+
+
+def make_global(host_value: np.ndarray, mesh, spec: P) -> jax.Array:
+    """Stage a host array (same on every process) into a global sharded
+    array; works with non-addressable devices, unlike ``device_put``."""
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_callback(
+        host_value.shape, sharding, lambda idx: host_value[idx]
+    )
+
+
+def fetch(x: jax.Array) -> np.ndarray:
+    """Materialize a (possibly cross-process sharded) global array on every
+    host — the reference's implicit 'replicated result on every rank'."""
+    if jax.process_count() == 1 or x.is_fully_replicated:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)

@@ -53,6 +53,24 @@ from sartsolver_tpu.parallel.mesh import (
 )
 
 
+def _stage(host_array, mesh, spec) -> jax.Array:
+    """Host -> global sharded array; multi-host safe (device_put cannot
+    target non-addressable devices)."""
+    if jax.process_count() == 1:
+        return jax.device_put(host_array, NamedSharding(mesh, spec))
+    from sartsolver_tpu.parallel.multihost import make_global
+
+    return make_global(np.asarray(host_array), mesh, spec)
+
+
+def _fetch(x) -> np.ndarray:
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from sartsolver_tpu.parallel.multihost import fetch
+
+    return fetch(x)
+
+
 def _shard_laplacian(
     laplacian: LaplacianCOO, n_voxel_shards: int, voxel_block: int, dtype
 ) -> LaplacianCOO:
@@ -91,12 +109,19 @@ class DistributedSARTSolver:
 
     def __init__(
         self,
-        rtm: np.ndarray,
+        rtm,
         laplacian: Optional[LaplacianCOO] = None,
         *,
         opts: SolverOptions,
         mesh=None,
+        npixel: Optional[int] = None,
+        nvoxel: Optional[int] = None,
     ):
+        """``rtm`` is either a host ``np.ndarray`` (padded, cast and
+        device_put here — single-host path) or an already-sharded global
+        ``jax.Array`` built by ``parallel.multihost.read_and_shard_rtm``
+        (multi-host path: pass the logical ``npixel``/``nvoxel`` since the
+        device array carries only the padded shape)."""
         self.opts = opts
         self.mesh = mesh if mesh is not None else make_mesh()
         if PIXEL_AXIS not in self.mesh.shape or VOXEL_AXIS not in self.mesh.shape:
@@ -106,10 +131,20 @@ class DistributedSARTSolver:
             )
         self.n_pixel_shards = self.mesh.shape[PIXEL_AXIS]
         self.n_voxel_shards = self.mesh.shape.get(VOXEL_AXIS, 1)
-        self.npixel, self.nvoxel = rtm.shape
 
         dtype = jnp.dtype(opts.dtype)
         rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
+
+        presharded = isinstance(rtm, jax.Array) and not isinstance(rtm, np.ndarray)
+        if presharded:
+            if npixel is None or nvoxel is None:
+                raise ValueError(
+                    "A pre-sharded RTM needs explicit npixel/nvoxel (the "
+                    "device array holds only the padded shape)."
+                )
+            self.npixel, self.nvoxel = npixel, nvoxel
+        else:
+            self.npixel, self.nvoxel = np.asarray(rtm).shape
 
         target_rows = padded_size(self.npixel, self.n_pixel_shards * ROW_ALIGN)
         target_cols = padded_size(self.nvoxel, self.n_voxel_shards * COL_ALIGN)
@@ -117,17 +152,26 @@ class DistributedSARTSolver:
         self.padded_nvoxel = target_cols
         self.voxel_block = target_cols // self.n_voxel_shards
 
-        # Single-copy staging: the RTM is the dominant host allocation (the
-        # reference targets tens-to-hundreds of GB), so pad+cast in one
-        # buffer, and skip the copy entirely when layout already matches.
-        rtm_np = np.asarray(rtm)
-        if (target_rows, target_cols) != rtm_np.shape or rtm_np.dtype != np.dtype(rtm_dtype):
-            buf = np.zeros((target_rows, target_cols), dtype=np.dtype(rtm_dtype))
-            buf[: self.npixel, : self.nvoxel] = rtm_np
-            rtm_np = buf
-        rtm_dev = jax.device_put(
-            rtm_np, NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
-        )
+        if presharded:
+            if rtm.shape != (target_rows, target_cols):
+                raise ValueError(
+                    f"Pre-sharded RTM has shape {tuple(rtm.shape)}, expected "
+                    f"padded {(target_rows, target_cols)} for "
+                    f"{self.npixel}x{self.nvoxel} on this mesh."
+                )
+            rtm_dev = rtm if rtm.dtype == rtm_dtype else rtm.astype(rtm_dtype)
+        else:
+            # Single-copy staging: the RTM is the dominant host allocation
+            # (the reference targets tens-to-hundreds of GB), so pad+cast in
+            # one buffer, and skip the copy when layout already matches.
+            rtm_np = np.asarray(rtm)
+            if (target_rows, target_cols) != rtm_np.shape or rtm_np.dtype != np.dtype(rtm_dtype):
+                buf = np.zeros((target_rows, target_cols), dtype=np.dtype(rtm_dtype))
+                buf[: self.npixel, : self.nvoxel] = rtm_np
+                rtm_np = buf
+            rtm_dev = jax.device_put(
+                rtm_np, NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
+            )
 
         # Size-1 mesh axes carry no reductions; dropping their names lets the
         # solver pick the fused Pallas sweep (no pixel-axis psum in the loop).
@@ -151,11 +195,11 @@ class DistributedSARTSolver:
             sharded_lap = _shard_laplacian(
                 laplacian, self.n_voxel_shards, self.voxel_block, dtype
             )
-            lap_sharding = NamedSharding(self.mesh, P(VOXEL_AXIS, None))
+            lap_spec = P(VOXEL_AXIS, None)
             laplacian = LaplacianCOO(
-                jax.device_put(sharded_lap.rows, lap_sharding),
-                jax.device_put(sharded_lap.cols, lap_sharding),
-                jax.device_put(sharded_lap.vals, lap_sharding),
+                _stage(sharded_lap.rows, self.mesh, lap_spec),
+                _stage(sharded_lap.cols, self.mesh, lap_spec),
+                _stage(sharded_lap.vals, self.mesh, lap_spec),
             )
 
         self.problem = SARTProblem(rtm_dev, ray_density, ray_length, laplacian)
@@ -225,26 +269,22 @@ class DistributedSARTSolver:
             )
             norms[b], msqs[b] = norm, msq
 
-        g_dev = jax.device_put(
-            g_stage, NamedSharding(self.mesh, P(None, PIXEL_AXIS))
-        )
+        g_dev = _stage(g_stage, self.mesh, P(None, PIXEL_AXIS))
         use_guess = f0 is None
         f0_np = np.zeros((B, self.padded_nvoxel), dtype)
         if not use_guess:
             f0_np[:, : self.nvoxel] = np.asarray(f0, np.float64) / norms[:, None]
-        f0_dev = jax.device_put(
-            f0_np, NamedSharding(self.mesh, P(None, VOXEL_AXIS))
-        )
+        f0_dev = _stage(f0_np, self.mesh, P(None, VOXEL_AXIS))
 
         res = self._batch_fn(use_guess)(
             self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev
         )
-        solution = np.asarray(res.solution, np.float64)[:, : self.nvoxel] * norms[:, None]
+        solution = _fetch(res.solution).astype(np.float64)[:, : self.nvoxel] * norms[:, None]
         return SolveResult(
             solution,
-            np.asarray(res.status),
-            np.asarray(res.iterations),
-            np.asarray(res.convergence, np.float64),
+            _fetch(res.status),
+            _fetch(res.iterations),
+            _fetch(res.convergence).astype(np.float64),
         )
 
     def solve(self, measurement, f0=None) -> SolveResult:

@@ -133,3 +133,85 @@ def test_bad_input_file_returns_1(world, tmp_path, capsys):
         f.create_group("mystery")
     assert main([bad, paths["img_a"]]) == 1
     assert "neither an RTM" in capsys.readouterr().err
+
+
+def test_resume_appends_remaining_frames(world, capsys):
+    """--resume skips already-written frames, warm-starts from the last
+    solution and appends — the final file matches a single full run."""
+    paths, H, f_true, times, scales = world
+
+    # reference: one uninterrupted run
+    ref_out = paths["output"] + ".ref.h5"
+    assert run_cli({**paths, "output": ref_out}) == 0
+    with h5py.File(ref_out, "r") as f:
+        ref_value = f["solution/value"][:]
+        ref_times = f["solution/time"][:]
+
+    # "interrupted" run: only the first half of the time range...
+    assert run_cli(paths, "-t", "0.05:0.25") == 0
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/value"].shape[0] == 2
+    # ...then resume over the full range
+    capsys.readouterr()
+    assert run_cli(paths, "--resume") == 0
+    assert capsys.readouterr().out.count("Processed in:") == len(times) - 2
+
+    with h5py.File(paths["output"], "r") as f:
+        value = f["solution/value"][:]
+        t = f["solution/time"][:]
+        assert "voxel_map" in f
+    np.testing.assert_allclose(t, ref_times)
+    np.testing.assert_allclose(value, ref_value, rtol=1e-10, atol=1e-13)
+
+    # resuming a complete file is a no-op, not an error or a duplicate
+    capsys.readouterr()
+    assert run_cli(paths, "--resume") == 0
+    assert capsys.readouterr().out.count("Processed in:") == 0
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/value"].shape[0] == len(times)
+
+
+def test_resume_rejects_incompatible_file(world, capsys):
+    paths, *_ = world
+    with h5py.File(paths["output"], "w") as f:
+        f.create_dataset("solution/value", data=np.zeros((1, 3)),
+                         maxshape=(None, 3), chunks=(1, 3))
+        f.create_dataset("solution/time", data=np.asarray([0.1]),
+                         maxshape=(None,), chunks=(1,))
+        f.create_dataset("solution/status", data=np.asarray([0], np.int32),
+                         maxshape=(None,), chunks=(1,))
+    assert run_cli(paths, "--resume") == 1
+    assert "Cannot resume" in capsys.readouterr().err
+
+
+def test_resume_truncates_torn_flush(world, capsys):
+    """A crash mid-flush leaves per-frame datasets at different lengths;
+    resume must trust only fully-written frames and redo the torn one."""
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths) == 0
+    with h5py.File(paths["output"], "r+") as f:
+        # simulate _update killed after extending time/status but before
+        # writing the value rows for a 5th frame
+        f["solution/time"].resize((5,))
+        f["solution/time"][4] = 0.9
+        f["solution/status"].resize((5,))
+    capsys.readouterr()
+    assert run_cli(paths, "--resume") == 0
+    assert capsys.readouterr().out.count("Processed in:") == 0  # 4 complete
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/time"].shape == (4,)  # torn tail truncated
+        assert f["solution/status"].shape == (4,)
+        assert f["solution/value"].shape[0] == 4
+
+
+def test_resume_recreates_torn_first_flush(world):
+    """status is created last; a file without it is a torn first flush and
+    must be rebuilt from scratch rather than resumed or rejected."""
+    paths, H, f_true, times, scales = world
+    with h5py.File(paths["output"], "w") as f:
+        f.create_dataset("solution/value", data=np.zeros((1, fx.NVOXEL)),
+                         maxshape=(None, fx.NVOXEL), chunks=(1, fx.NVOXEL))
+    assert run_cli(paths, "--resume") == 0
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/value"].shape[0] == len(times)
+        assert f["solution/status"].shape[0] == len(times)
