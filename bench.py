@@ -93,9 +93,12 @@ def main() -> int:
             opts=opts, axis_name=None, use_guess=True,
         )
 
-    # warmup/compile
+    # warmup/compile. Synchronize by fetching the solution to host —
+    # block_until_ready is unreliable on tunneled backends (observed
+    # returning before execution completes), and the 256 KB D2H is
+    # negligible against the multi-second solve.
     res = run()
-    res.solution.block_until_ready()
+    np.asarray(res.solution)
     # with tol=1e-30 the loop early-exits only on exact fp32 fixed point
     # (delta-conv == 0); use the measured trip count either way
     n_done = max(int(res.iterations), 1)
@@ -104,7 +107,7 @@ def main() -> int:
     for _ in range(3):
         t0 = time.perf_counter()
         res = run()
-        res.solution.block_until_ready()
+        np.asarray(res.solution)
         best = min(best, time.perf_counter() - t0)
 
     iters_per_sec = n_done / best

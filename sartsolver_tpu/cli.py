@@ -72,8 +72,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--use_cpu", action="store_true",
                    help="Perform all calculations on CPUs (fp64 parity profile).")
     p.add_argument("--parallel_read", action="store_true",
-                   help="Accepted for reference-CLI compatibility (host reads "
-                        "are always direct here).")
+                   help="All hosts read their RTM stripes simultaneously "
+                        "(multi-host runs serialize reads host-by-host by "
+                        "default, matching the reference's HDD-friendly "
+                        "round-robin; single-host reads are always direct).")
     p.add_argument("input_files", nargs="*",
                    help="List of ray transfer matrix and camera image hdf5 files.")
 
@@ -102,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Fused Pallas iteration sweep: one HBM read of the "
                           "RTM per iteration instead of two (applies when "
                           "the pixel axis is not sharded).")
+    tpu.add_argument("--timing", action="store_true",
+                     help="Print a per-phase wall-clock summary (validation, "
+                          "RTM ingest, per-frame solve — the first frame "
+                          "includes XLA compilation — and output writes) at "
+                          "the end of the run.")
     tpu.add_argument("--multihost", action="store_true",
                      help="Multi-host run (one process per host, e.g. a TPU "
                           "pod slice): initialize the JAX multi-controller "
@@ -172,6 +179,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     from sartsolver_tpu.parallel.mesh import make_mesh
     from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
 
+    from sartsolver_tpu.utils.timing import PhaseTimer
+
+    timer = PhaseTimer()
+    _t = _time.perf_counter()
+
+    def _mark(phase: str) -> None:
+        nonlocal _t
+        now = _time.perf_counter()
+        timer.add(phase, now - _t)
+        _t = now
+
     try:
         time_intervals = parse_time_intervals(args.time_range)
 
@@ -192,11 +210,21 @@ def main(argv: Optional[List[str]] = None) -> int:
         npixel, nvoxel = hf.get_total_rtm_size(sorted_matrix_files)
         rtm_frame_masks = hf.read_rtm_frame_masks(sorted_matrix_files)
 
+        # Resume compatibility is checkable from metadata alone — fail now,
+        # before the (potentially tens-of-GB) RTM ingest, not after.
+        from sartsolver_tpu.io.solution import read_resume_state
+
+        resume_state = (
+            read_resume_state(args.output_file, camera_names, nvoxel)
+            if args.resume else None
+        )
+
         # ---- data model (main.cpp:70-86) ---------------------------------
         composite_image = CompositeImage(
             sorted_image_files, rtm_frame_masks, time_intervals,
             npixel, 0, max_cache_size=args.max_cached_frames,
         )
+        _mark("validate + index inputs")
 
         if args.use_cpu:
             opts = SolverOptions.cpu_parity(
@@ -253,6 +281,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             rtm = mh.read_and_shard_rtm(
                 sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
                 dtype=opts.rtm_dtype or opts.dtype,
+                serialize=not args.parallel_read,
             )
             solver = DistributedSARTSolver(
                 rtm, lap, opts=opts, mesh=mesh, npixel=npixel, nvoxel=nvoxel
@@ -260,17 +289,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             rtm = read_rtm_block(sorted_matrix_files, rtm_name, npixel, nvoxel, 0)
             solver = DistributedSARTSolver(rtm, lap, opts=opts, mesh=mesh)
+        _mark("ingest RTM + upload")
 
         grid = make_voxel_grid(
             next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
         )
 
-        from sartsolver_tpu.io.solution import read_resume_state
-
-        resume_state = (
-            read_resume_state(args.output_file, camera_names, nvoxel)
-            if args.resume else None
-        )
         written_times = (
             resume_state.times if resume_state is not None else np.empty(0)
         )
@@ -333,6 +357,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                       stack.shape[1])),
                         ])
                     result = solver.solve_batch(stack)
+                    timer.add("solve batch", _time.perf_counter() - t0)
                     per_frame_ms = (_time.perf_counter() - t0) * 1e3 / len(pending)
                     for b, (_, ftime, cam_times) in enumerate(pending):
                         writer.add(result.solution[b], int(result.status[b]),
@@ -356,10 +381,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     result = solver.solve(frame, f0=warm)
                     writer.add(result.solution, result.status, ftime, cam_times)
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
+                    timer.add("solve frame", elapsed_ms / 1e3)
                     if primary:
                         print(f"Processed in: {elapsed_ms} ms")
                     warm = None if args.no_guess else result.solution
 
+        _mark("frame loop (solve + prefetch + flush)")
         if primary:
             import h5py
 
@@ -367,6 +394,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 has_grid = "voxel_map" in f
             if not has_grid:  # resumed runs already wrote the grid
                 grid.write_hdf5(args.output_file, "voxel_map")
+        _mark("write voxel map")
+        if args.timing and primary:
+            print(timer.summary())
     except KeyError as err:
         # h5py raises KeyError for missing datasets/attributes in otherwise
         # openable files; surface it as the fail-fast message + exit 1 the

@@ -49,14 +49,17 @@ def initialize(
     automatic on Cloud TPU pods, or via JAX's standard
     ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``.
     """
+    already = getattr(jax.distributed, "is_initialized", None)
+    if already is not None and already():
+        return
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError as err:  # already initialized
-        if "already initialized" not in str(err):
+    except RuntimeError as err:  # already initialized (race or old JAX)
+        if "only be called once" not in str(err):
             raise
     except ValueError as err:
         # No coordinator discoverable (not on a pod, no JAX_COORDINATOR_*
@@ -78,6 +81,7 @@ def read_and_shard_rtm(
     mesh,
     *,
     dtype,
+    serialize: bool = False,
 ) -> jax.Array:
     """Assemble the global padded RTM, each process reading only its rows.
 
@@ -87,6 +91,11 @@ def read_and_shard_rtm(
     assembled into one global array sharded ``P('pixels', 'voxels')``. No
     process ever holds more than its devices' share (plus one transient
     row stripe during the read).
+
+    ``serialize=True`` staggers the reads process-by-process with a global
+    barrier between turns — the reference's default HDD-friendly
+    round-robin ingest (main.cpp:78-86, MPI_Barrier at :84); leave False
+    for parallel reads (the reference's ``--parallel_read``).
     """
     n_pix = mesh.shape[PIXEL_AXIS]
     n_vox = mesh.shape.get(VOXEL_AXIS, 1)
@@ -102,25 +111,38 @@ def read_and_shard_rtm(
         if dev.process_index == jax.process_index():
             mine.setdefault(int(i), []).append((int(j), dev))
 
-    arrays = []
-    np_dtype = np.dtype(dtype)
-    for i, cols in sorted(mine.items()):
-        r0 = i * row_block
-        rows_have = max(0, min(npixel - r0, row_block))
-        stripe = None
-        if rows_have > 0:
-            stripe = read_rtm_block(
-                sorted_matrix_files, rtm_name, rows_have, nvoxel, r0,
-                dtype=np.float32,
-            )
-        for j, dev in sorted(cols):
-            c0 = j * col_block
-            block = np.zeros((row_block, col_block), np_dtype)
-            if stripe is not None:
-                cols_have = max(0, min(nvoxel - c0, col_block))
-                if cols_have > 0:
-                    block[:rows_have, :cols_have] = stripe[:, c0:c0 + cols_have]
-            arrays.append(jax.device_put(block, dev))
+    def read_my_blocks() -> list:
+        arrays = []
+        np_dtype = np.dtype(dtype)
+        for i, cols in sorted(mine.items()):
+            r0 = i * row_block
+            rows_have = max(0, min(npixel - r0, row_block))
+            stripe = None
+            if rows_have > 0:
+                stripe = read_rtm_block(
+                    sorted_matrix_files, rtm_name, rows_have, nvoxel, r0,
+                    dtype=np.float32,
+                )
+            for j, dev in sorted(cols):
+                c0 = j * col_block
+                block = np.zeros((row_block, col_block), np_dtype)
+                if stripe is not None:
+                    cols_have = max(0, min(nvoxel - c0, col_block))
+                    if cols_have > 0:
+                        block[:rows_have, :cols_have] = stripe[:, c0:c0 + cols_have]
+                arrays.append(jax.device_put(block, dev))
+        return arrays
+
+    if serialize and jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        arrays = []
+        for turn in range(jax.process_count()):
+            if turn == jax.process_index():
+                arrays = read_my_blocks()
+            multihost_utils.sync_global_devices(f"sart_rtm_read_turn_{turn}")
+    else:
+        arrays = read_my_blocks()
 
     return jax.make_array_from_single_device_arrays(
         (padded_rows, padded_cols), sharding, arrays

@@ -135,7 +135,14 @@ class DistributedSARTSolver:
         dtype = jnp.dtype(opts.dtype)
         rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
 
-        presharded = isinstance(rtm, jax.Array) and not isinstance(rtm, np.ndarray)
+        # Pre-sharded means the caller already distributed the (padded)
+        # matrix (multihost.read_and_shard_rtm); a plain single-device JAX
+        # array is host-stageable data like an ndarray, as before.
+        presharded = (
+            isinstance(rtm, jax.Array)
+            and not isinstance(rtm, np.ndarray)
+            and (not rtm.is_fully_addressable or len(rtm.sharding.device_set) > 1)
+        )
         if presharded:
             if npixel is None or nvoxel is None:
                 raise ValueError(

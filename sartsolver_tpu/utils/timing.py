@@ -1,0 +1,47 @@
+"""Per-phase wall-clock accounting for the frame pipeline.
+
+The reference's only built-in measurement is the per-frame solve time
+printed by rank 0 (main.cpp:128-137). That line is kept verbatim for
+parity; this module adds the phase breakdown the reference lacks —
+validation, RTM ingest, per-frame solve (the first sample includes XLA
+compilation), output writes — so a slow run can be attributed to host I/O
+vs device compute without a profiler. For kernel-level detail use
+``--profile_dir`` (jax.profiler traces).
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+
+class PhaseTimer:
+    """Accumulates wall time and hit counts per named phase."""
+
+    def __init__(self) -> None:
+        self._total: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+
+    @contextmanager
+    def phase(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0)
+
+    def add(self, name: str, seconds: float) -> None:
+        self._total[name] = self._total.get(name, 0.0) + seconds
+        self._count[name] = self._count.get(name, 0) + 1
+
+    def summary(self) -> str:
+        if not self._total:
+            return "timing: no phases recorded"
+        width = max(len(n) for n in self._total)
+        lines = ["timing summary (wall clock):"]
+        for name, total in self._total.items():
+            n = self._count[name]
+            per = f", {total / n * 1e3:8.1f} ms avg over {n}" if n > 1 else ""
+            lines.append(f"  {name:<{width}}  {total * 1e3:10.1f} ms{per}")
+        return "\n".join(lines)

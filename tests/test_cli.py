@@ -215,3 +215,13 @@ def test_resume_recreates_torn_first_flush(world):
     with h5py.File(paths["output"], "r") as f:
         assert f["solution/value"].shape[0] == len(times)
         assert f["solution/status"].shape[0] == len(times)
+
+
+def test_timing_flag_prints_summary(world, capsys):
+    paths, *_ = world
+    assert run_cli(paths, "--timing") == 0
+    out = capsys.readouterr().out
+    assert "timing summary" in out
+    for phase in ("validate + index inputs", "ingest RTM + upload",
+                  "solve frame", "write voxel map"):
+        assert phase in out

@@ -105,3 +105,18 @@ def test_make_global_and_fetch_roundtrip():
     g = mh.make_global(x, mesh, P("pixels", "voxels"))
     np.testing.assert_array_equal(mh.fetch(g), x)
     assert mh.is_primary()
+
+
+def test_single_device_jax_array_rtm_accepted(world):
+    """A plain (unsharded) JAX-resident RTM is host-stageable data, not a
+    pre-sharded global array — the README's library-API pattern."""
+    import jax.numpy as jnp
+
+    paths, H, f_true, times, scales = world
+    g = H @ (f_true * scales[0])
+    opts = SolverOptions(max_iterations=50, conv_tolerance=1e-6)
+    mesh = make_mesh(4, 2)
+    ref = DistributedSARTSolver(H.astype(np.float32), opts=opts, mesh=mesh).solve(g)
+    res = DistributedSARTSolver(jnp.asarray(H, jnp.float32), opts=opts, mesh=mesh).solve(g)
+    assert res.iterations == ref.iterations
+    np.testing.assert_allclose(res.solution, ref.solution, rtol=1e-6, atol=1e-9)
