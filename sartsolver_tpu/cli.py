@@ -104,6 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="Fused Pallas iteration sweep: one HBM read of the "
                           "RTM per iteration instead of two (applies when "
                           "the pixel axis is not sharded).")
+    tpu.add_argument("--debug_nans", action="store_true",
+                     help="Enable jax debug-NaN checking: abort with a "
+                          "traceback at the first NaN-producing op instead "
+                          "of propagating it into the solution (slow; "
+                          "debugging only).")
     tpu.add_argument("--timing", action="store_true",
                      help="Print a per-phase wall-clock summary (validation, "
                           "RTM ingest, per-frame solve — the first frame "
@@ -162,6 +167,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # Heavy imports deferred so `--help` stays instant.
     import jax
+
+    if args.debug_nans:
+        jax.config.update("jax_debug_nans", True)
 
     if args.multihost:
         from sartsolver_tpu.parallel import multihost as mh

@@ -136,12 +136,19 @@ class DistributedSARTSolver:
         rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
 
         # Pre-sharded means the caller already distributed the (padded)
-        # matrix (multihost.read_and_shard_rtm); a plain single-device JAX
-        # array is host-stageable data like an ndarray, as before.
+        # matrix (multihost.read_and_shard_rtm) — marked either by passing
+        # the logical sizes explicitly (a 1x1 mesh yields an ordinary
+        # single-device array, indistinguishable by sharding alone) or by a
+        # multi-device/cross-process sharding. A plain single-device JAX
+        # array without explicit sizes is host-stageable data, as before.
         presharded = (
             isinstance(rtm, jax.Array)
             and not isinstance(rtm, np.ndarray)
-            and (not rtm.is_fully_addressable or len(rtm.sharding.device_set) > 1)
+            and (
+                (npixel is not None and nvoxel is not None)
+                or not rtm.is_fully_addressable
+                or len(rtm.sharding.device_set) > 1
+            )
         )
         if presharded:
             if npixel is None or nvoxel is None:

@@ -11,9 +11,7 @@ vs device compute without a profiler. For kernel-level detail use
 
 from __future__ import annotations
 
-import time
-from contextlib import contextmanager
-from typing import Dict, Iterator
+from typing import Dict
 
 
 class PhaseTimer:
@@ -22,14 +20,6 @@ class PhaseTimer:
     def __init__(self) -> None:
         self._total: Dict[str, float] = {}
         self._count: Dict[str, int] = {}
-
-    @contextmanager
-    def phase(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.add(name, time.perf_counter() - t0)
 
     def add(self, name: str, seconds: float) -> None:
         self._total[name] = self._total.get(name, 0.0) + seconds

@@ -120,3 +120,26 @@ def test_single_device_jax_array_rtm_accepted(world):
     res = DistributedSARTSolver(jnp.asarray(H, jnp.float32), opts=opts, mesh=mesh).solve(g)
     assert res.iterations == ref.iterations
     np.testing.assert_allclose(res.solution, ref.solution, rtol=1e-6, atol=1e-9)
+
+
+def test_presharded_on_1x1_mesh_honors_logical_sizes(world):
+    """A 1x1 mesh yields an ordinary single-device padded array from
+    read_and_shard_rtm; explicit npixel/nvoxel must still mark it as
+    pre-sharded (regression: padded shape adopted as problem size)."""
+    paths, H, f_true, times, scales = world
+    files = _sorted_matrix_files(paths)
+    npixel, nvoxel = hf.get_total_rtm_size(files)
+    assert npixel % 8 != 0  # the regression needs a padded pixel count
+    import jax
+    mesh = make_mesh(1, 1, devices=jax.devices()[:1])
+    global_rtm = mh.read_and_shard_rtm(
+        files, "with_reflections", npixel, nvoxel, mesh, dtype="float32"
+    )
+    solver = DistributedSARTSolver(
+        global_rtm, opts=SolverOptions(max_iterations=30, conv_tolerance=1e-6),
+        mesh=mesh, npixel=npixel, nvoxel=nvoxel,
+    )
+    assert solver.npixel == npixel
+    g = H @ (f_true * scales[0])
+    res = solver.solve(g)
+    assert np.isfinite(res.solution).all()

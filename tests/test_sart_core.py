@@ -152,3 +152,34 @@ def test_guess_floor_applied_on_device_profile():
     problem = make_problem(H, opts=opts)
     res = solve(problem, g, opts=opts)
     assert np.isfinite(np.asarray(res.solution)).all()
+
+
+def test_bfloat16_rtm_tracks_fp32():
+    """rtm_dtype=bfloat16 (half HBM traffic) stays within bf16-mantissa
+    error of the fp32 solution."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import make_problem, solve
+
+    rng = np.random.default_rng(7)
+    P, V = 48, 256
+    H = rng.uniform(0.1, 1.0, (P, V)).astype(np.float32)
+    f_true = rng.uniform(0.5, 2.0, V)
+    g = H.astype(np.float64) @ f_true
+
+    base = SolverOptions(max_iterations=40, conv_tolerance=1e-12)
+    ref = solve(make_problem(H, opts=base), g, opts=base)
+    bf = dataclasses.replace(base, rtm_dtype="bfloat16")
+    problem = make_problem(H, opts=bf)
+    assert problem.rtm.dtype == jnp.bfloat16
+    res = solve(problem, g, opts=bf)
+
+    ref_sol = np.asarray(ref.solution, np.float64)
+    bf_sol = np.asarray(res.solution, np.float64)
+    rel = np.linalg.norm(bf_sol - ref_sol) / np.linalg.norm(ref_sol)
+    assert rel < 0.03, f"bf16 deviates {rel:.3%} from fp32"
+    # ray stats are computed in fp32 regardless of storage dtype
+    assert problem.ray_density.dtype == jnp.float32
