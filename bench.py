@@ -79,6 +79,15 @@ def main() -> int:
     # conv_tolerance tiny => fixed iteration count (measures iterations/sec,
     # not convergence luck).
     opts = SolverOptions(max_iterations=iters, conv_tolerance=1e-30)
+    # auto-fused path: verify the Pallas kernel compiles on this backend so
+    # a Mosaic regression degrades to the two-matmul path, not a failure
+    from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
+
+    resolved = resolve_fused_auto(opts)
+    if resolved is not opts:
+        print("fused sweep self-test failed; benching two-matmul path",
+              file=sys.stderr)
+    opts = resolved
 
     rtm = jnp.asarray(H)
     dens, length = compute_ray_stats(rtm, dtype=jnp.float32)

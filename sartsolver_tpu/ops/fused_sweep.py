@@ -88,6 +88,60 @@ def fused_available(npixel: int, nvoxel: int, rtm_itemsize: int, batch: int = 1)
     )
 
 
+_selftest_result: dict = {}
+
+
+def fused_selftest() -> bool:
+    """Compile and run a minimal fused sweep on the default backend.
+
+    The kernel is validated in interpreter mode by tests, but Mosaic (the
+    TPU Pallas compiler) can still reject a construct at compile time.
+    Drivers that *auto*-select the fused path call this once and fall back
+    to the two-matmul path if it fails, so a kernel-compile regression
+    degrades performance instead of breaking the solve. Result is cached
+    per backend.
+    """
+    backend = jax.default_backend()
+    if backend not in _selftest_result:
+        try:
+            rtm = jnp.ones((8, 256), jnp.float32)
+            w = jnp.full((1, 8), 0.5, jnp.float32)
+            f = jnp.zeros((1, 256), jnp.float32)
+            f_new, fitted = jax.jit(
+                lambda r, w, f: fused_sweep(r, w, f, [], lambda fp, bp: fp + bp)
+            )(rtm, w, f)
+            import numpy as _np
+
+            ok = bool(
+                _np.allclose(_np.asarray(f_new), 4.0)
+                and _np.allclose(_np.asarray(fitted), 4.0 * 256)
+            )
+        except Exception:
+            ok = False
+        _selftest_result[backend] = ok
+    return _selftest_result[backend]
+
+
+def resolve_fused_auto(opts, *, pixel_sharded: bool = False):
+    """Driver-level resolution of ``fused_sweep='auto'``.
+
+    Returns ``opts`` unchanged when auto-fusion is ineligible (non-TPU
+    backend, pixel-axis sharding — the solver declines those without
+    compiling anything) or when the self-test passes; returns a copy with
+    ``fused_sweep='off'`` when the kernel fails to compile on this backend.
+    Callers can warn when the returned object differs (``is not opts``).
+    """
+    if opts.fused_sweep != "auto":
+        return opts
+    if jax.default_backend() != "tpu" or pixel_sharded:
+        return opts
+    if fused_selftest():
+        return opts
+    import dataclasses
+
+    return dataclasses.replace(opts, fused_sweep="off")
+
+
 def _sweep_kernel(update_fn, n_aux, rtm_ref, w_ref, f_ref, *rest):
     aux_refs = rest[:n_aux]
     f_new_ref, fitted_ref = rest[n_aux:]

@@ -134,3 +134,11 @@ def test_block_picker():
     # bf16 halves the panel bytes -> at least as wide a block
     assert pick_block_voxels(8192, 65536, 2) >= pick_block_voxels(8192, 65536, 4)
     assert pick_block_voxels(8, 128, 4) == 128
+
+
+def test_selftest_returns_cached_bool():
+    from sartsolver_tpu.ops import fused_sweep as fs
+
+    first = fs.fused_selftest()
+    assert isinstance(first, bool)
+    assert fs.fused_selftest() is first  # cached per backend
