@@ -283,16 +283,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
         mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
-        # auto-fused path: compile self-test, skipped when the pixel axis is
-        # sharded (fusion ineligible there — no compile wasted); an explicit
-        # --fused_sweep on surfaces compile errors instead of degrading
-        from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
+        # auto-fused path: compile self-test, skipped when fusion is
+        # ineligible anyway (fp64 --use_cpu profile, sharded pixel axis —
+        # no compile wasted); an explicit --fused_sweep on surfaces compile
+        # errors instead of degrading
+        if not args.use_cpu:
+            from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
 
-        resolved = resolve_fused_auto(opts, pixel_sharded=n_pix > 1)
-        if resolved is not opts:
-            print("Warning: fused Pallas sweep failed its self-test on this "
-                  "backend; using the two-matmul path.", file=sys.stderr)
-        opts = resolved
+            resolved = resolve_fused_auto(opts, pixel_sharded=n_pix > 1)
+            if resolved is not opts:
+                print("Warning: fused Pallas sweep failed its self-test on "
+                      "this backend; using the two-matmul path.",
+                      file=sys.stderr)
+            opts = resolved
         if args.multihost:
             # striped per-process ingest: each host reads only the RTM rows
             # its devices hold (the reference's per-rank read, main.cpp:76-86)

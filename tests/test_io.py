@@ -1,6 +1,8 @@
 """IO layer tests on synthetic fixtures (discovery, sorting, consistency,
 RTM block reads, composite alignment, solution round trip)."""
 
+import os
+
 import numpy as np
 import pytest
 import h5py
@@ -300,3 +302,53 @@ class TestSolutionWriter:
             np.testing.assert_allclose(
                 f[f"solution/time_{fx.CAM_B}"][:], 0.1 * np.arange(5) + 0.003)
             assert f["solution/value"].maxshape == (None, fx.NVOXEL)
+
+
+class TestAlignmentTieBreaks:
+    """Table-driven pins for the subtle branches of the composite-frame
+    alignment (reference image.cpp:148-196): dedup of a frame picked by two
+    adjacent ticks, and moving a deduped frame's time to the closer tick."""
+
+    def _single_cam_world(self, tmp_path, frame_times):
+        d = str(tmp_path)
+        rtm = os.path.join(d, "rtm.h5")
+        img = os.path.join(d, "img.h5")
+        H_b = fx.make_rtm_matrices()[1]
+        cells = np.arange(fx.NVOXEL, dtype=np.int64)
+        fx._write_rtm_file(rtm, fx.CAM_B, fx.MASK_B, H_b, cells, cells)
+        frames = np.stack([
+            fx.frame_from_measurement(fx.MASK_B, np.full(fx.NPIX_B, 1.0 + t))
+            for t in frame_times
+        ])
+        fx._write_image_file(img, fx.CAM_B, frames, frame_times)
+        m, i = hf.categorize_input_files([rtm, img])
+        sm, si = hf.sort_rtm_files(m), hf.sort_image_files(i)
+        masks = hf.read_rtm_frame_masks(sm)
+        return si, masks
+
+    def test_dedup_keeps_single_entry(self, tmp_path):
+        """A frame within threshold of two adjacent ticks is emitted once,
+        at the tick it is closest to."""
+        si, masks = self._single_cam_world(tmp_path, [0.1, 0.3])
+        ci = CompositeImage(si, masks, [(0.0, 1.0, 0.1, 0.1)], fx.NPIX_B, 0)
+        np.testing.assert_allclose(ci.time, [0.1, 0.3], atol=1e-12)
+
+    def test_dedup_moves_time_to_closer_tick(self, tmp_path):
+        """image.cpp:158: same frame set, smaller total delta => the
+        composite time moves to the closer tick.
+
+        The grid anchors at the earliest frame time, so frame 0 pins the
+        ticks at 0.0, 0.1, ... Frame 1 at 0.26 bids on tick 0.2 (|0.06|)
+        and tick 0.3 (|0.04|): the deduped composite moves to 0.3.
+        """
+        si, masks = self._single_cam_world(tmp_path, [0.0, 0.26])
+        ci = CompositeImage(si, masks, [(0.0, 1.0, 0.1, 0.1)], fx.NPIX_B, 0)
+        np.testing.assert_allclose(ci.time, [0.0, 0.3], atol=1e-12)
+        np.testing.assert_allclose(ci.camera_time, [[0.0], [0.26]], atol=1e-12)
+
+    def test_exact_tie_prefers_earlier_tick(self, tmp_path):
+        si, masks = self._single_cam_world(tmp_path, [0.0, 0.25])
+        ci = CompositeImage(si, masks, [(0.0, 1.0, 0.1, 0.1)], fx.NPIX_B, 0)
+        # frame 1 equidistant from ticks 0.2 and 0.3: TIME_EPSILON keeps
+        # the earlier tick
+        np.testing.assert_allclose(ci.time, [0.0, 0.2], atol=1e-12)
