@@ -347,13 +347,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             def __exit__(self, *exc):
                 pass
 
+        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+
         writer_ctx = (
-            SolutionWriter(
+            # write off-thread so periodic HDF5 flushes never stall the
+            # solve loop (read / solve / write pipeline)
+            AsyncSolutionWriter(SolutionWriter(
                 args.output_file, camera_names, nvoxel,
                 max_cache_size=args.max_cached_solutions,
                 # pass the already-read state so the file is inspected once
                 resume=resume_state if resume_state is not None else False,
-            )
+            ))
             if primary else _NullWriter()
         )
 

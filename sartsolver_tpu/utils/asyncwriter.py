@@ -1,0 +1,113 @@
+"""Asynchronous solution writer: overlap HDF5 output with device compute.
+
+The reference writes synchronously on rank 0 inside the frame loop
+(main.cpp:134-135): every ``max_cached_solutions``-th frame pays a full
+extend-and-append flush (solution.cpp:114-165) before the next solve can be
+dispatched. This wrapper moves the buffering writer onto a dedicated
+thread — the counterpart of ``utils.prefetch.FramePrefetcher`` on the
+output side, completing a read / solve / write pipeline in which the device
+never waits for the filesystem.
+
+Ordering, flush cadence and crash semantics are the wrapped writer's: only
+the worker thread touches the HDF5 file (h5py requires single-thread file
+access), frames are written in submission order, and an interrupted run
+still keeps every flushed cache window (``--resume`` picks up from there).
+A write error is re-raised on the next ``add`` or on ``close`` — fail-fast,
+one frame later than the synchronous writer.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class AsyncSolutionWriter:
+    """Runs a :class:`~sartsolver_tpu.io.solution.SolutionWriter` (or any
+    object with ``add``/``close``) on a worker thread."""
+
+    def __init__(self, writer, max_pending: int = 16):
+        if max_pending < 1:
+            raise ValueError("max_pending must be positive.")
+        self._writer = writer
+        self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue(maxsize=max_pending)
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            if self._error is not None:
+                continue  # latched: drain every later frame, write none
+            try:
+                self._writer.add(*item)
+            except BaseException as err:
+                self._error = err
+
+    def _check(self) -> None:
+        # The latch is permanent: once a write failed, no later frame is
+        # ever written (a cleared latch would let frames still queued at
+        # clearance time be written while drained ones were dropped —
+        # non-contiguous output that corrupts a subsequent --resume).
+        if self._error is not None:
+            raise self._error
+
+    def add(
+        self,
+        solution: np.ndarray,
+        status: int,
+        time: float,
+        camera_time: Sequence[float],
+    ) -> None:
+        self._check()
+        if self._closed:
+            raise RuntimeError("Writer is closed.")
+        # copy: the caller may reuse/donate the buffer while the write is
+        # still queued
+        self._queue.put((np.array(solution, np.float64, copy=True),
+                         int(status), float(time), list(camera_time)))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join()
+        try:
+            self._writer.close()
+        except BaseException as err:
+            if self._error is None:
+                self._error = err
+        self._check()
+
+    def __enter__(self) -> "AsyncSolutionWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if exc and exc[0] is not None:
+            # consumer failed: drop queued frames, let the in-flight write
+            # finish (the worker must be done before any other thread may
+            # touch the HDF5 file), close without masking the original
+            # exception
+            self._closed = True
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            # sole producer + queue just drained => cannot block
+            self._queue.put(None)
+            self._thread.join()
+            try:
+                self._writer.close()
+            except BaseException:
+                pass
+        else:
+            self.close()

@@ -352,3 +352,58 @@ class TestAlignmentTieBreaks:
         # frame 1 equidistant from ticks 0.2 and 0.3: TIME_EPSILON keeps
         # the earlier tick
         np.testing.assert_allclose(ci.time, [0.0, 0.2], atol=1e-12)
+
+
+class TestAsyncSolutionWriter:
+    def test_matches_synchronous_writer(self, tmp_path):
+        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+
+        rng = np.random.default_rng(3)
+        sols = rng.uniform(size=(7, fx.NVOXEL))
+        sync_out = str(tmp_path / "sync.h5")
+        async_out = str(tmp_path / "async.h5")
+
+        with SolutionWriter(sync_out, [fx.CAM_A], fx.NVOXEL, max_cache_size=3) as w:
+            for t in range(7):
+                w.add(sols[t], -(t % 2), 0.1 * t, [0.1 * t])
+        with AsyncSolutionWriter(
+            SolutionWriter(async_out, [fx.CAM_A], fx.NVOXEL, max_cache_size=3)
+        ) as w:
+            for t in range(7):
+                w.add(sols[t], -(t % 2), 0.1 * t, [0.1 * t])
+
+        with h5py.File(sync_out) as a, h5py.File(async_out) as b:
+            for key in ("value", "time", "status", f"time_{fx.CAM_A}"):
+                np.testing.assert_array_equal(
+                    a[f"solution/{key}"][:], b[f"solution/{key}"][:]
+                )
+
+    def test_write_error_surfaces(self):
+        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+
+        class Exploding:
+            def add(self, *a):
+                raise OSError("disk full")
+
+            def close(self):
+                pass
+
+        w = AsyncSolutionWriter(Exploding())
+        w.add(np.zeros(4), 0, 0.0, [0.0])
+        with pytest.raises(OSError, match="disk full"):
+            for _ in range(50):  # error latches on a subsequent add or close
+                w.add(np.zeros(4), 0, 0.0, [0.0])
+            w.close()
+
+    def test_buffer_copied_before_queueing(self, tmp_path):
+        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+
+        out = str(tmp_path / "copy.h5")
+        buf = np.ones(fx.NVOXEL)
+        with AsyncSolutionWriter(
+            SolutionWriter(out, [fx.CAM_A], fx.NVOXEL, max_cache_size=10)
+        ) as w:
+            w.add(buf, 0, 0.0, [0.0])
+            buf[:] = -99.0  # mutate after submission
+        with h5py.File(out) as f:
+            np.testing.assert_array_equal(f["solution/value"][0], np.ones(fx.NVOXEL))
