@@ -82,11 +82,16 @@ def build_parser() -> argparse.ArgumentParser:
     tpu = p.add_argument_group("tpu options")
     tpu.add_argument("--pixel_shards", type=int, default=None,
                      help="Number of mesh shards along the pixel axis "
-                          "(default: all visible devices).")
-    tpu.add_argument("--voxel_shards", type=int, default=1,
+                          "(default: auto — all visible devices, unless the "
+                          "fused sweep prefers a voxel-major mesh).")
+    tpu.add_argument("--voxel_shards", type=int, default=None,
                      help="Number of mesh shards along the voxel axis "
                           "(column sharding; shrinks per-chip solution-state "
-                          "memory when nvoxel outgrows one chip).")
+                          "memory when nvoxel outgrows one chip). Default: "
+                          "auto — all devices on the voxel axis when the "
+                          "fused Pallas sweep is eligible per-shard (it "
+                          "needs the full pixel extent on each device), "
+                          "else 1.")
     tpu.add_argument("--batch_frames", type=int, default=1,
                      help="Solve N composite frames per device program "
                           "(gemv->gemm on the MXU; the RTM is read once per "
@@ -152,7 +157,7 @@ def _validate(args) -> None:
              f"required, {len(args.input_files)} given.")
     if args.pixel_shards is not None and args.pixel_shards < 1:
         fail(f"Argument pixel_shards must be >= 1, {args.pixel_shards} given.")
-    if args.voxel_shards < 1:
+    if args.voxel_shards is not None and args.voxel_shards < 1:
         fail(f"Argument voxel_shards must be >= 1, {args.voxel_shards} given.")
     if args.batch_frames < 1:
         fail(f"Argument batch_frames must be >= 1, {args.batch_frames} given.")
@@ -180,7 +185,6 @@ def main(argv: Optional[List[str]] = None) -> int:
     from sartsolver_tpu.io import hdf5files as hf
     from sartsolver_tpu.io.image import CompositeImage
     from sartsolver_tpu.io.laplacian_io import read_laplacian
-    from sartsolver_tpu.io.raytransfer import read_rtm_block
     from sartsolver_tpu.io.solution import SolutionWriter
     from sartsolver_tpu.io.voxelgrid import make_voxel_grid
     from sartsolver_tpu.ops.laplacian import make_laplacian
@@ -269,11 +273,39 @@ def main(argv: Optional[List[str]] = None) -> int:
             rows, cols, vals = read_laplacian(args.laplacian_file, nvoxel)
             lap = make_laplacian(rows, cols, vals, dtype=opts.dtype)
 
-        n_vox = args.voxel_shards
-        if args.pixel_shards is not None:
-            n_pix = args.pixel_shards
-        else:
-            n_pix = max(len(devices) // n_vox, 1)
+        # Explicit-flag mesh shape (None, None = auto-select below).
+        explicit_mesh = not (args.pixel_shards is None and args.voxel_shards is None)
+        if explicit_mesh:
+            n_vox = args.voxel_shards or 1
+            if args.pixel_shards is not None:
+                n_pix = args.pixel_shards
+            else:
+                n_pix = max(len(devices) // n_vox, 1)
+
+        # auto-fused path: compile self-test, skipped when fusion is
+        # ineligible anyway (fp64 --use_cpu profile, explicitly sharded
+        # pixel axis — no compile wasted); an explicit --fused_sweep on
+        # surfaces compile errors instead of degrading. Resolved *before*
+        # the auto mesh choice so a broken kernel demotes the auto mesh to
+        # the row-block layout instead of picking voxel-major for nothing.
+        if not args.use_cpu:
+            from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
+
+            resolved = resolve_fused_auto(
+                opts, pixel_sharded=explicit_mesh and n_pix > 1
+            )
+            if resolved is not opts:
+                print("Warning: fused Pallas sweep failed its self-test on "
+                      "this backend; using the two-matmul path.",
+                      file=sys.stderr)
+            opts = resolved
+
+        if not explicit_mesh:
+            from sartsolver_tpu.parallel.mesh import choose_mesh_shape
+
+            n_pix, n_vox = choose_mesh_shape(
+                len(devices), npixel, nvoxel, opts, args.batch_frames
+            )
         if n_pix * n_vox < len(devices) and args.pixel_shards is None:
             print(
                 f"Warning: {len(devices)} devices visible but the "
@@ -283,33 +315,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
         mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
-        # auto-fused path: compile self-test, skipped when fusion is
-        # ineligible anyway (fp64 --use_cpu profile, sharded pixel axis —
-        # no compile wasted); an explicit --fused_sweep on surfaces compile
-        # errors instead of degrading
-        if not args.use_cpu:
-            from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
+        # Striped chunked ingest on every path (the reference's per-rank
+        # read, main.cpp:76-86): each process streams only the row chunks
+        # its devices hold straight into device memory, so peak host
+        # allocation is one bounded chunk — never the full matrix
+        # (raytransfer.cpp:49 parity; see multihost.read_and_shard_rtm).
+        from sartsolver_tpu.parallel.multihost import read_and_shard_rtm
 
-            resolved = resolve_fused_auto(opts, pixel_sharded=n_pix > 1)
-            if resolved is not opts:
-                print("Warning: fused Pallas sweep failed its self-test on "
-                      "this backend; using the two-matmul path.",
-                      file=sys.stderr)
-            opts = resolved
-        if args.multihost:
-            # striped per-process ingest: each host reads only the RTM rows
-            # its devices hold (the reference's per-rank read, main.cpp:76-86)
-            rtm = mh.read_and_shard_rtm(
-                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
-                dtype=opts.rtm_dtype or opts.dtype,
-                serialize=not args.parallel_read,
-            )
-            solver = DistributedSARTSolver(
-                rtm, lap, opts=opts, mesh=mesh, npixel=npixel, nvoxel=nvoxel
-            )
-        else:
-            rtm = read_rtm_block(sorted_matrix_files, rtm_name, npixel, nvoxel, 0)
-            solver = DistributedSARTSolver(rtm, lap, opts=opts, mesh=mesh)
+        rtm = read_and_shard_rtm(
+            sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+            dtype=opts.rtm_dtype or opts.dtype,
+            serialize=args.multihost and not args.parallel_read,
+        )
+        solver = DistributedSARTSolver(
+            rtm, lap, opts=opts, mesh=mesh, npixel=npixel, nvoxel=nvoxel
+        )
         _mark("ingest RTM + upload")
 
         grid = make_voxel_grid(

@@ -70,6 +70,54 @@ def pad_measurement(g: np.ndarray, nshards: int, target: int | None = None) -> n
     return np.concatenate([g, np.full(target - g.shape[0], -1.0, dtype=g.dtype)])
 
 
+def choose_mesh_shape(
+    n_devices: int, npixel: int, nvoxel: int, opts, batch: int = 1
+) -> Tuple[int, int]:
+    """Pick ``(n_pixel_shards, n_voxel_shards)`` for an auto-configured mesh.
+
+    Heuristic: the fused Pallas sweep needs the full pixel extent on each
+    device (ops/fused_sweep.py module docstring), so when it would engage on
+    the per-device block, prefer a **voxel-major** mesh ``(1, N)``: every
+    chip runs the single-HBM-read panel sweep over its column block and only
+    the forward-projection psum crosses ICI. Per-device RTM bytes are
+    identical either way (``P*V/N``); what changes is which reduction runs
+    per iteration and whether fusion stays eligible. When fusion cannot
+    engage (explicitly off, non-fp32 compute, fp64 RTM, non-TPU backend for
+    ``'auto'``, or per-shard shapes that don't tile), fall back to the
+    reference's row-block layout ``(N, 1)`` (main.cpp:67-68).
+
+    ``opts`` is a :class:`sartsolver_tpu.config.SolverOptions`; only its
+    dtype/fusion fields are read.
+    """
+    if n_devices <= 1:
+        return 1, 1
+    if jax.process_count() > 1:
+        # Voxel-major would put every host's devices in row group 0, so
+        # every host would read the ENTIRE matrix from disk (the striped
+        # reader slices rows, not columns) — n_hosts x the I/O of the
+        # pixel-major stripe layout. Multi-host stays row-block.
+        return n_devices, 1
+    mode = opts.fused_sweep
+    would_engage = mode in ("on", "interpret") or (
+        mode == "auto" and jax.default_backend() == "tpu"
+    )
+    rtm_name = opts.rtm_dtype or opts.dtype
+    if (
+        not would_engage
+        or opts.dtype != "float32"
+        or rtm_name not in ("float32", "bfloat16")
+    ):
+        return n_devices, 1
+    from sartsolver_tpu.ops.fused_sweep import fused_available
+
+    itemsize = 2 if rtm_name == "bfloat16" else 4
+    rows = padded_size(npixel, ROW_ALIGN)
+    cols = padded_size(nvoxel, n_devices * COL_ALIGN)
+    if fused_available(rows, cols // n_devices, itemsize, batch):
+        return 1, n_devices
+    return n_devices, 1
+
+
 def make_mesh(n_pixel_shards: int | None = None, n_voxel_shards: int = 1, devices=None) -> Mesh:
     """Build a ('pixels',) or ('pixels', 'voxels') mesh over local devices."""
     devices = list(jax.devices()) if devices is None else list(devices)

@@ -22,9 +22,12 @@ multi-host; this module supplies the pieces that are host-topology-aware:
 
 from __future__ import annotations
 
+import functools
+import os
 from typing import Dict, List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -73,6 +76,18 @@ def is_primary() -> bool:
     return jax.process_index() == 0
 
 
+def _device_grid(mesh) -> np.ndarray:
+    """Mesh devices as a 2-D [pixel, voxel] grid, accepting 1-D meshes."""
+    devs = mesh.devices
+    if devs.ndim == 1:
+        # a ('pixels',) mesh has an implicit voxel axis of size 1 (and
+        # vice versa) — normalize instead of failing on tuple unpack
+        if PIXEL_AXIS in mesh.axis_names:
+            return devs.reshape(-1, 1)
+        return devs.reshape(1, -1)
+    return devs
+
+
 def read_and_shard_rtm(
     sorted_matrix_files: Dict[str, List[str]],
     rtm_name: str,
@@ -82,55 +97,91 @@ def read_and_shard_rtm(
     *,
     dtype,
     serialize: bool = False,
+    chunk_rows: Optional[int] = None,
 ) -> jax.Array:
     """Assemble the global padded RTM, each process reading only its rows.
 
-    Every process reads each pixel row stripe that one of its own devices
-    will hold — the reference's per-rank block read (raytransfer.cpp:49,
-    83-88) — pads it to the device block shape, and the stripes are
-    assembled into one global array sharded ``P('pixels', 'voxels')``. No
-    process ever holds more than its devices' share (plus one transient
-    row stripe during the read).
+    Every process reads the pixel row stripes its own devices will hold —
+    the reference's per-rank block read (raytransfer.cpp:49, 83-88) — in
+    **bounded row chunks** that are streamed straight into the device
+    buffers (in-place ``dynamic_update_slice`` with donated outputs). Peak
+    host allocation is one chunk (``chunk_rows x nvoxel`` fp32, default
+    ~256 MB, env ``SART_INGEST_CHUNK_ROWS``), *never* the full matrix or
+    even a full device block — which is what lets a "tens or even hundreds
+    of GB" RTM (/root/reference/README.md:4-8) pass through a host whose
+    RAM is smaller than the chips' aggregate HBM. Works for any process
+    count; the single-process multi-device CLI path uses it too.
 
     ``serialize=True`` staggers the reads process-by-process with a global
     barrier between turns — the reference's default HDD-friendly
     round-robin ingest (main.cpp:78-86, MPI_Barrier at :84); leave False
     for parallel reads (the reference's ``--parallel_read``).
     """
-    n_pix = mesh.shape[PIXEL_AXIS]
+    n_pix = mesh.shape.get(PIXEL_AXIS, 1)
     n_vox = mesh.shape.get(VOXEL_AXIS, 1)
     padded_rows = padded_size(npixel, n_pix * ROW_ALIGN)
     padded_cols = padded_size(nvoxel, n_vox * COL_ALIGN)
     row_block = padded_rows // n_pix
     col_block = padded_cols // n_vox
-    sharding = NamedSharding(mesh, P(PIXEL_AXIS, VOXEL_AXIS))
+    sharding = NamedSharding(mesh, P(
+        PIXEL_AXIS if PIXEL_AXIS in mesh.shape else None,
+        VOXEL_AXIS if VOXEL_AXIS in mesh.shape else None,
+    ))
+    jdtype = jnp.dtype(dtype)
+    if chunk_rows is None:
+        chunk_rows = int(os.environ.get(
+            "SART_INGEST_CHUNK_ROWS",
+            max(ROW_ALIGN, (256 << 20) // max(nvoxel * 4, 1)),
+        ))
+    chunk_rows = max(1, min(chunk_rows, row_block))
 
     # Group this process's devices by row block so each stripe is read once.
     mine: Dict[int, List] = {}
-    for (i, j), dev in np.ndenumerate(mesh.devices):
+    for (i, j), dev in np.ndenumerate(_device_grid(mesh)):
         if dev.process_index == jax.process_index():
             mine.setdefault(int(i), []).append((int(j), dev))
 
+    @functools.partial(jax.jit, donate_argnums=0)
+    def _scatter(buf, piece, row_start):
+        return jax.lax.dynamic_update_slice(
+            buf, piece.astype(buf.dtype), (row_start, jnp.int32(0))
+        )
+
     def read_my_blocks() -> list:
         arrays = []
-        np_dtype = np.dtype(dtype)
         for i, cols in sorted(mine.items()):
             r0 = i * row_block
             rows_have = max(0, min(npixel - r0, row_block))
-            stripe = None
-            if rows_have > 0:
+            # allocate the zero blocks *on device* — a device_put of host
+            # zeros would DMA a full matrix footprint of zeros before the
+            # data chunks stream the same bytes again
+            bufs = {
+                j: jax.jit(
+                    functools.partial(jnp.zeros, (row_block, col_block), jdtype),
+                    out_shardings=jax.sharding.SingleDeviceSharding(dev),
+                )()
+                for j, dev in sorted(cols)
+            }
+            for cs in range(0, rows_have, chunk_rows):
+                n = min(chunk_rows, rows_have - cs)
                 stripe = read_rtm_block(
-                    sorted_matrix_files, rtm_name, rows_have, nvoxel, r0,
+                    sorted_matrix_files, rtm_name, n, nvoxel, r0 + cs,
                     dtype=np.float32,
                 )
-            for j, dev in sorted(cols):
-                c0 = j * col_block
-                block = np.zeros((row_block, col_block), np_dtype)
-                if stripe is not None:
+                # fixed piece height (except one trailing shape) keeps the
+                # jitted scatter at <= 2 compiled variants
+                n_write = min(chunk_rows, row_block - cs)
+                for j, dev in sorted(cols):
+                    c0 = j * col_block
                     cols_have = max(0, min(nvoxel - c0, col_block))
+                    piece = np.zeros((n_write, col_block), np.float32)
                     if cols_have > 0:
-                        block[:rows_have, :cols_have] = stripe[:, c0:c0 + cols_have]
-                arrays.append(jax.device_put(block, dev))
+                        piece[:n, :cols_have] = stripe[:, c0:c0 + cols_have]
+                    bufs[j] = _scatter(
+                        bufs[j], jax.device_put(piece, dev),
+                        np.int32(cs),
+                    )
+            arrays.extend(bufs[j] for j, _ in sorted(cols))
         return arrays
 
     if serialize and jax.process_count() > 1:

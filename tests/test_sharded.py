@@ -124,3 +124,54 @@ def test_2d_mesh_warm_start_chain():
         f1 = solver_1d.solve(g * scale, f0=f1).solution
         f2 = solver_2d.solve(g * scale, f0=f2).solution
         np.testing.assert_allclose(f2, f1, rtol=1e-9)
+
+
+def test_choose_mesh_shape_heuristic():
+    """VERDICT r1 #2: auto mesh goes voxel-major iff the fused sweep would
+    engage on the per-device block; otherwise the reference's row-block
+    layout."""
+    from sartsolver_tpu.parallel.mesh import choose_mesh_shape
+
+    # 'interpret'/'on' engage on any backend => voxel-major when aligned
+    assert choose_mesh_shape(8, 800, 4096, SolverOptions(fused_sweep="interpret")) == (1, 8)
+    assert choose_mesh_shape(8, 800, 4096, SolverOptions(fused_sweep="on")) == (1, 8)
+    # fused off => pixel-major
+    assert choose_mesh_shape(8, 800, 4096, SolverOptions(fused_sweep="off")) == (8, 1)
+    # fp64 parity profile cannot fuse => pixel-major
+    assert choose_mesh_shape(8, 800, 4096, SolverOptions.cpu_parity()) == (8, 1)
+    # 'auto' on the CPU test backend never fuses => pixel-major
+    assert choose_mesh_shape(8, 800, 4096, SolverOptions(fused_sweep="auto")) == (8, 1)
+    # bf16 RTM storage composes with fusion
+    assert choose_mesh_shape(
+        8, 800, 4096, SolverOptions(fused_sweep="on", rtm_dtype="bfloat16")
+    ) == (1, 8)
+    # single device: trivial mesh
+    assert choose_mesh_shape(1, 800, 4096, SolverOptions(fused_sweep="on")) == (1, 1)
+
+
+@pytest.mark.parametrize("logarithmic", [False, True])
+def test_voxel_major_fused_equals_unfused(logarithmic):
+    """Fused sweep + voxel sharding at mesh>1 == unfused single device.
+
+    The flagship multi-chip fusion configuration (VERDICT r1 #2): a (1, 8)
+    voxel-major mesh where each shard runs the fused panel sweep over its
+    column block and only the forward-projection psum crosses shards."""
+    H, g, _ = make_case(seed=17, P=16, V=256, neg_pixels=2, zero_voxels=0,
+                        zero_pixels=1)
+    lap = make_laplacian(*laplacian_1d_chain(H.shape[1], 0.1), dtype="float32")
+    opts_ref = SolverOptions(
+        logarithmic=logarithmic, max_iterations=15, conv_tolerance=1e-12,
+        fused_sweep="off",
+    )
+    opts_fused = SolverOptions(
+        logarithmic=logarithmic, max_iterations=15, conv_tolerance=1e-12,
+        fused_sweep="interpret",
+    )
+    res_ref = solve(make_problem(H, lap, opts=opts_ref), g, opts=opts_ref)
+    solver = DistributedSARTSolver(H, lap, opts=opts_fused, mesh=make_mesh(1, 8))
+    res = solver.solve(g)
+    np.testing.assert_allclose(
+        res.solution, np.asarray(res_ref.solution), rtol=2e-4, atol=1e-5
+    )
+    assert res.status == int(res_ref.status)
+    assert res.iterations == int(res_ref.iterations)
