@@ -105,10 +105,12 @@ def build_parser() -> argparse.ArgumentParser:
     tpu.add_argument("--profile_dir", default=None,
                      help="Write a jax.profiler trace of the frame loop here.")
     tpu.add_argument("--fused_sweep", default="auto",
-                     choices=["auto", "on", "off"],
+                     choices=["auto", "on", "off", "interpret"],
                      help="Fused Pallas iteration sweep: one HBM read of the "
                           "RTM per iteration instead of two (applies when "
-                          "the pixel axis is not sharded).")
+                          "the pixel axis is not sharded). 'interpret' runs "
+                          "the kernel in the Pallas interpreter (works "
+                          "off-TPU; slow, for validation).")
     tpu.add_argument("--debug_nans", action="store_true",
                      help="Enable jax debug-NaN checking: abort with a "
                           "traceback at the first NaN-producing op instead "
@@ -181,7 +183,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         mh.initialize()
 
-    from sartsolver_tpu.config import SolverOptions, parse_time_intervals
+    from sartsolver_tpu.config import (
+        SartInputError, SolverOptions, parse_time_intervals,
+    )
     from sartsolver_tpu.io import hdf5files as hf
     from sartsolver_tpu.io.image import CompositeImage
     from sartsolver_tpu.io.laplacian_io import read_laplacian
@@ -223,19 +227,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         rtm_frame_masks = hf.read_rtm_frame_masks(sorted_matrix_files)
 
         # Resume compatibility is checkable from metadata alone — fail now,
-        # before the (potentially tens-of-GB) RTM ingest, not after.
+        # before the (potentially tens-of-GB) RTM ingest, not after. In a
+        # multi-host run only process 0 reads the file (it may be on a
+        # filesystem the other hosts can't see) and broadcasts its view so
+        # every process skips the same frames.
         from sartsolver_tpu.io.solution import read_resume_state
 
-        resume_state = (
-            read_resume_state(args.output_file, camera_names, nvoxel)
-            if args.resume else None
-        )
+        resume_state = None
+        if args.resume:
+            resume_error = None
+            if (not args.multihost) or mh.is_primary():
+                try:
+                    resume_state = read_resume_state(
+                        args.output_file, camera_names, nvoxel
+                    )
+                except (SartInputError, OSError, KeyError) as err:
+                    if not args.multihost:
+                        raise
+                    # broadcast the failure so every process exits cleanly
+                    # instead of the others hanging in the collective
+                    resume_error = str(err) or type(err).__name__
+            if args.multihost:
+                resume_state = mh.broadcast_resume_state(
+                    resume_state, nvoxel, error=resume_error
+                )
 
-        # ---- data model (main.cpp:70-86) ---------------------------------
-        composite_image = CompositeImage(
-            sorted_image_files, rtm_frame_masks, time_intervals,
-            npixel, 0, max_cache_size=args.max_cached_frames,
-        )
         _mark("validate + index inputs")
 
         if args.use_cpu:
@@ -315,6 +331,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                 file=sys.stderr,
             )
         mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
+
+        # ---- data model (main.cpp:70-86) ---------------------------------
+        # Multi-host: each process reads and caches only its own devices'
+        # pixel rows of every frame (the reference's per-rank measurement
+        # slice, image.cpp:282-321) and the solver stages the measurement
+        # sharded. The local and replicated staging paths issue different
+        # collectives, so the choice is made from the full device grid —
+        # unanimously TRUE only when EVERY process has a contiguous,
+        # non-empty range — never from this process's own range alone.
+        use_local = args.multihost and mh.all_processes_sliceable(mesh, npixel)
+        offset_pix, npix_read = (
+            mh.process_pixel_range(mesh, npixel) if use_local else (0, npixel)
+        )
+        composite_image = CompositeImage(
+            sorted_image_files, rtm_frame_masks, time_intervals,
+            npix_read, offset_pix, max_cache_size=args.max_cached_frames,
+        )
+
         # Striped chunked ingest on every path (the reference's per-rank
         # read, main.cpp:76-86): each process streams only the row chunks
         # its devices hold straight into device memory, so peak host
@@ -401,14 +435,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                             np.zeros((args.batch_frames - len(pending),
                                       stack.shape[1])),
                         ])
-                    result = solver.solve_batch(stack)
+                    result = solver.solve_batch(stack, local=use_local)
                     timer.add("solve batch", _time.perf_counter() - t0)
                     per_frame_ms = (_time.perf_counter() - t0) * 1e3 / len(pending)
                     for b, (_, ftime, cam_times) in enumerate(pending):
                         writer.add(result.solution[b], int(result.status[b]),
                                    ftime, cam_times)
                         if primary:
-                            print(f"Processed in: {per_frame_ms} ms")
+                            # the value is a batch average, not this frame's
+                            # own wall time — say so instead of mimicking
+                            # the reference's per-frame line misleadingly
+                            print(f"Processed in: {per_frame_ms} ms "
+                                  f"(average over batch of {len(pending)})")
                     pending.clear()
 
                 for item in frames:
@@ -423,7 +461,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     warm = resume_state.last_solution
                 for frame, ftime, cam_times in frames:
                     t0 = _time.perf_counter()
-                    result = solver.solve(frame, f0=warm)
+                    result = solver.solve(frame, f0=warm, local=use_local)
                     writer.add(result.solution, result.status, ftime, cam_times)
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
                     timer.add("solve frame", elapsed_ms / 1e3)
@@ -448,7 +486,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # reference contract promises.
         print(f"Missing dataset or attribute in input files: {err}", file=sys.stderr)
         return 1
-    except (ValueError, OSError) as err:
+    except (SartInputError, OSError) as err:
+        # Only *input* problems get the reference's polite message + exit(1)
+        # (hdf5files.cpp contract); an internal ValueError is a bug and
+        # tracebacks loudly instead of being swallowed.
         print(err, file=sys.stderr)
         return 1
 

@@ -17,6 +17,14 @@ SUCCESS = 0
 MAX_ITERATIONS_EXCEEDED = -1
 
 
+class SartInputError(ValueError):
+    """A problem with the *user's inputs* (flags or input-file contents).
+
+    The CLI converts exactly this (plus h5py's OSError/KeyError) into the
+    reference's polite message + exit(1) contract (hdf5files.cpp throughout);
+    any other exception is an internal bug and tracebacks loudly."""
+
+
 def parse_time_intervals(time_string: str) -> List[Tuple[float, float, float, float]]:
     """Parse a multi-interval time-range string.
 
@@ -40,16 +48,16 @@ def parse_time_intervals(time_string: str) -> List[Tuple[float, float, float, fl
         if not interval_string.strip():
             if pos == len(segments) - 1:
                 continue  # trailing "," is allowed (arguments.cpp:24)
-            raise ValueError(
+            raise SartInputError(
                 f"Unable to recognize a time interval in {interval_string}."
             )
         fields = interval_string.split(":")
         if len(fields) < 2:
-            raise ValueError(
+            raise SartInputError(
                 f"Unable to recognize a time interval in {interval_string}."
             )
         if len(fields) > 4:
-            raise ValueError(
+            raise SartInputError(
                 f"Too many values in a time interval: {interval_string}."
             )
         try:
@@ -58,26 +66,26 @@ def parse_time_intervals(time_string: str) -> List[Tuple[float, float, float, fl
             step = float(fields[2]) if len(fields) > 2 else 0.0
             threshold = float(fields[3]) if len(fields) > 3 else 0.0
         except ValueError as err:
-            raise ValueError(
+            raise SartInputError(
                 f"Unable to convert {interval_string} to the time interval."
             ) from err
 
         if start < 0:
-            raise ValueError("Time limits must be positive.")
+            raise SartInputError("Time limits must be positive.")
         if stop <= start:
-            raise ValueError(
+            raise SartInputError(
                 "The upper limit of the time interval must be higher than the lower one."
             )
         if step > (stop - start):
-            raise ValueError("Time step must be less or equal to the time interval.")
+            raise SartInputError("Time step must be less or equal to the time interval.")
         if threshold > step:
-            raise ValueError(
+            raise SartInputError(
                 "Synchronization threshold must be less or equal to the time step."
             )
         intervals.append((start, stop, step, threshold))
 
     if not intervals:
-        raise ValueError(f"Unable to recognize a time interval in {time_string}.")
+        raise SartInputError(f"Unable to recognize a time interval in {time_string}.")
     return intervals
 
 

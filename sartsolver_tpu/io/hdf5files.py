@@ -30,8 +30,7 @@ import h5py
 import numpy as np
 
 
-class SartInputError(ValueError):
-    """Invalid or inconsistent input files (reference: message + exit(1))."""
+from sartsolver_tpu.config import SartInputError  # noqa: F401  (canonical home; re-exported for back-compat)
 
 
 def _read_str_attr(obj, name: str) -> str:

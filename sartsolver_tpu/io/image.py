@@ -24,6 +24,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import h5py
 import numpy as np
 
+from sartsolver_tpu.config import SartInputError
+
 TIME_EPSILON = 1.0e-10  # image.cpp:17
 
 
@@ -63,7 +65,7 @@ class CompositeImage:
             with h5py.File(filename, "r") as f:
                 timeline = np.asarray(f["image/time"], np.float64)
             if not np.all(np.diff(timeline) >= 0):
-                raise ValueError(
+                raise SartInputError(
                     f"Image frames are not sorted by time in {filename}."
                 )
             timelines.append(timeline)
@@ -79,7 +81,7 @@ class CompositeImage:
             self._frame_indices_from_timepairs(timepairs, step, threshold)
 
         if not self.frame_indices:
-            raise ValueError(
+            raise SartInputError(
                 "No composite images can be created for given time intervals."
             )
 
@@ -108,7 +110,7 @@ class CompositeImage:
             # duplicate timestamps) while the spread exceeds TIME_EPSILON —
             # no step can be derived. The reference would divide by zero
             # here; fail fast instead.
-            raise ValueError(
+            raise SartInputError(
                 "Unable to derive a composite time step; specify the step "
                 "explicitly in the time range."
             )
@@ -208,8 +210,6 @@ class CompositeImage:
         cache_size_t = min(self.max_cache_size, len(self.time) - itime)
         cached = np.zeros((cache_size_t, self.npix))
 
-        from sartsolver_tpu.native import masked_compact
-
         start_pixel = 0
         for icam, (camera, mask) in enumerate(self.rtm_frame_masks.items()):
             npixel_masked = int(np.sum(mask != 0))
@@ -232,7 +232,7 @@ class CompositeImage:
                         frame_idx = self.frame_indices[itime + it][icam]
                         full = np.asarray(dset[frame_idx], np.float64).ravel()
                         cached[it, pix_offset:pix_offset + len(slice_indices)] = (
-                            masked_compact(full, slice_indices)
+                            full[slice_indices]
                         )
             start_pixel += npixel_masked
             if self.offset_pix + self.npix < start_pixel:

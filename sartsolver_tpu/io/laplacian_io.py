@@ -13,6 +13,8 @@ from typing import Tuple
 import h5py
 import numpy as np
 
+from sartsolver_tpu.config import SartInputError
+
 
 def read_laplacian(filename: str, nvoxel: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Returns sorted COO triplets (rows, cols, vals)."""
@@ -20,7 +22,7 @@ def read_laplacian(filename: str, nvoxel: int) -> Tuple[np.ndarray, np.ndarray, 
         group = f["laplacian"]
         nvoxel_data = int(group.attrs["nvoxel"])
         if nvoxel_data != nvoxel:
-            raise ValueError(
+            raise SartInputError(
                 "Laplacian and ray-transfer matrices have different number of voxels."
             )
         rows = np.asarray(group["i"], np.int64)

@@ -21,6 +21,8 @@ from typing import Dict, List
 import h5py
 import numpy as np
 
+from sartsolver_tpu.config import SartInputError
+
 
 def read_rtm_block(
     sorted_matrix_files: Dict[str, List[str]],
@@ -69,7 +71,7 @@ def read_rtm_block(
                         cols = voxel_index[sel]
                         vals = value[sel]
                         if cols.size and (int(cols.max()) >= nvoxel or int(cols.min()) < 0):
-                            raise ValueError(
+                            raise SartInputError(
                                 f"Sparse RTM segment {filename} has voxel "
                                 f"indices outside [0, {nvoxel})."
                             )

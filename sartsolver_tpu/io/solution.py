@@ -20,6 +20,8 @@ from typing import Dict, List, NamedTuple, Optional, Sequence
 import h5py
 import numpy as np
 
+from sartsolver_tpu.config import SartInputError
+
 
 class ResumeState(NamedTuple):
     """What a previous (possibly interrupted) run already produced."""
@@ -56,14 +58,14 @@ def read_resume_state(
             return None  # torn first flush — recreate from scratch
         value = group["value"]
         if value.shape[1] != nvoxel:
-            raise ValueError(
+            raise SartInputError(
                 f"Cannot resume into {filename}: it holds solutions of "
                 f"{value.shape[1]} voxels, this problem has {nvoxel}."
             )
         expected = {f"time_{name}" for name in camera_names}
         have = {k for k in group if k.startswith("time_")}
         if expected != have:
-            raise ValueError(
+            raise SartInputError(
                 f"Cannot resume into {filename}: camera set mismatch "
                 f"(file has {sorted(have)}, run has {sorted(expected)})."
             )

@@ -15,6 +15,8 @@ from typing import List, Optional, Sequence
 import h5py
 import numpy as np
 
+from sartsolver_tpu.config import SartInputError
+
 CARTESIAN = 0
 CYLINDRICAL = 1
 
@@ -132,7 +134,7 @@ class CartesianVoxelGrid(BaseVoxelGrid):
 
     def read_hdf5(self, filenames: Sequence[str], group_name: str) -> None:
         if get_coordinate_system_hdf5(filenames[0], group_name) == CYLINDRICAL:
-            raise ValueError("CartesianVoxelGrid cannot read cylindrical voxel map.")
+            raise SartInputError("CartesianVoxelGrid cannot read cylindrical voxel map.")
         super().read_hdf5(filenames, group_name)
 
     def voxel_index(self, x: float, y: float, z: float) -> int:
@@ -154,13 +156,13 @@ class CylindricalVoxelGrid(BaseVoxelGrid):
     def read_hdf5(self, filenames: Sequence[str], group_name: str) -> None:
         with h5py.File(filenames[0], "r") as f:
             if "coordinate_system" not in f[group_name].attrs:
-                raise ValueError("CylindricalVoxelGrid cannot read Cartesian voxel map.")
+                raise SartInputError("CylindricalVoxelGrid cannot read Cartesian voxel map.")
         if get_coordinate_system_hdf5(filenames[0], group_name) == CARTESIAN:
-            raise ValueError("CylindricalVoxelGrid cannot read Cartesian voxel map.")
+            raise SartInputError("CylindricalVoxelGrid cannot read Cartesian voxel map.")
         super().read_hdf5(filenames, group_name)
         period = self.ymax - self.ymin
         if math.fmod(360.0, period) > 0.001:
-            raise ValueError(f"{period} is not a divisor of 360.")
+            raise SartInputError(f"{period} is not a divisor of 360.")
 
     def voxel_index(self, x: float, y: float, z: float) -> int:
         """Point -> voxel in (r, phi, z) with periodic phi

@@ -287,7 +287,13 @@ def solve_normalized_batch(
     has_pen = problem.laplacian is not None
     if fused is not None:
         alpha = float(opts.relaxation)
-        eps_f = float(max(opts.log_epsilon, MIN_POSITIVE))
+        # same clamping rule as the unfused path's `eps` (_tiny leaves
+        # log_epsilon <= 0 alone), so fused and unfused log solves agree
+        # for every log_epsilon value; computed in Python because Pallas
+        # update closures need literal constants
+        eps_f = float(opts.log_epsilon)
+        if 0.0 < eps_f < MIN_POSITIVE:
+            eps_f = MIN_POSITIVE
         if opts.logarithmic:
             vm32 = vmask.astype(dtype)[None, :]
 

@@ -62,11 +62,9 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 return None
             lib = ctypes.CDLL(so)
             lib.sart_native_abi_version.restype = ctypes.c_int
-            if lib.sart_native_abi_version() != 1:
+            if lib.sart_native_abi_version() != 2:
                 _build_failed = True
                 return None
-            lib.sart_masked_compact_f64.argtypes = [
-                _f64p, _i64p, ctypes.c_int64, _f64p]
             lib.sart_scatter_coo_f32.argtypes = [
                 _f32p, ctypes.c_int64, _i64p, _i64p, _f32p, ctypes.c_int64]
         except (OSError, AttributeError):
@@ -78,19 +76,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
 
 
 # -- high-level wrappers (native when available, NumPy otherwise) ----------
-
-def masked_compact(full: np.ndarray, mask_indices: np.ndarray) -> np.ndarray:
-    """Gather frame values at masked positions (image.cpp:307-315)."""
-    full = np.ascontiguousarray(full, np.float64).ravel()
-    idx = np.ascontiguousarray(mask_indices, np.int64)
-    lib = get_lib()
-    out = np.empty(idx.shape[0], np.float64)
-    if lib is not None:
-        lib.sart_masked_compact_f64(full, idx, idx.shape[0], out)
-    else:
-        out[:] = full[idx]
-    return out
-
+# (Frame-mask compaction deliberately has NO native path: measured slower
+# than NumPy's gather — see sartrt.cpp header and BASELINE.md.)
 
 def scatter_coo(mat: np.ndarray, rows: np.ndarray, cols: np.ndarray,
                 vals: np.ndarray) -> None:

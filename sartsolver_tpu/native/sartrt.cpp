@@ -7,26 +7,20 @@
 // provides the same operations as a small C ABI consumed via ctypes, with a
 // NumPy fallback on the Python side when the shared object is unavailable.
 //
-// Design notes (deliberately different from the reference):
-// - compaction takes a precomputed index list (mask positions) instead of
-//   rescanning the boolean mask per frame: O(masked) instead of O(H*W),
-//   and the index list is computed once per camera, not once per frame.
-// - the scatter takes already-filtered/offset triplets; filtering happens
-//   where the file metadata lives (Python), the tight store loop here.
+// Scope note: only the COO scatter lives here. Measured on this host
+// (BASELINE.md, ingest microbenchmark): the native scatter beats NumPy
+// fancy-index assignment ~1.8x (it skips the take/put dispatch and bounds
+// machinery per element); a native masked-gather was also tried and was
+// *slower* than NumPy's take (wrapper overhead dominates), so frame
+// compaction stays pure NumPy (io/image.py).
+//
+// Design note (deliberately different from the reference): the scatter
+// takes already-filtered/offset triplets; filtering happens where the file
+// metadata lives (Python), the tight store loop here.
 
 #include <cstdint>
 
 extern "C" {
-
-// out[i] = full[mask_indices[i]] for i in [0, n_masked) — one camera frame.
-void sart_masked_compact_f64(const double* full,
-                             const int64_t* mask_indices,
-                             int64_t n_masked,
-                             double* out) {
-    for (int64_t i = 0; i < n_masked; ++i) {
-        out[i] = full[mask_indices[i]];
-    }
-}
 
 // mat[rows[i] * nvoxel + cols[i]] = vals[i] — dense row-block scatter of a
 // sparse RTM segment. Rows are block-local, cols global. The store loop is
@@ -42,6 +36,6 @@ void sart_scatter_coo_f32(float* mat,
     }
 }
 
-int sart_native_abi_version() { return 1; }
+int sart_native_abi_version() { return 2; }
 
 }  // extern "C"

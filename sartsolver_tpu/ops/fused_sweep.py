@@ -63,7 +63,8 @@ def pick_block_voxels(
 ) -> int:
     """Largest voxel-panel width (multiple of 128, dividing nvoxel) whose
     per-panel VMEM footprint — the RTM panel plus the batch-scaled
-    [B, bs] operand panels — fits the budget; 0 if even the minimum fits."""
+    [B, bs] operand panels — fits the budget; 0 if even the minimum block
+    does not fit the budget (or nvoxel is not a multiple of 128)."""
     if nvoxel % _MIN_BLOCK_VOXELS:
         return 0
     per_voxel = npixel * itemsize + _VOXEL_PANEL_OPERANDS * batch * 4

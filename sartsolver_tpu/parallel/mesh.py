@@ -125,7 +125,11 @@ def make_mesh(n_pixel_shards: int | None = None, n_voxel_shards: int = 1, device
         n_pixel_shards = len(devices) // n_voxel_shards
     ndev = n_pixel_shards * n_voxel_shards
     if ndev > len(devices):
-        raise ValueError(
+        from sartsolver_tpu.config import SartInputError
+
+        # reachable from the CLI's --pixel_shards/--voxel_shards flags:
+        # gets the polite message + exit(1), not a traceback
+        raise SartInputError(
             f"Mesh {n_pixel_shards}x{n_voxel_shards} needs {ndev} devices, "
             f"have {len(devices)}."
         )

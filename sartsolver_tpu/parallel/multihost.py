@@ -61,8 +61,14 @@ def initialize(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except RuntimeError as err:  # already initialized (race or old JAX)
-        if "only be called once" not in str(err):
+    except RuntimeError as err:
+        # "only be called once": already initialized (race or old JAX).
+        # "must be called before": the XLA backend is already up in this
+        # process (e.g. a second CLI invocation in one interpreter) — the
+        # multi-controller runtime can't start anymore; continue
+        # single-process, which is what such a process is.
+        if ("only be called once" not in str(err)
+                and "must be called before" not in str(err)):
             raise
     except ValueError as err:
         # No coordinator discoverable (not on a pod, no JAX_COORDINATOR_*
@@ -198,6 +204,113 @@ def read_and_shard_rtm(
     return jax.make_array_from_single_device_arrays(
         (padded_rows, padded_cols), sharding, arrays
     )
+
+
+def process_pixel_range(mesh, npixel: int):
+    """Logical pixel range ``(offset, count)`` covered by this process's
+    devices, or ``None`` when its row blocks are not contiguous.
+
+    The reference slices each rank's pixel range of every frame at read
+    time (image.cpp:282-321); this is the process-level equivalent for
+    multi-host runs: each host's ``CompositeImage`` reads and caches only
+    these rows, and the solver stages the measurement sharded instead of
+    replicated. ``count`` can be 0 for a process owning only padding rows.
+    """
+    n_pix = mesh.shape.get(PIXEL_AXIS, 1)
+    padded_rows = padded_size(npixel, n_pix * ROW_ALIGN)
+    row_block = padded_rows // n_pix
+    blocks = sorted({
+        int(i)
+        for (i, _j), dev in np.ndenumerate(_device_grid(mesh))
+        if dev.process_index == jax.process_index()
+    })
+    if not blocks:
+        return (0, 0)
+    if blocks != list(range(blocks[0], blocks[0] + len(blocks))):
+        return None
+    start = min(blocks[0] * row_block, npixel)
+    stop = min((blocks[-1] + 1) * row_block, npixel)
+    return (start, stop - start)
+
+
+def all_processes_sliceable(mesh, npixel: int) -> bool:
+    """True iff EVERY process has a contiguous, non-empty pixel range.
+
+    Deterministic in (mesh, npixel) — every process sees the full device
+    grid, so all processes compute the same answer with no communication.
+    This is the gate for per-process measurement slicing: the local and
+    replicated staging paths issue different collectives, so the choice
+    must be unanimous or the multihost run desynchronizes.
+    """
+    n_pix = mesh.shape.get(PIXEL_AXIS, 1)
+    padded_rows = padded_size(npixel, n_pix * ROW_ALIGN)
+    row_block = padded_rows // n_pix
+    blocks_by_proc: Dict[int, list] = {}
+    for (i, _j), dev in np.ndenumerate(_device_grid(mesh)):
+        blocks_by_proc.setdefault(dev.process_index, []).append(int(i))
+    for blocks in blocks_by_proc.values():
+        blocks = sorted(set(blocks))
+        if blocks != list(range(blocks[0], blocks[0] + len(blocks))):
+            return False
+        start = min(blocks[0] * row_block, npixel)
+        stop = min((blocks[-1] + 1) * row_block, npixel)
+        if stop - start <= 0:
+            return False  # a process owning only padding rows
+    return True
+
+
+def broadcast_resume_state(state, nvoxel: int, error: Optional[str] = None):
+    """Process-0's resume view, agreed on by every process.
+
+    With ``--multihost --resume`` the output file may live on a filesystem
+    only process 0 can see; if each process read it independently they
+    would compute different already-written frame sets and the collective
+    frame loop would desynchronize (or deadlock). Only process 0 reads the
+    file (cli.py); this broadcasts its ``ResumeState`` (or None) so all
+    processes skip exactly the same frames and share the warm start.
+
+    ``error`` (process 0 only): the resume read failed with this message.
+    It is broadcast FIRST and re-raised as ``SartInputError`` on every
+    process, so the whole job exits cleanly instead of process 0 exiting
+    alone while the others hang in this collective.
+    """
+    from sartsolver_tpu.config import SartInputError
+
+    if jax.process_count() == 1:
+        if error is not None:
+            raise SartInputError(error)
+        return state
+    from jax.experimental import multihost_utils as mhu
+
+    from sartsolver_tpu.io.solution import ResumeState
+
+    primary = jax.process_index() == 0
+    err_bytes = (error or "").encode() if primary else b""
+    if primary:
+        meta = np.array([
+            0 if state is None else 1,
+            0 if state is None else len(state.times),
+            1 if state is not None and state.last_solution is not None else 0,
+            len(err_bytes),
+        ], np.int64)
+    else:
+        meta = np.zeros(4, np.int64)
+    meta = np.asarray(mhu.broadcast_one_to_all(meta))
+    if meta[3] > 0:
+        buf = np.frombuffer(err_bytes.ljust(int(meta[3]), b" "), np.uint8) \
+            if primary else np.zeros(int(meta[3]), np.uint8)
+        buf = np.asarray(mhu.broadcast_one_to_all(buf))
+        raise SartInputError(bytes(buf.tobytes()).decode().rstrip())
+    if meta[0] == 0:
+        return None
+    ntimes, has_last = int(meta[1]), bool(meta[2])
+    times = state.times if primary else np.zeros(ntimes, np.float64)
+    times = np.asarray(mhu.broadcast_one_to_all(np.asarray(times, np.float64)))
+    last = None
+    if has_last:
+        last = state.last_solution if primary else np.zeros(nvoxel, np.float64)
+        last = np.asarray(mhu.broadcast_one_to_all(np.asarray(last, np.float64)))
+    return ResumeState(times, last)
 
 
 def make_global(host_value: np.ndarray, mesh, spec: P) -> jax.Array:

@@ -256,34 +256,109 @@ class DistributedSARTSolver:
             self._solve_fns[use_guess] = jax.jit(fn)
         return self._solve_fns[use_guess]
 
-    def solve_batch(self, measurements, f0=None) -> SolveResult:
+    def local_pixel_range(self):
+        """See :func:`multihost.process_pixel_range`."""
+        from sartsolver_tpu.parallel.multihost import process_pixel_range
+
+        return process_pixel_range(self.mesh, self.npixel)
+
+    def _stage_measurement_local(self, G: np.ndarray, norms: np.ndarray,
+                                 dtype) -> jax.Array:
+        """Per-device staging of process-local measurement slices.
+
+        ``G`` holds only this process's pixel rows (``local_pixel_range``).
+        Each device gets its padded row block directly (padding rows are -1
+        = saturated, excluded everywhere, Eq. 6); the global array is
+        assembled sharded ``P(None, 'pixels')`` with no replicated
+        [B, padded_npixel] host copy (the reference's per-rank measurement
+        slice, image.cpp:282-321)."""
+        from sartsolver_tpu.parallel.multihost import _device_grid
+
+        off0, _cnt = self.local_pixel_range()
+        B = G.shape[0]
+        rb = self.padded_npixel // self.n_pixel_shards
+        arrays = []
+        for (i, _j), dev in np.ndenumerate(_device_grid(self.mesh)):
+            if dev.process_index != jax.process_index():
+                continue
+            r0 = i * rb
+            block = np.full((B, rb), -1.0, dtype)
+            n_log = max(0, min(self.npixel - r0, rb))
+            if n_log > 0:
+                block[:, :n_log] = G[:, r0 - off0:r0 - off0 + n_log] / norms[:, None]
+            arrays.append(jax.device_put(block, dev))
+        return jax.make_array_from_single_device_arrays(
+            (B, self.padded_npixel),
+            NamedSharding(self.mesh, P(None, PIXEL_AXIS)),
+            arrays,
+        )
+
+    def solve_batch(self, measurements, f0=None, *, local: bool = False) -> SolveResult:
         """Solve B independent frames in one batched device program.
 
         Per-frame semantics are identical to :meth:`solve`; intended for
         ``no_guess`` workloads (no warm-start dependency between frames).
         Returns a SolveResult of arrays: solution [B, nvoxel], status [B],
         iterations [B], convergence [B].
+
+        ``local=True``: ``measurements`` hold only this process's pixel
+        rows (``local_pixel_range``); the measurement max/'norm' and
+        ``||g||^2`` are combined across processes, and staging is
+        per-device-sharded instead of replicated per host.
         """
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
         G = np.asarray(measurements, np.float64)
-        if G.ndim != 2 or G.shape[1] != self.npixel:
+        if local:
+            rng = self.local_pixel_range()
+            if rng is None:
+                raise ValueError(
+                    "local measurement staging needs this process's row "
+                    "blocks to be contiguous; pass full frames instead."
+                )
+            expected = rng[1]
+        else:
+            expected = self.npixel
+        if G.ndim != 2 or G.shape[1] != expected:
             raise ValueError(
-                f"Measurements must be [B, {self.npixel}], got {G.shape}."
+                f"Measurements must be [B, {expected}], got {G.shape}."
             )
         B = G.shape[0]
 
-        norms = np.empty(B)
-        msqs = np.empty(B)
-        g_stage = np.empty((B, self.padded_npixel), dtype)
-        for b in range(B):
-            g64, msq, norm = prepare_measurement(G[b], opts)
-            g_stage[b] = pad_measurement(
-                g64, self.n_pixel_shards, target=self.padded_npixel
-            )
-            norms[b], msqs[b] = norm, msq
+        if local:
+            # prepare_measurement semantics over process-local slices:
+            # global max (the fp32 normalization guard, MPI_Allreduce MAX
+            # parity, sartsolver_cuda.cpp:146-150) and global masked
+            # ||g||^2 (sartsolver.cpp:161-164) from cheap scalar gathers.
+            lmax = G.max(axis=1, initial=0.0)
+            lsum = np.sum(np.where(G > 0, G, 0.0) ** 2, axis=1)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils as mhu
 
-        g_dev = _stage(g_stage, self.mesh, P(None, PIXEL_AXIS))
+                allv = np.asarray(mhu.process_allgather(np.stack([lmax, lsum])))
+                gmax = allv[:, 0].max(axis=0)
+                gsum = allv[:, 1].sum(axis=0)
+            else:
+                gmax, gsum = lmax, lsum
+            if opts.normalize:
+                norms = np.where(gmax > 0, gmax, 1.0)
+            else:
+                norms = np.ones(B)
+            msqs = gsum / norms ** 2
+            msqs = np.where(msqs > 0, msqs, 1.0)
+            g_dev = self._stage_measurement_local(G, norms, dtype)
+        else:
+            norms = np.empty(B)
+            msqs = np.empty(B)
+            g_stage = np.empty((B, self.padded_npixel), dtype)
+            for b in range(B):
+                g64, msq, norm = prepare_measurement(G[b], opts)
+                g_stage[b] = pad_measurement(
+                    g64, self.n_pixel_shards, target=self.padded_npixel
+                )
+                norms[b], msqs[b] = norm, msq
+
+            g_dev = _stage(g_stage, self.mesh, P(None, PIXEL_AXIS))
         use_guess = f0 is None
         f0_np = np.zeros((B, self.padded_nvoxel), dtype)
         if not use_guess:
@@ -301,16 +376,22 @@ class DistributedSARTSolver:
             _fetch(res.convergence).astype(np.float64),
         )
 
-    def solve(self, measurement, f0=None) -> SolveResult:
+    def solve(self, measurement, f0=None, *, local: bool = False) -> SolveResult:
         """Solve one frame — the B=1 case of :meth:`solve_batch`."""
-        if np.shape(measurement)[0] != self.npixel:
+        if local:
+            rng = self.local_pixel_range()
+            expected = rng[1] if rng is not None else np.shape(measurement)[0]
+        else:
+            expected = self.npixel
+        if np.shape(measurement)[0] != expected:
             raise ValueError(
                 f"Measurement has {np.shape(measurement)[0]} pixels, "
-                f"expected {self.npixel}."
+                f"expected {expected}."
             )
         res = self.solve_batch(
             np.asarray(measurement)[None, :],
             None if f0 is None else np.asarray(f0)[None, :],
+            local=local,
         )
         return SolveResult(
             res.solution[0], int(res.status[0]),

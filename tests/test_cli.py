@@ -225,3 +225,49 @@ def test_timing_flag_prints_summary(world, capsys):
     for phase in ("validate + index inputs", "ingest RTM + upload",
                   "solve frame", "write voxel map"):
         assert phase in out
+
+
+def test_internal_error_propagates(world, monkeypatch):
+    """VERDICT r1 #7: the polite exit-1 funnel is for input errors only —
+    an internal bug (e.g. a shape error in the solver) must traceback."""
+    paths, *_ = world
+    from sartsolver_tpu.parallel import sharded
+
+    def boom(self, *a, **kw):
+        raise ValueError("internal solver bug")
+
+    monkeypatch.setattr(sharded.DistributedSARTSolver, "solve_batch", boom)
+    with pytest.raises(ValueError, match="internal solver bug"):
+        run_cli(paths)
+
+
+def test_multihost_resume_appends(world, capsys):
+    """--multihost --resume single-process: process-0 read + broadcast path."""
+    paths, *_ = world
+    assert run_cli(paths, "-t", "0:0.25", "-m", "50") == 0
+    n_first = capsys.readouterr().out.count("Processed in:")
+    assert run_cli(paths, "--resume", "--multihost", "-m", "50") == 0
+    n_second = capsys.readouterr().out.count("Processed in:")
+    assert n_first >= 1 and n_second >= 1
+    import h5py
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/value"].shape[0] == n_first + n_second
+
+
+def test_mesh_flag_error_is_polite(world, capsys):
+    """--pixel_shards beyond the device count is a flag mistake: message +
+    exit(1), not a traceback (SartInputError funnel)."""
+    paths, *_ = world
+    assert run_cli(paths, "--pixel_shards", "4096") == 1
+    assert "devices" in capsys.readouterr().err
+
+
+def test_multihost_resume_error_raises_everywhere(world):
+    """A broken resume file in --multihost must fail the job cleanly (the
+    error is broadcast before any process can hang in the collective)."""
+    paths, *_ = world
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.parallel import multihost as mh
+
+    with pytest.raises(SartInputError, match="corrupt"):
+        mh.broadcast_resume_state(None, 16, error="resume file corrupt")

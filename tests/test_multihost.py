@@ -208,3 +208,14 @@ def test_read_and_shard_rtm_1d_mesh(world):
     np.testing.assert_array_equal(
         np.asarray(global_rtm)[:npixel, :nvoxel], direct
     )
+
+
+def test_broadcast_resume_state_single_process_passthrough():
+    """Single-process: broadcast is the identity (the broadcast itself needs
+    a real multi-process runtime; the CLI wiring is covered by test_cli's
+    --multihost resume run)."""
+    from sartsolver_tpu.io.solution import ResumeState
+
+    state = ResumeState(np.array([1.0, 2.0]), np.ones(5))
+    assert mh.broadcast_resume_state(state, 5) is state
+    assert mh.broadcast_resume_state(None, 5) is None

@@ -14,20 +14,7 @@ import fixtures as fx
 def test_native_lib_builds():
     lib = native.get_lib()
     assert lib is not None, "g++ toolchain present but native build failed"
-    assert lib.sart_native_abi_version() == 1
-
-
-def test_masked_compact_matches_numpy():
-    rng = np.random.default_rng(0)
-    full = rng.uniform(size=300)
-    idx = np.sort(rng.choice(300, 120, replace=False)).astype(np.int64)
-    out = native.masked_compact(full, idx)
-    np.testing.assert_array_equal(out, full[idx])
-
-
-def test_masked_compact_empty():
-    out = native.masked_compact(np.zeros(10), np.empty(0, np.int64))
-    assert out.shape == (0,)
+    assert lib.sart_native_abi_version() == 2
 
 
 def test_scatter_coo_matches_numpy():
