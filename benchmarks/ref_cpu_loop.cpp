@@ -1,0 +1,77 @@
+// Measured stand-in for the reference's CPU solver hot loop.
+//
+// The reference cannot be rebuilt in this image (no mpi.h / hdf5.h dev
+// headers), so this reproduces the *computational structure* of one SART
+// iteration of its fp64 CPU path — implemented from the update equation
+// (manual Eq. 2) and the loop shape documented in SURVEY.md §3.2
+// (sartsolver.cpp:180-229): a voxel-major back-projection sweep over the
+// dense row block, the additive update with non-negativity clamp, then a
+// pixel-major forward projection, per iteration. No MPI (single rank) and
+// no Laplacian (matching bench.py's headline config).
+//
+// Build & run (see BASELINE.md):
+//   g++ -O3 -march=native -std=c++17 benchmarks/ref_cpu_loop.cpp -o /tmp/refloop
+//   /tmp/refloop [npixel nvoxel iters]
+// Prints iterations/sec of the fp64 scalar-loop formulation.
+
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <random>
+#include <vector>
+
+int main(int argc, char** argv) {
+    const long P = argc > 1 ? atol(argv[1]) : 1024;
+    const long V = argc > 2 ? atol(argv[2]) : 8192;
+    const int iters = argc > 3 ? atoi(argv[3]) : 20;
+
+    std::mt19937_64 rng(0);
+    std::uniform_real_distribution<float> u(0.1f, 1.0f);
+    std::vector<float> H(P * V);          // fp32 storage (raytransfer.hpp:20)
+    for (auto& h : H) h = u(rng);
+
+    std::vector<double> f(V, 0.5), g(P), fitted(P), diff(V);
+    std::vector<double> rho(V, 0.0), lambda(P, 0.0);
+    for (long j = 0; j < P; ++j)
+        for (long i = 0; i < V; ++i) {
+            rho[i] += H[j * V + i];
+            lambda[j] += H[j * V + i];
+        }
+    for (long j = 0; j < P; ++j) g[j] = 0.9 * lambda[j];  // consistent RHS
+    for (long j = 0; j < P; ++j) {
+        double acc = 0.0;
+        for (long i = 0; i < V; ++i) acc += H[j * V + i] * f[i];
+        fitted[j] = acc;
+    }
+
+    const double alpha = 1.0;
+    auto t0 = std::chrono::steady_clock::now();
+    for (int k = 0; k < iters; ++k) {
+        // back-projection: diff_i = alpha/rho_i * sum_j H_ij (g_j-fit_j)/lambda_j
+        for (long i = 0; i < V; ++i) diff[i] = 0.0;
+        for (long j = 0; j < P; ++j) {
+            const double w = (g[j] - fitted[j]) / lambda[j];
+            for (long i = 0; i < V; ++i) diff[i] += H[j * V + i] * w;
+        }
+        for (long i = 0; i < V; ++i) {
+            double fi = f[i] + alpha / rho[i] * diff[i];
+            f[i] = fi > 0.0 ? fi : 0.0;  // non-negativity clamp
+        }
+        // forward projection
+        for (long j = 0; j < P; ++j) {
+            double acc = 0.0;
+            for (long i = 0; i < V; ++i) acc += H[j * V + i] * f[i];
+            fitted[j] = acc;
+        }
+    }
+    double secs = std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - t0).count();
+    // keep the result observable so the loops can't be dead-code-eliminated
+    double checksum = 0.0;
+    for (long i = 0; i < V; ++i) checksum += f[i];
+    printf("{\"npixel\": %ld, \"nvoxel\": %ld, \"iters\": %d, "
+           "\"iter_per_sec\": %.3f, \"checksum\": %.6e}\n",
+           P, V, iters, iters / secs, checksum);
+    return 0;
+}

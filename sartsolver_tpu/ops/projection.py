@@ -17,15 +17,28 @@ pixel-axis vectors are local, voxel-axis vectors are global/replicated.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import Array
+from jax import Array, lax
 
 
 def forward_project(rtm: Array, solution: Array, *, accum_dtype=jnp.float32) -> Array:
     """``fitted = H @ f`` — per-pixel line integrals of the emissivity.
 
     rtm: [P, V]; solution: [V] or [B, V] -> fitted: [P] or [B, P].
+
+    Expressed as a ``dot_general`` contracting the RTM's voxel axis
+    directly — NOT ``solution @ rtm.T``. The explicit ``.T`` materializes a
+    full transposed copy of the matrix, and because the RTM is a parameter
+    of the solver's ``while_loop`` body, XLA does not hoist it: the
+    tens-of-GB operand would be transposed and copied *every iteration*
+    (observed in round-2 HLO as a per-iteration ``transpose_copy`` fusion
+    costing ~30x the matmul pair on CPU and a large fraction of the TPU
+    iteration time).
     """
-    return jnp.matmul(solution, rtm.T, preferred_element_type=accum_dtype)
+    dims = (((solution.ndim - 1,), (1,)), ((), ()))
+    return lax.dot_general(
+        solution, rtm, dimension_numbers=dims,
+        preferred_element_type=accum_dtype,
+    )
 
 
 def back_project(rtm: Array, pixel_values: Array, *, accum_dtype=jnp.float32) -> Array:
@@ -33,4 +46,8 @@ def back_project(rtm: Array, pixel_values: Array, *, accum_dtype=jnp.float32) ->
 
     rtm: [P, V]; pixel_values: [P] or [B, P] -> [V] or [B, V].
     """
-    return jnp.matmul(pixel_values, rtm, preferred_element_type=accum_dtype)
+    dims = (((pixel_values.ndim - 1,), (0,)), ((), ()))
+    return lax.dot_general(
+        pixel_values, rtm, dimension_numbers=dims,
+        preferred_element_type=accum_dtype,
+    )
