@@ -43,7 +43,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 # Per-panel VMEM footprint target for the RTM panel (double-buffered by the
 # Pallas pipeline, so actual use is ~2x this plus the pixel-axis residents).
-_PANEL_BYTES_TARGET = 8 * 1024 * 1024
+# Env-tunable for on-hardware sweeps: larger panels = fewer grid steps and
+# longer DMA bursts, at the cost of VMEM headroom.
+import os as _os
+
+_PANEL_BYTES_TARGET = int(_os.environ.get(
+    "SART_FUSED_PANEL_BYTES", 8 * 1024 * 1024))
 # Budget for the blocks resident across all panels: w and the fitted
 # accumulator, each [B, P] fp32. Together with ~2x the panel target this
 # stays well inside the ~64 MB guaranteed VMEM of recent TPUs.

@@ -1,0 +1,99 @@
+"""Compiled-HLO regression guards.
+
+The SART loop's performance envelope is set by exactly two streams of the
+RTM per iteration (one with the fused sweep). Round 2 found XLA
+materializing a full transposed COPY of the RTM inside the while body —
+``solution @ rtm.T`` does not get its transpose folded when the RTM is a
+loop parameter — costing ~30x the matmul pair. These tests lower the real
+solver and assert no matrix-sized transpose/copy lives inside the loop, so
+the pathology cannot silently return with a refactor or a JAX upgrade.
+"""
+
+import functools
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sartsolver_tpu.config import SolverOptions
+from sartsolver_tpu.models.sart import (
+    SARTProblem, compute_ray_stats, solve_normalized_batch,
+)
+from sartsolver_tpu.ops.laplacian import make_laplacian
+
+P, V = 128, 1024
+
+
+def _matrix_sized_loop_copies(txt: str, threshold: int) -> list:
+    bad = []
+    for line in txt.splitlines():
+        if "while" not in line:
+            continue
+        if "transpose" not in line and " copy(" not in line:
+            continue
+        m = re.search(r"(?:f32|f64|bf16)\[([0-9,]+)\]", line)
+        if m and np.prod([int(x) for x in m.group(1).split(",")]) >= threshold:
+            bad.append(line.strip())
+    return bad
+
+
+@pytest.mark.parametrize("logarithmic", [False, True])
+@pytest.mark.parametrize("batch", [1, 8])
+def test_no_rtm_copy_inside_iteration_loop(logarithmic, batch):
+    rng = np.random.default_rng(0)
+    rtm = jnp.asarray(rng.random((P, V), np.float32))
+    dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
+    li = np.arange(V)
+    lap = make_laplacian(
+        np.r_[li, li[1:]], np.r_[li, li[:-1]],
+        np.r_[np.full(V, 2.0), np.full(V - 1, -1.0)].astype(np.float32),
+    )
+    prob = SARTProblem(rtm, dens, length, lap)
+    opts = SolverOptions(
+        max_iterations=4, conv_tolerance=1e-30, fused_sweep="off",
+        logarithmic=logarithmic,
+    )
+    g = jnp.ones((batch, P), jnp.float32)
+    msq = jnp.ones(batch, jnp.float32)
+    f0 = jnp.zeros((batch, V), jnp.float32)
+    fn = jax.jit(functools.partial(
+        solve_normalized_batch, opts=opts, axis_name=None, voxel_axis=None,
+        use_guess=True,
+    ))
+    txt = fn.lower(prob, g, msq, f0).compile().as_text()
+    bad = _matrix_sized_loop_copies(txt, P * V)
+    assert not bad, (
+        "matrix-sized transpose/copy inside the iteration loop "
+        "(each one re-streams the tens-of-GB RTM every iteration):\n"
+        + "\n".join(bad[:5])
+    )
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (1, 8)])
+def test_no_rtm_copy_inside_sharded_loop(mesh_shape):
+    from jax.sharding import NamedSharding, PartitionSpec as PS
+
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    H = np.random.default_rng(1).random((P, V), np.float32)
+    opts = SolverOptions(max_iterations=4, conv_tolerance=1e-30,
+                         fused_sweep="off")
+    s = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(*mesh_shape))
+    g = jax.device_put(
+        np.ones((1, s.padded_npixel), np.float32),
+        NamedSharding(s.mesh, PS(None, "pixels")),
+    )
+    f0 = jax.device_put(
+        np.zeros((1, s.padded_nvoxel), np.float32),
+        NamedSharding(s.mesh, PS(None, "voxels")),
+    )
+    txt = s._batch_fn(True).lower(
+        s.problem, g, jnp.ones(1, jnp.float32), f0
+    ).compile().as_text()
+    local = (s.padded_npixel // mesh_shape[0]) * (s.padded_nvoxel // mesh_shape[1])
+    bad = _matrix_sized_loop_copies(txt, local)
+    assert not bad, "\n".join(bad[:5])
