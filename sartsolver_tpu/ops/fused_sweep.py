@@ -44,11 +44,25 @@ from jax.experimental.pallas import tpu as pltpu
 # Per-panel VMEM footprint target for the RTM panel (double-buffered by the
 # Pallas pipeline, so actual use is ~2x this plus the pixel-axis residents).
 # Env-tunable for on-hardware sweeps: larger panels = fewer grid steps and
-# longer DMA bursts, at the cost of VMEM headroom.
+# longer DMA bursts, at the cost of VMEM headroom. Validated and clamped so
+# a bad value degrades to the default / a safe bound instead of pushing
+# fused_available() past what VMEM can hold (the compile self-test runs a
+# toy shape and would not catch an oversized real-shape panel).
 import os as _os
 
-_PANEL_BYTES_TARGET = int(_os.environ.get(
-    "SART_FUSED_PANEL_BYTES", 8 * 1024 * 1024))
+
+def _env_bytes(name: str, default: int, lo: int, hi: int) -> int:
+    try:
+        v = int(_os.environ.get(name, default))
+    except ValueError:
+        return default
+    return max(lo, min(v, hi))
+
+
+# hi: 2x panel (double-buffered) + 32 MB residents must stay inside the
+# ~64 MB VMEM floor of recent TPUs => panel <= 12 MB.
+_PANEL_BYTES_TARGET = _env_bytes(
+    "SART_FUSED_PANEL_BYTES", 8 << 20, 1 << 20, 12 << 20)
 # Budget for the blocks resident across all panels: w and the fitted
 # accumulator, each [B, P] fp32. Together with ~2x the panel target this
 # stays well inside the ~64 MB guaranteed VMEM of recent TPUs.

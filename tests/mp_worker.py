@@ -1,0 +1,41 @@
+"""Worker for the real two-process distributed test (test_multiprocess.py).
+
+Runs the full `sartsolve` CLI under an actual JAX multi-controller runtime
+(2 processes x 1 virtual CPU device), which exercises the cross-process
+code paths the single-process suite can only approximate: striped
+serialized RTM ingest with the global barrier, per-process measurement
+slicing, process-0-only output, and the resume-state broadcast.
+
+Usage: python mp_worker.py <rank> <nproc> <port> <outfile> <extra...> -- <inputs...>
+"""
+
+import os
+import sys
+
+
+def main() -> int:
+    rank = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    outfile = sys.argv[4]
+    sep = sys.argv.index("--")
+    extra = sys.argv[5:sep]
+    inputs = sys.argv[sep + 1:]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+    from sartsolver_tpu.parallel import multihost as mh
+
+    mh.initialize(f"127.0.0.1:{port}", nproc, rank)
+
+    from sartsolver_tpu.cli import main as cli_main
+
+    return cli_main([
+        "-o", outfile, *inputs, "--use_cpu", "-m", "100", "-c", "1e-8",
+        "--multihost", *extra,
+    ])
+
+
+if __name__ == "__main__":
+    sys.exit(main())

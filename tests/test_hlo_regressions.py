@@ -27,16 +27,62 @@ from sartsolver_tpu.ops.laplacian import make_laplacian
 P, V = 128, 1024
 
 
-def _matrix_sized_loop_copies(txt: str, threshold: int) -> list:
-    bad = []
+def _computations(txt: str) -> dict:
+    """HLO text split into {computation_name: [lines]}."""
+    comps: dict = {}
+    current = None
     for line in txt.splitlines():
-        if "while" not in line:
+        m = re.match(r"\s*(?:ENTRY\s+)?(%?[\w.\-]+)\s*\([^)]*\)\s*->.*{", line)
+        if m:
+            current = m.group(1).lstrip("%")
+            comps[current] = []
+        elif current is not None:
+            comps[current].append(line)
+    return comps
+
+
+def _while_body_names(txt: str) -> set:
+    """Computation names referenced as a while op's body= attribute."""
+    names = set()
+    for m in re.finditer(r"while\([^)]*\).*?body=%?([\w.\-]+)", txt):
+        names.add(m.group(1))
+    return names
+
+
+def _matrix_sized_loop_copies(txt: str, threshold: int) -> list:
+    """Transpose/copy ops of >= threshold elements INSIDE while bodies.
+
+    Parses the body computations a `while` op actually references (plus
+    their nested fusions) instead of substring-matching "while" on each
+    line: metadata-less copies inside the body are caught, and hoisted
+    loop-invariant copies outside it are not flagged.
+    """
+    comps = _computations(txt)
+    bodies = _while_body_names(txt)
+    assert bodies, "no while loop found in HLO — did the solver change?"
+
+    # include computations (fusions) called from a body computation
+    reachable = set()
+    frontier = [b for b in bodies]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable or name not in comps:
             continue
-        if "transpose" not in line and " copy(" not in line:
-            continue
-        m = re.search(r"(?:f32|f64|bf16)\[([0-9,]+)\]", line)
-        if m and np.prod([int(x) for x in m.group(1).split(",")]) >= threshold:
-            bad.append(line.strip())
+        reachable.add(name)
+        for line in comps[name]:
+            for m in re.finditer(r"(?:calls=|to_apply=)%?([\w.\-]+)", line):
+                frontier.append(m.group(1))
+            for m in re.finditer(r"fusion\(|call\(", line):
+                pass  # handled via calls= above
+
+    bad = []
+    for name in reachable:
+        for line in comps.get(name, []):
+            if "transpose" not in line and " copy(" not in line and "copy." not in line.split("=")[0]:
+                continue
+            m = re.search(r"(?:f32|f64|bf16)\[([0-9,]+)\]", line)
+            if m and np.prod([int(x) for x in m.group(1).split(",")]) >= threshold:
+                bad.append(f"{name}: {line.strip()}")
     return bad
 
 
