@@ -197,9 +197,24 @@ def solve_normalized(
     )
 
 
-@functools.partial(
-    jax.jit, static_argnames=("opts", "axis_name", "voxel_axis", "use_guess")
-)
+_SOLVER_STATIC_ARGS = ("opts", "axis_name", "voxel_axis", "use_guess")
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_solver(options_items):
+    """Jitted solver core, cached per frozen compiler-options dict.
+
+    The fused Pallas sweep can need a raised XLA scoped-VMEM limit at large
+    shapes (ops/fused_sweep.py:fused_compile_options); compiler options must
+    be fixed at jit time, so each distinct option set gets its own cached
+    jit wrapper."""
+    return functools.partial(
+        jax.jit,
+        static_argnames=_SOLVER_STATIC_ARGS,
+        compiler_options=dict(options_items) if options_items else None,
+    )(_solve_normalized_batch_impl)
+
+
 def solve_normalized_batch(
     problem: SARTProblem,
     g: Array,  # [B, P_local]
@@ -225,6 +240,46 @@ def solve_normalized_batch(
     match frame-by-frame solves exactly. Intended for ``--no_guess``
     workloads, where frames carry no warm-start dependency.
     """
+    kwargs = dict(
+        opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
+        use_guess=use_guess,
+    )
+    if any(
+        isinstance(leaf, jax.core.Tracer)
+        for leaf in jax.tree_util.tree_leaves((problem, g, msq, f0))
+    ):
+        # Some input is being traced by an outer jit/shard_map
+        # (parallel/sharded.py, or a user's own jit — even one closing over
+        # the problem): inline the core; compiler options belong on the
+        # outermost jit there. With all-concrete inputs a nested call still
+        # compiles separately, so the options path below stays honored.
+        return _solve_normalized_batch_impl(problem, g, msq, f0, **kwargs)
+    rtm = problem.rtm
+    options = None
+    if (
+        jax.default_backend() == "tpu"  # the raised limit is a TPU-only flag
+        and _resolve_fused(opts, axis_name, rtm, g.shape[0]) == "compiled"
+    ):
+        from sartsolver_tpu.ops.fused_sweep import fused_compile_options
+
+        opt_dict = fused_compile_options(
+            rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, g.shape[0]
+        )
+        options = tuple(sorted(opt_dict.items())) if opt_dict else None
+    return _jitted_solver(options)(problem, g, msq, f0, **kwargs)
+
+
+def _solve_normalized_batch_impl(
+    problem: SARTProblem,
+    g: Array,
+    msq: Array,
+    f0: Array,
+    *,
+    opts: SolverOptions,
+    axis_name=None,
+    voxel_axis=None,
+    use_guess: bool,
+) -> SolveResult:
     dtype = jnp.dtype(opts.dtype)
     rtm = problem.rtm
     B = g.shape[0]

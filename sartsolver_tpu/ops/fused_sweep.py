@@ -59,22 +59,80 @@ def _env_bytes(name: str, default: int, lo: int, hi: int) -> int:
     return max(lo, min(v, hi))
 
 
-# hi: 2x panel (double-buffered) + 32 MB residents must stay inside the
-# ~64 MB VMEM floor of recent TPUs => panel <= 12 MB.
+# hi: 2x panel (double-buffered) + residents must stay inside the VMEM the
+# compiler will grant the kernel (see the scoped-VMEM model below).
 _PANEL_BYTES_TARGET = _env_bytes(
     "SART_FUSED_PANEL_BYTES", 8 << 20, 1 << 20, 12 << 20)
-# Budget for the blocks resident across all panels: w and the fitted
-# accumulator, each [B, P] fp32. Together with ~2x the panel target this
-# stays well inside the ~64 MB guaranteed VMEM of recent TPUs.
-_RESIDENT_BYTES_TARGET = 32 * 1024 * 1024
 _MIN_BLOCK_VOXELS = 128  # lane width
 _SUBLANE = 8  # fp32 sublane width
+
+# XLA charges a Pallas kernel's entire VMEM footprint (double-buffered
+# operand/output blocks, scratch, plus any operands/results XLA itself
+# decides to stack-allocate in VMEM) against --xla_tpu_scoped_vmem_limit_kib,
+# which defaults to 16 MiB — NOT against the chip's full physical VMEM
+# (~128 MiB on v5e). Measured on TPU v5e 2026-07-29: an 8192x256 fp32 panel
+# (2x8.4 MiB double-buffered) already fails to compile at the default limit.
+# When the estimated footprint exceeds the default, the solver passes a
+# raised limit via jit(compiler_options=...); raising it is a bound, not an
+# allocation, and measured throughput is unchanged (306 iter/s at bs=128
+# default vs bs=512 with a 64 MiB limit). Estimates above the raise cap make
+# the shape ineligible for fusion instead.
+_SCOPED_VMEM_DEFAULT_BYTES = 16 << 20
+_SCOPED_VMEM_RAISED_KIB = 65536  # 64 MiB
+_SCOPED_VMEM_EST_CAP_BYTES = 48 << 20
 
 
 # Conservative count of [B, bs] voxel-panel operands cycling through VMEM
 # alongside the RTM panel: f, f_new, and up to three aux inputs, each
 # double-buffered by the Pallas pipeline.
 _VOXEL_PANEL_OPERANDS = 10
+
+
+def _scoped_vmem_estimate(
+    npixel: int, nvoxel: int, bs: int, itemsize: int, batch: int
+) -> int:
+    """Upper-bound estimate of the kernel's scoped-VMEM charge, bytes.
+
+    Over-estimating is safe (the solver just requests the raised limit);
+    under-estimating would reproduce the round-2 compile failure, so every
+    term XLA has been observed charging is included: double-buffered RTM
+    panels, the f32 conversion scratch for sub-fp32 storage, double-buffered
+    voxel-panel operands, the pixel-axis residents, and the [B, V]/[B, P]
+    outputs XLA stack-allocates in VMEM (observed S(1) placement)."""
+    return (
+        2 * npixel * bs * itemsize
+        + (npixel * bs * 4 if itemsize < 4 else 0)
+        + 2 * _VOXEL_PANEL_OPERANDS * batch * bs * 4
+        + 2 * batch * npixel * 4
+        + batch * (nvoxel + npixel) * 4
+    )
+
+
+def raised_vmem_options() -> dict:
+    """The compiler-options dict that raises XLA's scoped-VMEM limit —
+    single source of truth for the flag name/value (used by the solver
+    dispatcher and the sharded driver's outer jit). TPU-only flag: attach
+    only when ``jax.default_backend() == "tpu"``."""
+    return {"xla_tpu_scoped_vmem_limit_kib": str(_SCOPED_VMEM_RAISED_KIB)}
+
+
+def fused_compile_options(
+    npixel: int, nvoxel: int, itemsize: int, batch: int = 1
+) -> dict | None:
+    """XLA compiler options the fused sweep needs at these shapes.
+
+    Returns :func:`raised_vmem_options` when the estimated kernel footprint
+    exceeds XLA's default scoped-VMEM budget, else None. TPU-only flag —
+    callers must additionally gate on a TPU default backend (explicit
+    ``fused_sweep="on"`` can engage the kernel off-TPU).
+    """
+    bs = pick_block_voxels(npixel, nvoxel, itemsize, batch)
+    if bs <= 0:
+        return None
+    est = _scoped_vmem_estimate(npixel, nvoxel, bs, itemsize, batch)
+    if est <= _SCOPED_VMEM_DEFAULT_BYTES - (512 << 10):
+        return None
+    return raised_vmem_options()
 
 
 def pick_block_voxels(
@@ -98,14 +156,16 @@ def pick_block_voxels(
 
 def fused_available(npixel: int, nvoxel: int, rtm_itemsize: int, batch: int = 1) -> bool:
     """Shapes aligned for the fused sweep: pixel rows fill fp32 sublanes, a
-    voxel panel (RTM + batch-scaled operand panels) fits VMEM, and the
-    pixel-axis residents (``w`` and the ``fitted`` accumulator, [B, P]
-    each) fit their budget."""
-    return (
-        npixel % _SUBLANE == 0
-        and pick_block_voxels(npixel, nvoxel, rtm_itemsize, batch) > 0
-        and 2 * batch * npixel * 4 <= _RESIDENT_BYTES_TARGET
-    )
+    voxel panel (RTM + batch-scaled operand panels) fits the panel budget,
+    and the kernel's estimated scoped-VMEM footprint stays within the raise
+    cap (see :func:`fused_compile_options`)."""
+    if npixel % _SUBLANE:
+        return False
+    bs = pick_block_voxels(npixel, nvoxel, rtm_itemsize, batch)
+    if bs <= 0:
+        return False
+    est = _scoped_vmem_estimate(npixel, nvoxel, bs, rtm_itemsize, batch)
+    return est <= _SCOPED_VMEM_EST_CAP_BYTES
 
 
 _selftest_result: dict = {}

@@ -253,7 +253,21 @@ class DistributedSARTSolver:
                 out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
                 check_vma=False,
             )
-            self._solve_fns[use_guess] = jax.jit(fn)
+            # The per-shard fused Pallas sweep can need a raised scoped-VMEM
+            # limit (ops/fused_sweep.py); the option must sit on THIS outer
+            # jit (the solver core is inlined under shard_map). Attaching the
+            # raised limit when fusion is merely possible is harmless — it is
+            # a bound, not an allocation (measured throughput unchanged).
+            options = None
+            if (
+                pixel_axis is None
+                and opts.fused_sweep != "off"
+                and jax.default_backend() == "tpu"
+            ):
+                from sartsolver_tpu.ops.fused_sweep import raised_vmem_options
+
+                options = raised_vmem_options()
+            self._solve_fns[use_guess] = jax.jit(fn, compiler_options=options)
         return self._solve_fns[use_guess]
 
     def local_pixel_range(self):

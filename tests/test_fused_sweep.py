@@ -146,3 +146,62 @@ def test_selftest_returns_cached_bool():
     # cached per backend (bool identity alone would hold vacuously)
     assert jax.default_backend() in fs._selftest_result
     assert fs.fused_selftest() == first
+
+
+def test_scoped_vmem_model():
+    """Pin the scoped-VMEM accounting to what TPU v5e measurements showed
+    (2026-07-29): the benchmark shapes must be eligible, with the raised
+    compiler limit requested exactly when XLA's 16 MiB default would OOM."""
+    from sartsolver_tpu.ops.fused_sweep import (
+        _SCOPED_VMEM_RAISED_KIB, fused_compile_options,
+    )
+
+    P, V = 8192, 65536
+    # bf16 B=32 OOMed at the default limit in round 2; it must stay eligible
+    # and request the raised limit rather than being declined or crashing.
+    assert fused_available(P, V, 2, batch=32)
+    opt = fused_compile_options(P, V, 2, batch=32)
+    assert opt == {"xla_tpu_scoped_vmem_limit_kib": str(_SCOPED_VMEM_RAISED_KIB)}
+    # the B=1 headline configs also clear the raise cap
+    assert fused_available(P, V, 4, batch=1)
+    assert fused_available(P, V, 2, batch=1)
+    # a tiny problem fits the default budget: no options needed
+    assert fused_compile_options(8, 256, 4, batch=1) is None
+    # absurd batch blows past the raise cap -> ineligible (two-matmul path)
+    assert not fused_available(P, V, 4, batch=4096)
+
+
+def test_compiler_options_dispatch_cpu_safe():
+    """The dispatch wrapper must never attach the TPU-only flag off-TPU
+    (auto resolves unfused on CPU) and must stay callable under an outer
+    trace (sharded path inlines the core)."""
+    import jax
+
+    from sartsolver_tpu.models import sart
+
+    H, g = _case()
+    opts = SolverOptions(max_iterations=3, conv_tolerance=1e-12, fused_sweep="auto")
+    res = _solve(H, g, opts)
+    assert np.isfinite(np.asarray(res.solution)).all()
+    # the CPU path must have dispatched through the option-less jit core
+    assert sart._jitted_solver.cache_info().currsize >= 1
+    assert sart._jitted_solver(None) is sart._jitted_solver(None)
+    # and the tracer branch (sharded path) inlines without a fresh jit
+    @jax.jit
+    def traced(rtm, gv):
+        from sartsolver_tpu.models.sart import (
+            SARTProblem, compute_ray_stats, solve_normalized_batch,
+        )
+
+        dens, length = compute_ray_stats(rtm, dtype=np.float32)
+        problem = SARTProblem(rtm, dens, length, None)
+        import jax.numpy as jnp
+
+        return solve_normalized_batch(
+            problem, gv[None, :], jnp.ones((1,), np.float32),
+            jnp.zeros((1, rtm.shape[1]), np.float32),
+            opts=opts, axis_name=None, voxel_axis=None, use_guess=True,
+        )
+
+    res2 = traced(np.asarray(H, np.float32), np.asarray(g, np.float32))
+    assert np.isfinite(np.asarray(res2.solution)).all()
