@@ -240,8 +240,10 @@ def main() -> int:
         dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
         problem = SARTProblem(rtm, dens, length, None)
         # trace-time fused decision, recorded so the judge can see which
-        # path actually ran (VERDICT r1: "fused path confirmed selected")
-        fused_sel = _resolve_fused(opts, None, rtm, B)
+        # path actually ran (VERDICT r1: "fused path confirmed selected");
+        # vmem_raised=True mirrors the dispatcher, which attaches whatever
+        # scoped-VMEM limit the shape needs
+        fused_sel = _resolve_fused(opts, None, rtm, B, vmem_raised=True)
         g_dev = jnp.asarray(G_n[:B])
         msq_dev = jnp.asarray(msqs[:B], jnp.float32)
         f0 = jnp.zeros((B, V), jnp.float32)
@@ -283,11 +285,15 @@ def main() -> int:
     sweep: list = []
     fused_possible = jax.default_backend() == "tpu"
     if on_accel and not quick:
+        # Headline candidates first (best-B=1 fused configs), then batched
+        # fused, then the two-matmul reference points — so a budget cut
+        # still leaves the numbers that matter most.
+        fused_modes = ("auto", "off") if fused_possible else ("off",)
         configs = [
             (fm, dt, B)
-            for dt in ("float32", "bfloat16")
-            for fm in (("auto", "off") if fused_possible else ("off",))
+            for fm in fused_modes
             for B in (1, 8, 32)
+            for dt in ("bfloat16", "float32")
         ]
     elif fused_possible:
         configs = [("auto", "float32", 1), ("off", "float32", 1)]

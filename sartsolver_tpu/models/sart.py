@@ -65,7 +65,9 @@ def _psum(x, axis_name):
     return lax.psum(x, axis_name) if axis_name is not None else x
 
 
-def _resolve_fused(opts: SolverOptions, axis_name, rtm, batch: int) -> Optional[str]:
+def _resolve_fused(
+    opts: SolverOptions, axis_name, rtm, batch: int, *, vmem_raised: bool = False
+) -> Optional[str]:
     """Trace-time decision for the fused Pallas sweep (ops/fused_sweep.py).
 
     Returns None (two-matmul path), "compiled", or "interpret". Fusion needs
@@ -74,6 +76,12 @@ def _resolve_fused(opts: SolverOptions, axis_name, rtm, batch: int) -> Optional[
     and fp32 compute; "auto" additionally requires a TPU backend and
     tile-aligned shapes. An explicitly requested mode that cannot be
     honoured raises instead of silently degrading.
+
+    ``vmem_raised`` says the caller attached the raised scoped-VMEM
+    compiler limit (fused_compile_options) to the jit that will compile
+    this trace. Without it, "auto" declines shapes that only compile at
+    the raised limit — e.g. under a user's own outer jit, where nothing
+    can attach compiler options — instead of failing the compile.
     """
     mode = opts.fused_sweep
     if mode == "off":
@@ -99,6 +107,12 @@ def _resolve_fused(opts: SolverOptions, axis_name, rtm, batch: int) -> Optional[
         return None
     ok = fused_available(rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, batch)
     if mode == "auto":
+        if ok and not vmem_raised:
+            from sartsolver_tpu.ops.fused_sweep import fused_compile_options
+
+            ok = fused_compile_options(
+                rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, batch
+            ) is None
         return "compiled" if ok and jax.default_backend() == "tpu" else None
     if not ok:
         raise ValueError(
@@ -197,7 +211,9 @@ def solve_normalized(
     )
 
 
-_SOLVER_STATIC_ARGS = ("opts", "axis_name", "voxel_axis", "use_guess")
+_SOLVER_STATIC_ARGS = (
+    "opts", "axis_name", "voxel_axis", "use_guess", "_vmem_raised"
+)
 
 
 @functools.lru_cache(maxsize=None)
@@ -225,6 +241,7 @@ def solve_normalized_batch(
     axis_name=None,
     voxel_axis=None,
     use_guess: bool,
+    _vmem_raised: bool = False,
 ) -> SolveResult:
     """Batched solver core: B independent frames in one while_loop.
 
@@ -248,17 +265,23 @@ def solve_normalized_batch(
         isinstance(leaf, jax.core.Tracer)
         for leaf in jax.tree_util.tree_leaves((problem, g, msq, f0))
     ):
-        # Some input is being traced by an outer jit/shard_map
-        # (parallel/sharded.py, or a user's own jit — even one closing over
-        # the problem): inline the core; compiler options belong on the
-        # outermost jit there. With all-concrete inputs a nested call still
-        # compiles separately, so the options path below stays honored.
-        return _solve_normalized_batch_impl(problem, g, msq, f0, **kwargs)
+        # Some input is being traced by an outer jit/shard_map: inline the
+        # core; compiler options belong on the outermost jit there. Only a
+        # caller that actually attached them may claim _vmem_raised
+        # (parallel/sharded.py does; a user's own jit typically has not, so
+        # the default makes auto-fusion decline needs-raised-limit shapes
+        # instead of failing their compile). With all-concrete inputs a
+        # nested call still compiles separately, so the options path below
+        # stays honored.
+        return _solve_normalized_batch_impl(
+            problem, g, msq, f0, _vmem_raised=_vmem_raised, **kwargs
+        )
     rtm = problem.rtm
     options = None
     if (
         jax.default_backend() == "tpu"  # the raised limit is a TPU-only flag
-        and _resolve_fused(opts, axis_name, rtm, g.shape[0]) == "compiled"
+        and _resolve_fused(opts, axis_name, rtm, g.shape[0], vmem_raised=True)
+        == "compiled"
     ):
         from sartsolver_tpu.ops.fused_sweep import fused_compile_options
 
@@ -266,7 +289,11 @@ def solve_normalized_batch(
             rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, g.shape[0]
         )
         options = tuple(sorted(opt_dict.items())) if opt_dict else None
-    return _jitted_solver(options)(problem, g, msq, f0, **kwargs)
+    # The dispatcher attaches whatever options the shape needs, so the core
+    # may always treat the raised limit as available.
+    return _jitted_solver(options)(
+        problem, g, msq, f0, _vmem_raised=True, **kwargs
+    )
 
 
 def _solve_normalized_batch_impl(
@@ -279,6 +306,7 @@ def _solve_normalized_batch_impl(
     axis_name=None,
     voxel_axis=None,
     use_guess: bool,
+    _vmem_raised: bool = False,
 ) -> SolveResult:
     dtype = jnp.dtype(opts.dtype)
     rtm = problem.rtm
@@ -338,7 +366,7 @@ def _solve_normalized_batch_impl(
     # Fused Pallas sweep: one HBM pass over the RTM per iteration instead of
     # two (ops/fused_sweep.py). The elementwise update closures use Python
     # float constants (Pallas kernels cannot capture traced values).
-    fused = _resolve_fused(opts, axis_name, rtm, B)
+    fused = _resolve_fused(opts, axis_name, rtm, B, vmem_raised=_vmem_raised)
     has_pen = problem.laplacian is not None
     if fused is not None:
         alpha = float(opts.relaxation)

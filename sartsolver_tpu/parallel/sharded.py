@@ -233,26 +233,6 @@ class DistributedSARTSolver:
             pixel_axis = self._pixel_axis
             voxel_axis = self._voxel_axis
 
-            def run(problem, g, msq, f0):
-                lap = problem.laplacian
-                if lap is not None:
-                    # drop the leading per-shard dim added by _shard_laplacian
-                    problem = problem._replace(
-                        laplacian=LaplacianCOO(lap.rows[0], lap.cols[0], lap.vals[0])
-                    )
-                return solve_normalized_batch(
-                    problem, g, msq, f0,
-                    opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
-                    use_guess=use_guess,
-                )
-
-            fn = jax.shard_map(
-                run,
-                mesh=self.mesh,
-                in_specs=(problem_spec, P(None, PIXEL_AXIS), P(), P(None, VOXEL_AXIS)),
-                out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
-                check_vma=False,
-            )
             # The per-shard fused Pallas sweep can need a raised scoped-VMEM
             # limit (ops/fused_sweep.py); the option must sit on THIS outer
             # jit (the solver core is inlined under shard_map). Attaching the
@@ -267,6 +247,28 @@ class DistributedSARTSolver:
                 from sartsolver_tpu.ops.fused_sweep import raised_vmem_options
 
                 options = raised_vmem_options()
+            vmem_raised = options is not None
+
+            def run(problem, g, msq, f0):
+                lap = problem.laplacian
+                if lap is not None:
+                    # drop the leading per-shard dim added by _shard_laplacian
+                    problem = problem._replace(
+                        laplacian=LaplacianCOO(lap.rows[0], lap.cols[0], lap.vals[0])
+                    )
+                return solve_normalized_batch(
+                    problem, g, msq, f0,
+                    opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
+                    use_guess=use_guess, _vmem_raised=vmem_raised,
+                )
+
+            fn = jax.shard_map(
+                run,
+                mesh=self.mesh,
+                in_specs=(problem_spec, P(None, PIXEL_AXIS), P(), P(None, VOXEL_AXIS)),
+                out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
+                check_vma=False,
+            )
             self._solve_fns[use_guess] = jax.jit(fn, compiler_options=options)
         return self._solve_fns[use_guess]
 

@@ -205,3 +205,48 @@ def test_compiler_options_dispatch_cpu_safe():
 
     res2 = traced(np.asarray(H, np.float32), np.asarray(g, np.float32))
     assert np.isfinite(np.asarray(res2.solution)).all()
+
+
+@pytest.mark.parametrize("logarithmic", [False, True])
+def test_fused_matches_unfused_bf16(logarithmic):
+    """The fused kernel feeds a bf16 panel to the dot directly (mixed
+    f32xbf16 contraction, no conversion scratch); interpreter mode must
+    agree with the unfused two-matmul path on the same bf16 RTM, pinning
+    the mixed-dtype semantics off-TPU."""
+    H, g = _case()
+    lap = _laplacian()
+    base = SolverOptions(
+        max_iterations=30, conv_tolerance=1e-12, beta_laplace=1e-3,
+        rtm_dtype="bfloat16", logarithmic=logarithmic,
+    )
+    res_f = _solve(H, g, dataclasses.replace(base, fused_sweep="interpret"), lap)
+    res_u = _solve(H, g, dataclasses.replace(base, fused_sweep="off"), lap)
+    np.testing.assert_allclose(
+        np.asarray(res_f.solution), np.asarray(res_u.solution),
+        rtol=2e-5, atol=1e-6,
+    )
+    assert int(res_f.iterations) == int(res_u.iterations)
+
+
+def test_auto_declines_raise_needing_shapes_without_options():
+    """VERDICT-r2 contract: auto-fusion must degrade, not break. A shape
+    that only compiles at the raised scoped-VMEM limit resolves fused only
+    when the caller claims it attached the limit (vmem_raised); under a
+    user's outer jit (no options attachable) it falls back to two-matmul."""
+    import jax
+    import jax.numpy as jnp
+
+    from sartsolver_tpu.models.sart import _resolve_fused
+
+    opts = SolverOptions(fused_sweep="auto", rtm_dtype="bfloat16")
+    big = jax.ShapeDtypeStruct((8192, 65536), jnp.bfloat16)
+    small = jax.ShapeDtypeStruct((24, 256), jnp.float32)
+    orig = jax.default_backend
+    jax.default_backend = lambda: "tpu"
+    try:
+        assert _resolve_fused(opts, None, big, 32, vmem_raised=True) == "compiled"
+        assert _resolve_fused(opts, None, big, 32, vmem_raised=False) is None
+        # shapes inside the default budget fuse either way
+        assert _resolve_fused(opts, None, small, 1, vmem_raised=False) == "compiled"
+    finally:
+        jax.default_backend = orig
