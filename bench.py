@@ -197,7 +197,8 @@ def main() -> int:
 
     from sartsolver_tpu.config import SolverOptions
     from sartsolver_tpu.models.sart import (
-        SARTProblem, _resolve_fused, compute_ray_stats, solve_normalized_batch,
+        SARTProblem, _resolve_fused, compute_ray_stats, make_problem,
+        solve_normalized_batch,
     )
     from sartsolver_tpu.ops.laplacian import make_laplacian
 
@@ -232,13 +233,20 @@ def main() -> int:
 
     def run_config(fused_mode: str, rtm_dtype: str, B: int) -> dict:
         """Fixed-iteration throughput of one configuration."""
+        # conv_tolerance=0 disables the stall test: quantized (int8) solves
+        # can reach their fixed point bit-exactly within a few iterations,
+        # and |dC| == 0.0 passes ANY positive tolerance
         opts = SolverOptions(
-            max_iterations=iters, conv_tolerance=1e-30,
+            max_iterations=iters, conv_tolerance=0.0,
             fused_sweep=fused_mode, rtm_dtype=rtm_dtype,
         )
-        rtm = jnp.asarray(H32, dtype=jnp.dtype(rtm_dtype))
-        dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
-        problem = SARTProblem(rtm, dens, length, None)
+        if rtm_dtype == "int8":
+            problem = make_problem(H32, None, opts=opts)
+            rtm = problem.rtm
+        else:
+            rtm = jnp.asarray(H32, dtype=jnp.dtype(rtm_dtype))
+            dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
+            problem = SARTProblem(rtm, dens, length, None)
         # trace-time fused decision, recorded so the judge can see which
         # path actually ran (VERDICT r1: "fused path confirmed selected");
         # vmem_raised=True mirrors the dispatcher, which attaches whatever
@@ -294,6 +302,11 @@ def main() -> int:
             for B in (1, 8, 32)
             for dt in ("bfloat16", "float32")
         ]
+        if fused_possible:
+            # quantized storage (fused-only; excluded from the headline —
+            # it solves a perturbed system, reported as sweep detail)
+            primary[2:2] = [("auto", "int8", 1)]
+            primary.append(("auto", "int8", 32))
         secondary = [
             ("off", dt, B)
             for B in (1, 8, 32)
@@ -422,7 +435,10 @@ def main() -> int:
     # Headline: best B=1 configuration (apples-to-apples with the
     # reference's one-frame-at-a-time loop); batched multipliers are in
     # "detail.sweep" as frame_iter_s.
-    b1 = [r for r in ok if r["B"] == 1] or ok
+    # int8 solves a (slightly) perturbed quantized system — sweep detail
+    # only, never the apples-to-apples headline
+    honest = [r for r in ok if r["rtm_dtype"] != "int8"] or ok
+    b1 = [r for r in honest if r["B"] == 1] or honest
     head = max(b1, key=lambda r: r["loop_iter_s"])
     vs_baseline = head["loop_iter_s"] / bar
 

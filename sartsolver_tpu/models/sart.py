@@ -52,6 +52,9 @@ class SARTProblem(NamedTuple):
     ray_density: Array  # [V], opts.dtype
     ray_length: Array  # [P_local], opts.dtype
     laplacian: Optional[LaplacianCOO]  # COO over [V, V], or None
+    # Per-voxel dequantization scales when the RTM is int8-quantized
+    # (H_ij = rtm_scale[j] * rtm[i, j]); None for fp32/bf16 storage.
+    rtm_scale: Optional[Array] = None  # [V], fp32
 
 
 class SolveResult(NamedTuple):
@@ -96,13 +99,13 @@ def _resolve_fused(
             )
         return None
     if jnp.dtype(opts.dtype) != jnp.float32 or rtm.dtype not in (
-        jnp.float32, jnp.bfloat16
+        jnp.float32, jnp.bfloat16, jnp.int8
     ):
         if explicit:
             raise ValueError(
                 f"fused_sweep='{mode}' requested but dtype={opts.dtype} / "
                 f"rtm dtype={rtm.dtype}; the fused sweep computes in fp32 "
-                "(fp32 or bfloat16 RTM storage)."
+                "(fp32, bfloat16 or quantized int8 RTM storage)."
             )
         return None
     ok = fused_available(rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, batch)
@@ -150,6 +153,65 @@ def compute_ray_stats(
     return dens, length.astype(dtype)
 
 
+# int8 x int8 dots accumulate in int32: |codes| <= 127 on both sides bounds
+# the contraction extent at 2^31 / 127^2 (~133k); enforced in make_problem.
+INT8_MAX_CONTRACTION = (2**31 - 1) // (127 * 127)
+
+
+def _quantize_sym(x: Array, axis: int) -> Tuple[Array, Array]:
+    """Symmetric int8 quantization along ``axis``: ``x ~= scale * codes``
+    with ``|codes| <= 127``; all-zero slices get scale 1 (codes stay 0).
+    The single source of the recipe shared by the RTM storage quantizer
+    and the per-call vector quantization of the integer projections."""
+    amax = jnp.max(jnp.abs(x), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    codes = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def int8_back_project(codes, scale, w, *, accum_dtype=jnp.float32):
+    """``H^T w`` for an int8-quantized RTM, without dequantizing it.
+
+    ``w`` is quantized per batch row (max-abs/127) so the contraction runs
+    as an integer MXU dot and is rescaled exactly afterwards; the only
+    approximation is the ~1/254 relative rounding of ``w``. Used outside
+    the iteration loop (initial guess, log-mode ``obs``); the loop itself
+    dequantizes codes exactly (ops/fused_sweep.py).
+    """
+    wq, ws = _quantize_sym(w, axis=-1)
+    acc = lax.dot_general(
+        wq, codes, dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(accum_dtype) * (ws * scale[None, :]).astype(accum_dtype)
+
+
+def int8_forward_project(codes, scale, f, *, accum_dtype=jnp.float32):
+    """``H f`` for an int8-quantized RTM; counterpart of
+    :func:`int8_back_project` (same quantize-rescale scheme applied to
+    ``f * scale``)."""
+    yq, ys = _quantize_sym(f * scale[None, :], axis=-1)
+    acc = lax.dot_general(
+        yq, codes, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(accum_dtype) * ys.astype(accum_dtype)
+
+
+def quantize_rtm(rtm: Array) -> Tuple[Array, Array]:
+    """Per-voxel (column) symmetric int8 quantization of an RTM block.
+
+    Returns ``(codes int8 [P, V], scale fp32 [V])`` with
+    ``H ~= scale[None, :] * codes`` and ``|codes| <= 127``. RTM entries are
+    physically non-negative line integrals, so the codes use [0, 127]; the
+    per-column relative error bound is 1/254 of the column maximum — below
+    the bf16 per-entry bound for the large entries that dominate both
+    projections.
+    """
+    codes, scale = _quantize_sym(jnp.asarray(rtm, jnp.float32), axis=0)
+    return codes, scale[0]
+
+
 def make_problem(
     rtm,
     laplacian: Optional[LaplacianCOO] = None,
@@ -157,8 +219,37 @@ def make_problem(
     opts: SolverOptions,
     axis_name=None,
 ) -> SARTProblem:
-    """Build device problem state from a (local block of the) RTM."""
+    """Build device problem state from a (local block of the) RTM.
+
+    With ``opts.rtm_dtype == "int8"`` the matrix is stored as per-voxel-
+    scaled int8 codes (see :func:`quantize_rtm`); ray stats are computed
+    from the quantized matrix so the solver is self-consistent with what
+    the sweeps actually multiply by.
+    """
     dtype = jnp.dtype(opts.dtype)
+    if (opts.rtm_dtype or "") == "int8":
+        P_, V_ = np.shape(rtm)
+        if max(P_, V_) > INT8_MAX_CONTRACTION:
+            raise ValueError(
+                f"rtm_dtype='int8': RTM extent {max(P_, V_)} exceeds the "
+                f"int32-accumulation bound {INT8_MAX_CONTRACTION} of the "
+                "integer projections (int8_back_project); use "
+                "fp32/bfloat16 storage."
+            )
+        codes, scale = quantize_rtm(rtm)
+        # stats of the QUANTIZED matrix (what the sweeps multiply by), both
+        # exact: column sums as int32 x scale, row sums as an fp32
+        # contraction of the codes against the scales
+        dens = _psum(
+            scale * jnp.sum(codes, axis=0, dtype=jnp.int32).astype(dtype),
+            axis_name,
+        )
+        length = lax.dot_general(
+            codes, scale.astype(dtype),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=dtype,
+        )
+        return SARTProblem(codes, dens, length.astype(dtype), laplacian, scale)
     rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
     rtm = jnp.asarray(rtm)
     dens, length = compute_ray_stats(rtm, dtype=dtype, axis_name=axis_name)
@@ -331,12 +422,34 @@ def _solve_normalized_batch_impl(
             lambda x: coo_matvec(problem.laplacian, x, nvoxel)
         )(x_full)
 
+    # int8-quantized storage: the iteration loop dequantizes codes exactly
+    # inside the fused kernel; the handful of out-of-loop projections below
+    # run as integer dots with per-row quantization of the vector operand.
+    is_int8 = rtm.dtype == jnp.int8
+    if is_int8:
+        if problem.rtm_scale is None:
+            raise ValueError(
+                "int8 RTM needs SARTProblem.rtm_scale; build the problem "
+                "with make_problem(..., opts with rtm_dtype='int8')."
+            )
+        scale = problem.rtm_scale.astype(dtype)
+
+    def bp_any(w_):
+        if is_int8:
+            return int8_back_project(rtm, scale, w_, accum_dtype=dtype)
+        return back_project(rtm, w_, accum_dtype=dtype)
+
+    def fp_any(f_):
+        if is_int8:
+            return int8_forward_project(rtm, scale, f_, accum_dtype=dtype)
+        return forward_project(rtm, f_, accum_dtype=dtype)
+
     if use_guess:
         # f0 = H^T g / rho on unmasked voxels (Eq. 4; sartsolver.cpp:144-159);
         # the device path excludes negative measurements (sart_kernels.cu:34),
         # the CPU-parity profile does not (sartsolver.cpp:153).
         g_guess = jnp.where(g > 0, g, 0) if opts.mask_negative_guess else g
-        accum = _psum(back_project(rtm, g_guess, accum_dtype=dtype), axis_name)
+        accum = _psum(bp_any(g_guess), axis_name)
         f0 = jnp.where(vmask[None, :], accum / safe_dens[None, :], 0)
     if opts.guess_floor > 0:
         # CUDA path floors *any* starting solution at 1e-7 for both variants
@@ -350,7 +463,7 @@ def _solve_normalized_batch_impl(
         f0 = jnp.maximum(f0, _tiny(max(opts.guess_floor, opts.log_epsilon), dtype))
     f0 = f0.astype(dtype)
 
-    fitted0 = _psum(forward_project(rtm, f0, accum_dtype=dtype), voxel_axis)
+    fitted0 = _psum(fp_any(f0), voxel_axis)
 
     beta = jnp.asarray(opts.beta_laplace, dtype)
     tol = jnp.asarray(opts.conv_tolerance, dtype)
@@ -358,7 +471,7 @@ def _solve_normalized_batch_impl(
 
     if opts.logarithmic:
         obs = _psum(
-            back_project(rtm, jnp.where(meas_mask, g, 0) * inv_length, accum_dtype=dtype),
+            bp_any(jnp.where(meas_mask, g, 0) * inv_length),
             axis_name,
         )
         obs = jnp.where(vmask[None, :], obs, 0)
@@ -367,6 +480,17 @@ def _solve_normalized_batch_impl(
     # two (ops/fused_sweep.py). The elementwise update closures use Python
     # float constants (Pallas kernels cannot capture traced values).
     fused = _resolve_fused(opts, axis_name, rtm, B, vmem_raised=_vmem_raised)
+    if is_int8 and fused is None:
+        # The two-matmul loop would have to re-quantize w/f every iteration
+        # (extra error) or dequantize the matrix (4x the memory the user
+        # chose int8 to avoid) — int8 storage is a fused-sweep feature.
+        raise ValueError(
+            "rtm_dtype='int8' requires the fused sweep, but it resolved "
+            f"off (fused_sweep='{opts.fused_sweep}', pixel axis "
+            f"{'sharded' if axis_name is not None else 'unsharded'}). Use "
+            "fused_sweep='on'/'interpret' (or 'auto' on TPU with "
+            "tile-aligned shapes), or fp32/bfloat16 storage."
+        )
     has_pen = problem.laplacian is not None
     if fused is not None:
         alpha = float(opts.relaxation)
@@ -377,40 +501,59 @@ def _solve_normalized_batch_impl(
         eps_f = float(opts.log_epsilon)
         if 0.0 < eps_f < MIN_POSITIVE:
             eps_f = MIN_POSITIVE
+        # int8 variants: the raw kernel bp is in integer-code space; the
+        # per-voxel scale panel (aux 0) dequantizes it inside the update,
+        # and the same panel pre-scales the forward operand (fwd_scale=0) so
+        # ``fitted`` comes out in physical units.
         if opts.logarithmic:
             vm32 = vmask.astype(dtype)[None, :]
 
-            def update_fn(f_p, bp_p, vm_p, obs_p, *pen_p):
+            def _log_update(f_p, bp_p, vm_p, obs_p, *pen_p):
                 fit = bp_p * vm_p
                 ratio = (obs_p + eps_f) / (fit + eps_f)
                 if alpha != 1.0:
                     ratio = ratio ** alpha
                 return f_p * ratio * jnp.exp(-pen_p[0]) if pen_p else f_p * ratio
+
+            if is_int8:
+                def update_fn(f_p, bp_p, s_p, vm_p, obs_p, *pen_p):
+                    return _log_update(f_p, bp_p * s_p, vm_p, obs_p, *pen_p)
+            else:
+                update_fn = _log_update
         else:
 
-            def update_fn(f_p, bp_p, invd_p, *pen_p):
+            def _lin_update(f_p, bp_p, invd_p, *pen_p):
                 upd = f_p + invd_p * bp_p
                 if pen_p:
                     upd = upd - pen_p[0]
                 return jnp.maximum(upd, 0)
+
+            if is_int8:
+                def update_fn(f_p, bp_p, s_p, invd_p, *pen_p):
+                    return _lin_update(f_p, bp_p * s_p, invd_p, *pen_p)
+            else:
+                update_fn = _lin_update
+
+    def run_fused(w, f, aux):
+        if is_int8:
+            aux = [scale[None, :]] + aux
+        return fused_sweep(rtm, w, f, aux, update_fn,
+                           fwd_scale=0 if is_int8 else None,
+                           interpret=fused == "interpret")
 
     def run_sweep(f, fitted, penalty):
         """(f_upd, fitted_upd or None): the iteration's two RTM sweeps."""
         if opts.logarithmic:
             w = jnp.where(meas_mask, fitted, 0) * inv_length
             if fused is not None:
-                aux = [vm32, obs] + ([penalty] if has_pen else [])
-                return fused_sweep(rtm, w, f, aux, update_fn,
-                                   interpret=fused == "interpret")
+                return run_fused(w, f, [vm32, obs] + ([penalty] if has_pen else []))
             fit = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
             fit = jnp.where(vmask[None, :], fit, 0)
             ratio = ((obs + eps) / (fit + eps)) ** jnp.asarray(opts.relaxation, dtype)
             return f * ratio * jnp.exp(-penalty), None
         w = jnp.where(meas_mask, g - fitted, 0) * inv_length
         if fused is not None:
-            aux = [inv_density[None, :]] + ([penalty] if has_pen else [])
-            return fused_sweep(rtm, w, f, aux, update_fn,
-                               interpret=fused == "interpret")
+            return run_fused(w, f, [inv_density[None, :]] + ([penalty] if has_pen else []))
         bp = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
         return jnp.maximum(f + inv_density[None, :] * bp - penalty, 0), None
 

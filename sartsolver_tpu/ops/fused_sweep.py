@@ -40,7 +40,7 @@ parity with the reference (raytransfer.hpp:20) and ingest simplicity.
 from __future__ import annotations
 
 import functools
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -105,10 +105,13 @@ def _scoped_vmem_estimate(
     term XLA has been observed charging is included: double-buffered RTM
     panels, double-buffered voxel-panel operands, the pixel-axis residents,
     and the [B, V]/[B, P] outputs XLA stack-allocates in VMEM (observed
-    S(1) placement). Sub-fp32 panels feed the MXU directly (no conversion
-    scratch — see _sweep_kernel)."""
+    S(1) placement). bf16 panels feed the MXU directly (no conversion
+    scratch — see _sweep_kernel); int8 panels dequantize to a bf16 scratch
+    copy in VMEM (measured: int8 bs=512 needs 16.39M at B=1, over the
+    16M default)."""
     return (
         2 * npixel * bs * itemsize
+        + (npixel * bs * 2 if itemsize == 1 else 0)
         + 2 * _VOXEL_PANEL_OPERANDS * batch * bs * 4
         + 2 * batch * npixel * 4
         + batch * (nvoxel + npixel) * 4
@@ -229,7 +232,7 @@ def resolve_fused_auto(opts, *, pixel_sharded: bool = False):
     return dataclasses.replace(opts, fused_sweep="off")
 
 
-def _sweep_kernel(update_fn, n_aux, rtm_ref, w_ref, f_ref, *rest):
+def _sweep_kernel(update_fn, n_aux, fwd_scale, rtm_ref, w_ref, f_ref, *rest):
     aux_refs = rest[:n_aux]
     f_new_ref, fitted_ref = rest[n_aux:]
     # A reduced-precision (bf16) panel feeds the MXU directly: Mosaic
@@ -238,6 +241,13 @@ def _sweep_kernel(update_fn, n_aux, rtm_ref, w_ref, f_ref, *rest):
     # measured on v5e 2026-07-29 as the allocation that pushed large-batch
     # bf16 shapes past the scoped-VMEM limit, for no throughput gain.
     panel = rtm_ref[...]
+    if panel.dtype == jnp.int8:
+        # int8-quantized storage: dequantize the integer codes to bf16
+        # (exact — |codes| <= 127) for the MXU; the per-voxel scales are the
+        # `fwd_scale` aux panel, applied to bp inside update_fn and to the
+        # forward operand below, so the loop's math is exactly fp32 SART on
+        # the quantized matrix.
+        panel = panel.astype(jnp.bfloat16)
     # Back-projection of this panel: contraction over the full pixel axis.
     bp = jax.lax.dot_general(
         w_ref[...], panel,
@@ -248,8 +258,9 @@ def _sweep_kernel(update_fn, n_aux, rtm_ref, w_ref, f_ref, *rest):
     f_new_ref[...] = f_new
     # Forward-projection contribution of the same panel, while it is still
     # in VMEM — this is the read the two-matmul formulation pays twice for.
+    fwd = f_new if fwd_scale is None else f_new * aux_refs[fwd_scale][...]
     contrib = jax.lax.dot_general(
-        f_new, panel,
+        fwd, panel,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )  # [B, P]
@@ -270,12 +281,16 @@ def fused_sweep(
     aux: Sequence[Array],  # each [b_i, V] (b_i in {1, B}) fp32
     update_fn: Callable[..., Array],
     *,
+    fwd_scale: Optional[int] = None,
     interpret: bool = False,
 ):
     """Run one fused SART sweep; returns ``(f_new [B, V], fitted [B, P])``.
 
     ``update_fn(f_panel, bp_panel, *aux_panels) -> f_new_panel`` is applied
     elementwise per voxel panel. Shapes must satisfy :func:`fused_available`.
+    ``fwd_scale`` names an aux index whose panel scales the forward-
+    projection operand (``fitted += (f_new * aux[fwd_scale]) @ panel^T``) —
+    the per-voxel dequantization scales of an int8 RTM.
     """
     P, V = rtm.shape
     B = w.shape[0]
@@ -298,7 +313,7 @@ def fused_sweep(
         voxel_panel(B),  # f_new
         pl.BlockSpec((B, P), lambda j: (0, 0)),  # fitted accumulator
     )
-    kernel = functools.partial(_sweep_kernel, update_fn, len(aux))
+    kernel = functools.partial(_sweep_kernel, update_fn, len(aux), fwd_scale)
     return pl.pallas_call(
         kernel,
         grid=grid,

@@ -133,6 +133,12 @@ class DistributedSARTSolver:
         self.n_voxel_shards = self.mesh.shape.get(VOXEL_AXIS, 1)
 
         dtype = jnp.dtype(opts.dtype)
+        if opts.rtm_dtype == "int8":
+            raise NotImplementedError(
+                "rtm_dtype='int8' is a single-device (models.sart) feature "
+                "for now: the sharded driver's staging path has no "
+                "quantization pass yet. Use fp32/bfloat16 storage here."
+            )
         rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
 
         # Pre-sharded means the caller already distributed the (padded)

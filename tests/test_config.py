@@ -64,7 +64,7 @@ class TestSolverOptions:
         [
             {"ray_density_threshold": -1},
             {"ray_length_threshold": -0.5},
-            {"conv_tolerance": 0},
+            {"conv_tolerance": -1e-6},
             {"beta_laplace": -1e-3},
             {"relaxation": 0},
             {"relaxation": 1.5},
@@ -86,3 +86,21 @@ class TestSolverOptions:
 
     def test_hashable_for_jit_static(self):
         assert hash(SolverOptions()) == hash(SolverOptions())
+
+
+def test_conv_tolerance_zero_disables_early_stop():
+    """conv_tolerance=0 is the fixed-iteration benchmarking switch: the
+    stall test |dC| < 0.0 can never fire (bit-exact stalls pass any
+    positive tolerance), so the loop runs exactly max_iterations."""
+    import numpy as np
+
+    from sartsolver_tpu.config import MAX_ITERATIONS_EXCEEDED, SolverOptions
+    from sartsolver_tpu.models.sart import make_problem, solve
+
+    opts = SolverOptions(max_iterations=7, conv_tolerance=0.0)
+    rng = np.random.default_rng(0)
+    H = rng.uniform(0.1, 1.0, (16, 128)).astype(np.float32)
+    g = H.astype(np.float64) @ rng.uniform(0.5, 2.0, 128)
+    res = solve(make_problem(H, None, opts=opts), g, opts=opts)
+    assert int(res.iterations) == 7
+    assert int(res.status) == MAX_ITERATIONS_EXCEEDED

@@ -1,0 +1,107 @@
+"""int8-quantized RTM storage (opt-in, fused-sweep only).
+
+The fused kernel dequantizes the integer codes exactly in VMEM
+(ops/fused_sweep.py), so the loop performs full-fp32 SART on the quantized
+matrix Hq = scale * codes; only the storage rounding of H (~1/254 of each
+column max) and the per-row quantization of the out-of-loop guess/obs
+projections (models/sart.py:int8_back_project) perturb the solve.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from sartsolver_tpu.config import SolverOptions
+
+P, V = 24, 256
+
+
+def _case(seed=0):
+    rng = np.random.default_rng(seed)
+    H = rng.uniform(0.1, 1.0, (P, V)).astype(np.float32)
+    H[:, :3] = 0.0
+    H[3, :] = 0.0
+    f_true = rng.uniform(0.5, 2.0, V)
+    g = H.astype(np.float64) @ f_true
+    g[5] = -1.0  # saturated detector
+    return H, g
+
+
+def _solve(H, g, opts):
+    from sartsolver_tpu.models.sart import make_problem, solve
+
+    return solve(make_problem(H, None, opts=opts), g, opts=opts)
+
+
+def test_quantize_roundtrip():
+    from sartsolver_tpu.models.sart import quantize_rtm
+
+    H, _ = _case()
+    codes, scale = quantize_rtm(H)
+    assert codes.dtype == np.int8 and scale.shape == (V,)
+    Hq = np.asarray(codes, np.float32) * np.asarray(scale)[None, :]
+    colmax = np.abs(H).max(axis=0)
+    err = np.abs(Hq - H).max(axis=0)
+    assert (err <= colmax / 254.0 + 1e-7).all()
+    # all-zero columns round-trip to zero with scale 1
+    assert (np.asarray(scale)[:3] == 1.0).all()
+    assert (Hq[:, :3] == 0.0).all()
+
+
+def test_problem_stats_match_quantized_matrix():
+    from sartsolver_tpu.models.sart import make_problem, quantize_rtm
+
+    H, _ = _case()
+    opts = SolverOptions(rtm_dtype="int8", fused_sweep="interpret")
+    prob = make_problem(H, None, opts=opts)
+    codes, scale = quantize_rtm(H)
+    Hq = np.asarray(codes, np.float64) * np.asarray(scale)[None, :]
+    np.testing.assert_allclose(np.asarray(prob.ray_density), Hq.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(prob.ray_length), Hq.sum(1), rtol=1e-5)
+    assert prob.rtm.dtype == np.int8 and prob.rtm_scale is not None
+
+
+@pytest.mark.parametrize("logarithmic", [False, True])
+def test_int8_solution_tracks_fp32(logarithmic):
+    H, g = _case()
+    base = SolverOptions(
+        max_iterations=60, conv_tolerance=1e-10, logarithmic=logarithmic,
+    )
+    ref = _solve(H, g, base)
+    res = _solve(H, g, dataclasses.replace(
+        base, rtm_dtype="int8", fused_sweep="interpret"))
+    assert int(res.status) == int(ref.status)
+    a, b = np.asarray(res.solution), np.asarray(ref.solution)
+    # solution of the quantized system: a few % of the fp32 solution norm
+    assert np.linalg.norm(a - b) / np.linalg.norm(b) < 0.05
+    # fitted-space agreement is tighter (the quantized system reproduces
+    # the same measurements)
+    fa, fb = H.astype(np.float64) @ a.astype(np.float64), H.astype(np.float64) @ b.astype(np.float64)
+    assert np.abs(fa - fb).max() / np.abs(fb).max() < 0.01
+
+
+def test_int8_requires_fused():
+    from sartsolver_tpu.models.sart import make_problem, solve
+
+    H, g = _case()
+    opts = SolverOptions(rtm_dtype="int8", fused_sweep="off")
+    prob = make_problem(H, None, opts=opts)
+    with pytest.raises(ValueError, match="requires the fused sweep"):
+        solve(prob, g, opts=opts)
+
+
+def test_int8_validation():
+    with pytest.raises(ValueError, match="dtype='float32'"):
+        SolverOptions(rtm_dtype="int8", dtype="float64")
+    import jax
+
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    H, _ = _case()
+    with pytest.raises(NotImplementedError, match="int8"):
+        DistributedSARTSolver(
+            H, None, opts=SolverOptions(rtm_dtype="int8"),
+            mesh=make_mesh(1, 1, devices=jax.devices()[:1]),
+        )
