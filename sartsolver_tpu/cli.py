@@ -99,9 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "--no_guess, since batched frames carry no "
                           "warm-start dependency.")
     tpu.add_argument("--rtm_dtype", default=None,
-                     choices=["float32", "bfloat16", "float64"],
-                     help="On-device RTM storage dtype (bfloat16 halves HBM "
-                          "traffic of the two dominant sweeps).")
+                     choices=["float32", "bfloat16", "float64", "int8"],
+                     help="On-device RTM storage dtype. bfloat16 halves the "
+                          "HBM traffic of the two dominant sweeps; int8 "
+                          "quarters it via per-voxel-scaled quantized codes "
+                          "(opt-in: solves the quantized system; needs the "
+                          "fused sweep, so a voxel-major mesh).")
     tpu.add_argument("--profile_dir", default=None,
                      help="Write a jax.profiler trace of the frame loop here.")
     tpu.add_argument("--fused_sweep", default="auto",
@@ -150,6 +153,13 @@ def _validate(args) -> None:
         fail(f"Argument relaxation must be within (0, 1] interval, {args.relaxation} given.")
     if args.beta_laplace < 0:
         fail("Argument beta_laplace must be positive.")
+    if args.rtm_dtype == "int8" and args.use_cpu:
+        fail("Argument rtm_dtype='int8' needs the fp32 device profile; "
+             "it cannot be combined with --use_cpu.")
+    if args.rtm_dtype == "int8" and args.multihost:
+        fail("Argument rtm_dtype='int8' is single-host for now (multi-host "
+             "forces the pixel-sharded layout, which cannot run the fused "
+             "sweep int8 requires).")
     if args.max_cached_frames <= 0:
         fail("Argument max_cached_frames must be positive.")
     if args.max_cached_solutions <= 0:
@@ -315,6 +325,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                       "this backend; using the two-matmul path.",
                       file=sys.stderr)
             opts = resolved
+            if opts.rtm_dtype == "int8":
+                from sartsolver_tpu.ops.fused_sweep import fused_available
+                from sartsolver_tpu.parallel.mesh import (
+                    COL_ALIGN, ROW_ALIGN, padded_size,
+                )
+
+                n_vox_probe = max(n_vox if explicit_mesh else len(devices), 1)
+                eligible = (
+                    opts.fused_sweep in ("on", "interpret")
+                    or (opts.fused_sweep == "auto"
+                        and jax.default_backend() == "tpu")
+                ) and fused_available(
+                    padded_size(npixel, ROW_ALIGN),
+                    padded_size(nvoxel, n_vox_probe * COL_ALIGN) // n_vox_probe,
+                    1, args.batch_frames or 1,
+                )
+                if not eligible:
+                    raise SartInputError(
+                        "Argument rtm_dtype='int8' needs the fused sweep, "
+                        "which cannot engage here (fused_sweep="
+                        f"'{opts.fused_sweep}', backend "
+                        f"'{jax.default_backend()}', or shape ineligible); "
+                        "pass --fused_sweep interpret (slow, any backend) "
+                        "or use fp32/bfloat16 storage."
+                    )
 
         if not explicit_mesh:
             from sartsolver_tpu.parallel.mesh import choose_mesh_shape
@@ -356,9 +391,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # (raytransfer.cpp:49 parity; see multihost.read_and_shard_rtm).
         from sartsolver_tpu.parallel.multihost import read_and_shard_rtm
 
+        # int8 is staged fp32 and quantized on device by the solver (the
+        # per-voxel scales need global column maxima)
+        stage_dtype = opts.rtm_dtype or opts.dtype
+        if stage_dtype == "int8":
+            stage_dtype = "float32"
         rtm = read_and_shard_rtm(
             sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
-            dtype=opts.rtm_dtype or opts.dtype,
+            dtype=stage_dtype,
             serialize=args.multihost and not args.parallel_read,
         )
         solver = DistributedSARTSolver(

@@ -212,6 +212,29 @@ def quantize_rtm(rtm: Array) -> Tuple[Array, Array]:
     return codes, scale[0]
 
 
+def compute_ray_stats_int8(
+    codes: Array, scale: Array, *, dtype, axis_name=None, voxel_axis=None
+) -> Tuple[Array, Array]:
+    """Ray stats of a quantized RTM ``H = scale * codes``, both exact:
+    column sums accumulate the int8 codes in int32 before scaling; row sums
+    contract the codes against the fp32 scales. Reductions mirror
+    :func:`compute_ray_stats`."""
+    dens = _psum(
+        scale.astype(dtype)
+        * jnp.sum(codes, axis=0, dtype=jnp.int32).astype(dtype),
+        axis_name,
+    )
+    length = _psum(
+        lax.dot_general(
+            codes, scale.astype(dtype),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=dtype,
+        ),
+        voxel_axis,
+    )
+    return dens, length.astype(dtype)
+
+
 def make_problem(
     rtm,
     laplacian: Optional[LaplacianCOO] = None,
@@ -237,19 +260,11 @@ def make_problem(
                 "fp32/bfloat16 storage."
             )
         codes, scale = quantize_rtm(rtm)
-        # stats of the QUANTIZED matrix (what the sweeps multiply by), both
-        # exact: column sums as int32 x scale, row sums as an fp32
-        # contraction of the codes against the scales
-        dens = _psum(
-            scale * jnp.sum(codes, axis=0, dtype=jnp.int32).astype(dtype),
-            axis_name,
+        # stats of the QUANTIZED matrix (what the sweeps multiply by)
+        dens, length = compute_ray_stats_int8(
+            codes, scale, dtype=dtype, axis_name=axis_name
         )
-        length = lax.dot_general(
-            codes, scale.astype(dtype),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=dtype,
-        )
-        return SARTProblem(codes, dens, length.astype(dtype), laplacian, scale)
+        return SARTProblem(codes, dens, length, laplacian, scale)
     rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
     rtm = jnp.asarray(rtm)
     dens, length = compute_ray_stats(rtm, dtype=dtype, axis_name=axis_name)

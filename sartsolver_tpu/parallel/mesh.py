@@ -105,12 +105,12 @@ def choose_mesh_shape(
     if (
         not would_engage
         or opts.dtype != "float32"
-        or rtm_name not in ("float32", "bfloat16")
+        or rtm_name not in ("float32", "bfloat16", "int8")
     ):
         return n_devices, 1
     from sartsolver_tpu.ops.fused_sweep import fused_available
 
-    itemsize = 2 if rtm_name == "bfloat16" else 4
+    itemsize = {"bfloat16": 2, "int8": 1}.get(rtm_name, 4)
     rows = padded_size(npixel, ROW_ALIGN)
     cols = padded_size(nvoxel, n_devices * COL_ALIGN)
     if fused_available(rows, cols // n_devices, itemsize, batch):

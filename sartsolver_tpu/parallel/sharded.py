@@ -133,13 +133,22 @@ class DistributedSARTSolver:
         self.n_voxel_shards = self.mesh.shape.get(VOXEL_AXIS, 1)
 
         dtype = jnp.dtype(opts.dtype)
-        if opts.rtm_dtype == "int8":
-            raise NotImplementedError(
-                "rtm_dtype='int8' is a single-device (models.sart) feature "
-                "for now: the sharded driver's staging path has no "
-                "quantization pass yet. Use fp32/bfloat16 storage here."
+        is_int8 = opts.rtm_dtype == "int8"
+        if is_int8 and self.mesh.shape[PIXEL_AXIS] > 1:
+            from sartsolver_tpu.config import SartInputError
+
+            # reachable from CLI flags -> polite exit(1), not a traceback
+            raise SartInputError(
+                "rtm_dtype='int8' needs the fused sweep, which the pixel-"
+                "sharded layout cannot run; use a voxel-major mesh "
+                "(--voxel_shards N, pixels=1) or fp32/bfloat16 storage."
             )
-        rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
+        # int8 codes are staged as fp32 here and quantized on device below
+        # (the per-voxel scales need global column maxima, which only exist
+        # once the full matrix is assembled).
+        rtm_dtype = jnp.dtype("float32") if is_int8 else jnp.dtype(
+            opts.rtm_dtype or opts.dtype
+        )
 
         # Pre-sharded means the caller already distributed the (padded)
         # matrix (multihost.read_and_shard_rtm) — marked either by passing
@@ -197,19 +206,58 @@ class DistributedSARTSolver:
         # solver pick the fused Pallas sweep (no pixel-axis psum in the loop).
         self._pixel_axis = PIXEL_AXIS if self.n_pixel_shards > 1 else None
         self._voxel_axis = VOXEL_AXIS if self.n_voxel_shards > 1 else None
+
+        rtm_scale = None
+        if is_int8:
+            from sartsolver_tpu.models.sart import (
+                INT8_MAX_CONTRACTION, compute_ray_stats_int8, quantize_rtm,
+            )
+
+            if max(self.padded_npixel, self.padded_nvoxel) > INT8_MAX_CONTRACTION:
+                from sartsolver_tpu.config import SartInputError
+
+                raise SartInputError(
+                    f"rtm_dtype='int8': padded RTM extent "
+                    f"{max(self.padded_npixel, self.padded_nvoxel)} exceeds "
+                    f"the int32-accumulation bound {INT8_MAX_CONTRACTION} "
+                    "of the integer projections; use fp32/bfloat16 storage."
+                )
+            # On-device quantization of the assembled fp32 matrix (GSPMD
+            # inserts the cross-shard column-max reduction); the fp32
+            # staging copy is freed afterwards, so peak device footprint is
+            # the 5-bytes/element transient.
+            quant = jax.jit(
+                quantize_rtm,
+                out_shardings=(
+                    NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS)),
+                    NamedSharding(self.mesh, P(VOXEL_AXIS)),
+                ),
+                donate_argnums=0,
+            )
+            rtm_dev, rtm_scale = quant(rtm_dev)
+            stats_core = functools.partial(
+                compute_ray_stats_int8, dtype=dtype,
+                axis_name=self._pixel_axis, voxel_axis=self._voxel_axis,
+            )
+            stats_in = (P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS))
+            stats_args = (rtm_dev, rtm_scale)
+        else:
+            stats_core = functools.partial(
+                compute_ray_stats, dtype=dtype,
+                axis_name=self._pixel_axis, voxel_axis=self._voxel_axis,
+            )
+            stats_in = P(PIXEL_AXIS, VOXEL_AXIS)
+            stats_args = (rtm_dev,)
         stats_fn = jax.jit(
             jax.shard_map(
-                functools.partial(
-                    compute_ray_stats, dtype=dtype,
-                    axis_name=self._pixel_axis, voxel_axis=self._voxel_axis,
-                ),
+                stats_core,
                 mesh=self.mesh,
-                in_specs=P(PIXEL_AXIS, VOXEL_AXIS),
+                in_specs=stats_in,
                 out_specs=(P(VOXEL_AXIS), P(PIXEL_AXIS)),
                 check_vma=False,
             )
         )
-        ray_density, ray_length = stats_fn(rtm_dev)
+        ray_density, ray_length = stats_fn(*stats_args)
 
         if laplacian is not None:
             sharded_lap = _shard_laplacian(
@@ -222,7 +270,9 @@ class DistributedSARTSolver:
                 _stage(sharded_lap.vals, self.mesh, lap_spec),
             )
 
-        self.problem = SARTProblem(rtm_dev, ray_density, ray_length, laplacian)
+        self.problem = SARTProblem(
+            rtm_dev, ray_density, ray_length, laplacian, rtm_scale
+        )
         self._solve_fns = {}
 
     def _batch_fn(self, use_guess: bool):
@@ -233,7 +283,9 @@ class DistributedSARTSolver:
             lap_spec = LaplacianCOO(P(VOXEL_AXIS, None), P(VOXEL_AXIS, None),
                                     P(VOXEL_AXIS, None)) if has_lap else None
             problem_spec = SARTProblem(
-                P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS), P(PIXEL_AXIS), lap_spec
+                P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS), P(PIXEL_AXIS),
+                lap_spec,
+                P(VOXEL_AXIS) if self.problem.rtm_scale is not None else None,
             )
             opts = self.opts
             pixel_axis = self._pixel_axis

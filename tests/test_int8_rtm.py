@@ -94,14 +94,62 @@ def test_int8_requires_fused():
 def test_int8_validation():
     with pytest.raises(ValueError, match="dtype='float32'"):
         SolverOptions(rtm_dtype="int8", dtype="float64")
+    # int32-accumulation bound of the integer projections
+    from sartsolver_tpu.models.sart import INT8_MAX_CONTRACTION, make_problem
+
+    huge = np.zeros((INT8_MAX_CONTRACTION + 1, 128), np.float32)
+    with pytest.raises(ValueError, match="int32-accumulation"):
+        make_problem(
+            huge, None,
+            opts=SolverOptions(rtm_dtype="int8", fused_sweep="interpret"),
+        )
+
+
+def test_int8_sharded_voxel_major_matches_single():
+    """int8 through the sharded driver (voxel-major 1x2 mesh, interpret
+    kernel) must match the single-device int8 solve: the on-device
+    quantization, sharded scales and per-shard fused sweeps compose."""
     import jax
 
+    from sartsolver_tpu.models.sart import make_problem, solve
     from sartsolver_tpu.parallel.mesh import make_mesh
     from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
 
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (virtual CPU mesh)")
+    H, g = _case()
+    # fixed iteration count (conv_tolerance=0): the out-of-loop guess /
+    # fitted0 projections quantize per shard, so the convergence
+    # trajectories differ at the ~1e-3 level and a tight stall tolerance
+    # would stop the two runs at different iterations
+    opts = SolverOptions(
+        max_iterations=40, conv_tolerance=0.0,
+        rtm_dtype="int8", fused_sweep="interpret",
+    )
+    single = solve(make_problem(H, None, opts=opts), g, opts=opts)
+    mesh = make_mesh(1, 2, devices=jax.devices()[:2])
+    solver = DistributedSARTSolver(H, None, opts=opts, mesh=mesh)
+    sharded = solver.solve(g)
+    assert int(sharded.status) == int(single.status)
+    np.testing.assert_allclose(
+        np.asarray(sharded.solution), np.asarray(single.solution),
+        rtol=1e-2, atol=1e-4,
+    )
+
+
+def test_int8_pixel_sharded_rejected():
+    import jax
+
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (virtual CPU mesh)")
     H, _ = _case()
-    with pytest.raises(NotImplementedError, match="int8"):
+    with pytest.raises(SartInputError, match="voxel-major"):
         DistributedSARTSolver(
-            H, None, opts=SolverOptions(rtm_dtype="int8"),
-            mesh=make_mesh(1, 1, devices=jax.devices()[:1]),
+            H, None,
+            opts=SolverOptions(rtm_dtype="int8", fused_sweep="interpret"),
+            mesh=make_mesh(2, 1, devices=jax.devices()[:2]),
         )
