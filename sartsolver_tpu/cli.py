@@ -305,6 +305,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             n_vox = args.voxel_shards or 1
             if args.pixel_shards is not None:
                 n_pix = args.pixel_shards
+            elif args.rtm_dtype == "int8":
+                # int8 needs the fused sweep, which pixel sharding breaks:
+                # --voxel_shards alone means a voxel-major mesh, not
+                # fill-the-devices-with-pixel-shards
+                n_pix = 1
             else:
                 n_pix = max(len(devices) // n_vox, 1)
 
@@ -326,22 +331,30 @@ def main(argv: Optional[List[str]] = None) -> int:
                       file=sys.stderr)
             opts = resolved
             if opts.rtm_dtype == "int8":
-                from sartsolver_tpu.ops.fused_sweep import fused_available
-                from sartsolver_tpu.parallel.mesh import (
-                    COL_ALIGN, ROW_ALIGN, padded_size,
-                )
+                # preflight BEFORE the (possibly tens-of-GB, two-pass)
+                # ingest: everything here is knowable from sizes + flags
+                from sartsolver_tpu.models.sart import INT8_MAX_CONTRACTION
+                from sartsolver_tpu.parallel.mesh import fused_would_engage
 
+                if explicit_mesh and n_pix > 1:
+                    raise SartInputError(
+                        "Argument rtm_dtype='int8' needs a voxel-major "
+                        f"mesh, but --pixel_shards gives {n_pix} pixel "
+                        "shards; use --voxel_shards N (pixels=1) or "
+                        "fp32/bfloat16 storage."
+                    )
+                if max(npixel, nvoxel) > INT8_MAX_CONTRACTION:
+                    raise SartInputError(
+                        f"Argument rtm_dtype='int8': RTM extent "
+                        f"{max(npixel, nvoxel)} exceeds the int32-"
+                        f"accumulation bound {INT8_MAX_CONTRACTION}; use "
+                        "fp32/bfloat16 storage."
+                    )
                 n_vox_probe = max(n_vox if explicit_mesh else len(devices), 1)
-                eligible = (
-                    opts.fused_sweep in ("on", "interpret")
-                    or (opts.fused_sweep == "auto"
-                        and jax.default_backend() == "tpu")
-                ) and fused_available(
-                    padded_size(npixel, ROW_ALIGN),
-                    padded_size(nvoxel, n_vox_probe * COL_ALIGN) // n_vox_probe,
-                    1, args.batch_frames or 1,
-                )
-                if not eligible:
+                if not fused_would_engage(
+                    opts, npixel, nvoxel, n_vox_probe,
+                    args.batch_frames or 1,
+                ):
                     raise SartInputError(
                         "Argument rtm_dtype='int8' needs the fused sweep, "
                         "which cannot engage here (fused_sweep="
@@ -391,18 +404,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         # (raytransfer.cpp:49 parity; see multihost.read_and_shard_rtm).
         from sartsolver_tpu.parallel.multihost import read_and_shard_rtm
 
-        # int8 is staged fp32 and quantized on device by the solver (the
-        # per-voxel scales need global column maxima)
-        stage_dtype = opts.rtm_dtype or opts.dtype
-        if stage_dtype == "int8":
-            stage_dtype = "float32"
-        rtm = read_and_shard_rtm(
-            sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
-            dtype=stage_dtype,
-            serialize=args.multihost and not args.parallel_read,
-        )
+        rtm_scale = None
+        if opts.rtm_dtype == "int8":
+            # two-pass ingest: quantize fp32 chunks host-side into int8
+            # device buffers, so peak device footprint is 1 byte/element —
+            # a matrix that only fits as int8 loads (multihost.py)
+            from sartsolver_tpu.parallel.multihost import read_and_quantize_rtm
+
+            rtm, rtm_scale = read_and_quantize_rtm(
+                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+            )
+        else:
+            rtm = read_and_shard_rtm(
+                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                dtype=opts.rtm_dtype or opts.dtype,
+                serialize=args.multihost and not args.parallel_read,
+            )
         solver = DistributedSARTSolver(
-            rtm, lap, opts=opts, mesh=mesh, npixel=npixel, nvoxel=nvoxel
+            rtm, lap, opts=opts, mesh=mesh, npixel=npixel, nvoxel=nvoxel,
+            rtm_scale=rtm_scale,
         )
         _mark("ingest RTM + upload")
 

@@ -97,25 +97,35 @@ def choose_mesh_shape(
         # reader slices rows, not columns) — n_hosts x the I/O of the
         # pixel-major stripe layout. Multi-host stays row-block.
         return n_devices, 1
+    if fused_would_engage(opts, npixel, nvoxel, n_devices, batch):
+        return 1, n_devices
+    return n_devices, 1
+
+
+def fused_would_engage(
+    opts, npixel: int, nvoxel: int, n_vox: int, batch: int = 1
+) -> bool:
+    """Would the fused sweep engage on a voxel-major mesh of ``n_vox``
+    column shards at these logical sizes? Single source of the engagement
+    rule (mode/backend/dtype gates + padded per-shard shape eligibility),
+    shared by :func:`choose_mesh_shape` and the CLI's int8 preflight."""
     mode = opts.fused_sweep
-    would_engage = mode in ("on", "interpret") or (
-        mode == "auto" and jax.default_backend() == "tpu"
-    )
-    rtm_name = opts.rtm_dtype or opts.dtype
-    if (
-        not would_engage
-        or opts.dtype != "float32"
-        or rtm_name not in ("float32", "bfloat16", "int8")
+    if not (
+        mode in ("on", "interpret")
+        or (mode == "auto" and jax.default_backend() == "tpu")
     ):
-        return n_devices, 1
+        return False
+    rtm_name = opts.rtm_dtype or opts.dtype
+    if opts.dtype != "float32" or rtm_name not in (
+        "float32", "bfloat16", "int8"
+    ):
+        return False
     from sartsolver_tpu.ops.fused_sweep import fused_available
 
     itemsize = {"bfloat16": 2, "int8": 1}.get(rtm_name, 4)
     rows = padded_size(npixel, ROW_ALIGN)
-    cols = padded_size(nvoxel, n_devices * COL_ALIGN)
-    if fused_available(rows, cols // n_devices, itemsize, batch):
-        return 1, n_devices
-    return n_devices, 1
+    cols = padded_size(nvoxel, n_vox * COL_ALIGN)
+    return fused_available(rows, cols // n_vox, itemsize, batch)
 
 
 def make_mesh(n_pixel_shards: int | None = None, n_voxel_shards: int = 1, devices=None) -> Mesh:

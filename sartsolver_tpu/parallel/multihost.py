@@ -94,6 +94,63 @@ def _device_grid(mesh) -> np.ndarray:
     return devs
 
 
+def read_and_quantize_rtm(
+    sorted_matrix_files: Dict[str, List[str]],
+    rtm_name: str,
+    npixel: int,
+    nvoxel: int,
+    mesh,
+    *,
+    chunk_rows: Optional[int] = None,
+):
+    """Two-pass chunked int8 ingest: ``(codes jax.Array, scale jax.Array)``.
+
+    Pass 1 streams the row chunks once to accumulate the per-voxel column
+    maxima on the host (an ``[nvoxel]`` fp32 vector — tiny); pass 2 streams
+    them again, quantizing each fp32 chunk host-side into the int8 device
+    buffers. Peak host allocation stays one fp32 chunk and peak device
+    allocation is the **1-byte/element** codes array — unlike quantizing a
+    staged fp32 matrix on device, a matrix that only *fits* as int8 can be
+    loaded this way (the 4x capacity headroom is real, at the cost of
+    reading the HDF5 bytes twice). Matches the int8 quantization recipe of
+    ``models.sart.quantize_rtm``. Single-process only (the per-column
+    scales would need a cross-process max; multi-host runs are
+    pixel-sharded, which int8 cannot use anyway).
+    """
+    if jax.process_count() > 1:
+        raise ValueError("int8 RTM ingest is single-process only.")
+    chunk = chunk_rows or int(os.environ.get(
+        "SART_INGEST_CHUNK_ROWS", max(ROW_ALIGN, (256 << 20) // max(nvoxel * 4, 1))
+    ))
+    colmax = np.zeros(nvoxel, np.float32)
+    for r0 in range(0, npixel, chunk):
+        n = min(chunk, npixel - r0)
+        stripe = read_rtm_block(
+            sorted_matrix_files, rtm_name, n, nvoxel, r0, dtype=np.float32,
+        )
+        np.maximum(colmax, np.abs(stripe).max(axis=0), out=colmax)
+    n_vox = mesh.shape.get(VOXEL_AXIS, 1)
+    padded_cols = padded_size(nvoxel, n_vox * COL_ALIGN)
+    scale_np = np.ones(padded_cols, np.float32)
+    scale_np[:nvoxel] = np.where(colmax > 0, colmax / 127.0, 1.0)
+
+    def quantize_chunk(stripe: np.ndarray, col0: int) -> np.ndarray:
+        s = scale_np[col0:col0 + stripe.shape[1]]
+        return np.clip(
+            np.rint(stripe / s[None, :]), -127, 127
+        ).astype(np.int8)
+
+    codes = read_and_shard_rtm(
+        sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+        dtype="int8", chunk_rows=chunk, _quantize_chunk=quantize_chunk,
+    )
+    scale = jax.device_put(
+        scale_np,
+        NamedSharding(mesh, P(VOXEL_AXIS if VOXEL_AXIS in mesh.shape else None)),
+    )
+    return codes, scale
+
+
 def read_and_shard_rtm(
     sorted_matrix_files: Dict[str, List[str]],
     rtm_name: str,
@@ -104,6 +161,7 @@ def read_and_shard_rtm(
     dtype,
     serialize: bool = False,
     chunk_rows: Optional[int] = None,
+    _quantize_chunk=None,
 ) -> jax.Array:
     """Assemble the global padded RTM, each process reading only its rows.
 
@@ -134,6 +192,11 @@ def read_and_shard_rtm(
         VOXEL_AXIS if VOXEL_AXIS in mesh.shape else None,
     ))
     jdtype = jnp.dtype(dtype)
+    if jdtype == jnp.int8 and _quantize_chunk is None:
+        raise ValueError(
+            "int8 staging needs the quantization pass; call "
+            "read_and_quantize_rtm (a bare astype would truncate)."
+        )
     if chunk_rows is None:
         chunk_rows = int(os.environ.get(
             "SART_INGEST_CHUNK_ROWS",
@@ -180,9 +243,13 @@ def read_and_shard_rtm(
                 for j, dev in sorted(cols):
                     c0 = j * col_block
                     cols_have = max(0, min(nvoxel - c0, col_block))
-                    piece = np.zeros((n_write, col_block), np.float32)
+                    piece_np = np.int8 if _quantize_chunk is not None else np.float32
+                    piece = np.zeros((n_write, col_block), piece_np)
                     if cols_have > 0:
-                        piece[:n, :cols_have] = stripe[:, c0:c0 + cols_have]
+                        sl = stripe[:, c0:c0 + cols_have]
+                        piece[:n, :cols_have] = (
+                            _quantize_chunk(sl, c0) if _quantize_chunk else sl
+                        )
                     bufs[j] = _scatter(
                         bufs[j], jax.device_put(piece, dev),
                         np.int32(cs),

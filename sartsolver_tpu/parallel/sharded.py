@@ -116,12 +116,18 @@ class DistributedSARTSolver:
         mesh=None,
         npixel: Optional[int] = None,
         nvoxel: Optional[int] = None,
+        rtm_scale=None,
     ):
         """``rtm`` is either a host ``np.ndarray`` (padded, cast and
         device_put here — single-host path) or an already-sharded global
         ``jax.Array`` built by ``parallel.multihost.read_and_shard_rtm``
         (multi-host path: pass the logical ``npixel``/``nvoxel`` since the
-        device array carries only the padded shape)."""
+        device array carries only the padded shape). With
+        ``opts.rtm_dtype == "int8"`` a pre-quantized codes array from
+        ``multihost.read_and_quantize_rtm`` may be passed together with its
+        ``rtm_scale``; otherwise the matrix is staged fp32 and quantized on
+        device here (a 5-bytes/element transient — use the two-pass ingest
+        when the matrix only fits as int8)."""
         self.opts = opts
         self.mesh = mesh if mesh is not None else make_mesh()
         if PIXEL_AXIS not in self.mesh.shape or VOXEL_AXIS not in self.mesh.shape:
@@ -143,12 +149,29 @@ class DistributedSARTSolver:
                 "sharded layout cannot run; use a voxel-major mesh "
                 "(--voxel_shards N, pixels=1) or fp32/bfloat16 storage."
             )
-        # int8 codes are staged as fp32 here and quantized on device below
-        # (the per-voxel scales need global column maxima, which only exist
-        # once the full matrix is assembled).
-        rtm_dtype = jnp.dtype("float32") if is_int8 else jnp.dtype(
-            opts.rtm_dtype or opts.dtype
-        )
+        if not is_int8 and rtm_scale is not None:
+            raise ValueError("rtm_scale is only valid with rtm_dtype='int8'.")
+        if (
+            rtm_scale is not None
+            and np.dtype(getattr(rtm, "dtype", np.float32)) != np.dtype(np.int8)
+        ):
+            # checked BEFORE staging: the staging cast would silently
+            # truncate non-code data to garbage int8 values
+            raise ValueError(
+                "rtm_scale implies pre-quantized int8 codes "
+                "(multihost.read_and_quantize_rtm); got a "
+                f"{np.dtype(getattr(rtm, 'dtype', np.float32))} matrix."
+            )
+        if is_int8 and rtm_scale is None:
+            # int8 codes are staged as fp32 and quantized on device below
+            # (the per-voxel scales need global column maxima, which only
+            # exist once the full matrix is assembled); pre-quantized codes
+            # (read_and_quantize_rtm) arrive with their scale and stay int8.
+            rtm_dtype = jnp.dtype("float32")
+        else:
+            rtm_dtype = jnp.dtype(
+                "int8" if is_int8 else (opts.rtm_dtype or opts.dtype)
+            )
 
         # Pre-sharded means the caller already distributed the (padded)
         # matrix (multihost.read_and_shard_rtm) — marked either by passing
@@ -207,7 +230,6 @@ class DistributedSARTSolver:
         self._pixel_axis = PIXEL_AXIS if self.n_pixel_shards > 1 else None
         self._voxel_axis = VOXEL_AXIS if self.n_voxel_shards > 1 else None
 
-        rtm_scale = None
         if is_int8:
             from sartsolver_tpu.models.sart import (
                 INT8_MAX_CONTRACTION, compute_ray_stats_int8, quantize_rtm,
@@ -222,19 +244,29 @@ class DistributedSARTSolver:
                     f"the int32-accumulation bound {INT8_MAX_CONTRACTION} "
                     "of the integer projections; use fp32/bfloat16 storage."
                 )
-            # On-device quantization of the assembled fp32 matrix (GSPMD
-            # inserts the cross-shard column-max reduction); the fp32
-            # staging copy is freed afterwards, so peak device footprint is
-            # the 5-bytes/element transient.
-            quant = jax.jit(
-                quantize_rtm,
-                out_shardings=(
-                    NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS)),
-                    NamedSharding(self.mesh, P(VOXEL_AXIS)),
-                ),
-                donate_argnums=0,
-            )
-            rtm_dev, rtm_scale = quant(rtm_dev)
+            if rtm_scale is not None:
+                if rtm_dev.dtype != jnp.int8 or rtm_scale.shape != (
+                    self.padded_nvoxel,
+                ):
+                    raise ValueError(
+                        "Pre-quantized int8 RTM needs int8 codes and a "
+                        f"[{self.padded_nvoxel}] rtm_scale (got "
+                        f"{rtm_dev.dtype}, {tuple(rtm_scale.shape)})."
+                    )
+            else:
+                # On-device quantization of the assembled fp32 matrix
+                # (GSPMD inserts the cross-shard column-max reduction); the
+                # fp32 staging copy is freed afterwards, so peak device
+                # footprint is the 5-bytes/element transient.
+                quant = jax.jit(
+                    quantize_rtm,
+                    out_shardings=(
+                        NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS)),
+                        NamedSharding(self.mesh, P(VOXEL_AXIS)),
+                    ),
+                    donate_argnums=0,
+                )
+                rtm_dev, rtm_scale = quant(rtm_dev)
             stats_core = functools.partial(
                 compute_ray_stats_int8, dtype=dtype,
                 axis_name=self._pixel_axis, voxel_axis=self._voxel_axis,

@@ -153,3 +153,42 @@ def test_int8_pixel_sharded_rejected():
             opts=SolverOptions(rtm_dtype="int8", fused_sweep="interpret"),
             mesh=make_mesh(2, 1, devices=jax.devices()[:2]),
         )
+
+
+def test_two_pass_ingest_matches_device_quantization(tmp_path):
+    """read_and_quantize_rtm (host-side two-pass, 1-byte/element device
+    footprint) must produce the same codes/scales as staging fp32 and
+    quantizing on device, and solve identically through the driver."""
+    import jax
+
+    import fixtures as fx
+    from sartsolver_tpu.io.hdf5files import (
+        categorize_input_files, sort_rtm_files,
+    )
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.multihost import read_and_quantize_rtm
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (virtual CPU mesh)")
+    paths, H, f_true, times, scales_t = fx.write_world(str(tmp_path))
+    rtm_files, _ = categorize_input_files(
+        [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"]])
+    sorted_files = sort_rtm_files(rtm_files)
+    mesh = make_mesh(1, 2, devices=jax.devices()[:2])
+    P_, V_ = H.shape
+    codes, scale = read_and_quantize_rtm(
+        sorted_files, "with_reflections", P_, V_, mesh, chunk_rows=3)
+    opts = SolverOptions(rtm_dtype="int8", fused_sweep="interpret",
+                         max_iterations=30, conv_tolerance=0.0)
+    pre = DistributedSARTSolver(codes, None, opts=opts, mesh=mesh,
+                                npixel=P_, nvoxel=V_, rtm_scale=scale)
+    dev = DistributedSARTSolver(H, None, opts=opts, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(pre.problem.rtm),
+                                  np.asarray(dev.problem.rtm))
+    np.testing.assert_allclose(np.asarray(pre.problem.rtm_scale),
+                               np.asarray(dev.problem.rtm_scale), rtol=1e-6)
+    g = H.astype(np.float64) @ f_true
+    ra, rb = pre.solve(g), dev.solve(g)
+    np.testing.assert_allclose(np.asarray(ra.solution),
+                               np.asarray(rb.solution), rtol=1e-5, atol=1e-7)
