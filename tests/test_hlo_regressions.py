@@ -72,15 +72,13 @@ def _matrix_sized_loop_copies(txt: str, threshold: int) -> list:
         for line in comps[name]:
             for m in re.finditer(r"(?:calls=|to_apply=)%?([\w.\-]+)", line):
                 frontier.append(m.group(1))
-            for m in re.finditer(r"fusion\(|call\(", line):
-                pass  # handled via calls= above
 
     bad = []
     for name in reachable:
         for line in comps.get(name, []):
             if "transpose" not in line and " copy(" not in line and "copy." not in line.split("=")[0]:
                 continue
-            m = re.search(r"(?:f32|f64|bf16)\[([0-9,]+)\]", line)
+            m = re.search(r"(?:f32|f64|bf16|s8)\[([0-9,]+)\]", line)
             if m and np.prod([int(x) for x in m.group(1).split(",")]) >= threshold:
                 bad.append(f"{name}: {line.strip()}")
     return bad
@@ -143,3 +141,31 @@ def test_no_rtm_copy_inside_sharded_loop(mesh_shape):
     local = (s.padded_npixel // mesh_shape[0]) * (s.padded_nvoxel // mesh_shape[1])
     bad = _matrix_sized_loop_copies(txt, local)
     assert not bad, "\n".join(bad[:5])
+
+
+def test_no_codes_copy_inside_int8_loop():
+    """The int8 loop must stream only the 1-byte codes: no matrix-sized
+    transpose/copy (s8 or dequantized f32/bf16) may live inside the while
+    body — a dequantized matrix copy would erase the 4x bandwidth win."""
+    from sartsolver_tpu.models.sart import make_problem
+
+    opts = SolverOptions(
+        max_iterations=4, conv_tolerance=0.0,
+        rtm_dtype="int8", fused_sweep="interpret",
+    )
+    rng = np.random.default_rng(0)
+    prob = make_problem(
+        rng.random((P, V)).astype(np.float32), None, opts=opts)
+    g = jnp.ones((1, P), jnp.float32)
+    msq = jnp.ones(1, jnp.float32)
+    f0 = jnp.zeros((1, V), jnp.float32)
+    fn = jax.jit(functools.partial(
+        solve_normalized_batch, opts=opts, axis_name=None, voxel_axis=None,
+        use_guess=True,
+    ))
+    txt = fn.lower(prob, g, msq, f0).compile().as_text()
+    bad = _matrix_sized_loop_copies(txt, P * V)
+    assert not bad, (
+        "matrix-sized transpose/copy inside the int8 iteration loop:\n"
+        + "\n".join(bad[:5])
+    )
