@@ -63,7 +63,7 @@ def _solve(H, g, opts, lap=None, batch=None):
         problem,
         jnp.asarray(np.stack(gs), jnp.float32),
         jnp.asarray(msqs, jnp.float32),
-        jnp.zeros((batch, V), jnp.float32),
+        jnp.zeros((batch, H.shape[1]), jnp.float32),
         opts=opts, axis_name=None, voxel_axis=None, use_guess=True,
     )
     return res._replace(
@@ -250,3 +250,54 @@ def test_auto_declines_raise_needing_shapes_without_options():
         assert _resolve_fused(opts, None, small, 1, vmem_raised=False) == "compiled"
     finally:
         jax.default_backend = orig
+
+
+@pytest.mark.parametrize("P_,V_,B_,logarithmic,rtm_dtype,with_lap", [
+    # explicit corners: every dtype x variant x laplacian combination is
+    # exercised at least once, at shapes away from the fixture's 24x256 —
+    # notably int8 with logarithmic/laplacian pins the aux-panel ordering
+    # of the int8 update closures (scale, [vm, obs,] penalty)
+    (8, 128, 1, False, "float32", False),
+    (40, 384, 3, True, "float32", True),
+    (16, 256, 2, False, "bfloat16", True),
+    (32, 128, 1, True, "bfloat16", False),
+    (24, 384, 2, False, "int8", True),
+    (40, 256, 3, True, "int8", True),
+    (8, 128, 2, True, "int8", False),
+])
+def test_fused_matches_unfused_config_sweep(
+    P_, V_, B_, logarithmic, rtm_dtype, with_lap
+):
+    """Interpreter-mode fused must track the unfused path across shapes,
+    variants, storage dtypes and regularization — not just the fixture
+    shape. int8 has no unfused loop; it is compared loosely against the
+    fp32 unfused solve (quantized-system perturbation only)."""
+    rng = np.random.default_rng(P_ * 1000 + V_)
+    H = rng.uniform(0.05, 1.0, (P_, V_)).astype(np.float32)
+    H[:, 0] = 0.0  # one dead voxel
+    g = H.astype(np.float64) @ rng.uniform(0.5, 2.0, V_)
+    lap = None
+    if with_lap:
+        li = np.arange(V_)
+        lap = make_laplacian(
+            np.r_[li, li[1:]], np.r_[li, li[:-1]],
+            np.r_[np.full(V_, 1.0), np.full(V_ - 1, -0.5)].astype(np.float32),
+        )
+    base = SolverOptions(
+        max_iterations=12, conv_tolerance=0.0, logarithmic=logarithmic,
+        beta_laplace=1e-3 if with_lap else 0.0, rtm_dtype=rtm_dtype,
+    )
+    fus = _solve(H, g, dataclasses.replace(base, fused_sweep="interpret"),
+                 lap, batch=B_)
+    if rtm_dtype == "int8":
+        ref = _solve(H, g, dataclasses.replace(
+            base, fused_sweep="off", rtm_dtype="float32"), lap, batch=B_)
+        a, b = np.asarray(fus.solution), np.asarray(ref.solution)
+        assert np.linalg.norm(a - b) / max(np.linalg.norm(b), 1e-30) < 0.08
+    else:
+        ref = _solve(H, g, dataclasses.replace(base, fused_sweep="off"),
+                     lap, batch=B_)
+        np.testing.assert_allclose(
+            np.asarray(fus.solution), np.asarray(ref.solution),
+            rtol=3e-5, atol=3e-6,
+        )
