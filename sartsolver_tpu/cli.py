@@ -452,7 +452,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         primary = (not args.multihost) or mh.is_primary()
 
         class _NullWriter:
-            def add(self, *a):
+            def add(self, *a, **kw):
                 pass
 
             def __enter__(self):
@@ -500,7 +500,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     per_frame_ms = (_time.perf_counter() - t0) * 1e3 / len(pending)
                     for b, (_, ftime, cam_times) in enumerate(pending):
                         writer.add(result.solution[b], int(result.status[b]),
-                                   ftime, cam_times)
+                                   ftime, cam_times,
+                                   iterations=int(result.iterations[b]))
                         if primary:
                             # the value is a batch average, not this frame's
                             # own wall time — say so instead of mimicking
@@ -522,7 +523,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 for frame, ftime, cam_times in frames:
                     t0 = _time.perf_counter()
                     result = solver.solve(frame, f0=warm, local=use_local)
-                    writer.add(result.solution, result.status, ftime, cam_times)
+                    writer.add(result.solution, result.status, ftime,
+                               cam_times, iterations=int(result.iterations))
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
                     timer.add("solve frame", elapsed_ms / 1e3)
                     if primary:

@@ -69,10 +69,11 @@ def read_resume_state(
                 f"Cannot resume into {filename}: camera set mismatch "
                 f"(file has {sorted(have)}, run has {sorted(expected)})."
             )
+        per_frame = [value, group["time"], group["status"]]
+        if "iterations" in group:
+            per_frame.append(group["iterations"])
         completed = min(
-            value.shape[0],
-            group["time"].shape[0],
-            group["status"].shape[0],
+            *(d.shape[0] for d in per_frame),
             *(group[k].shape[0] for k in expected),
         )
         times = group["time"][:completed]
@@ -111,6 +112,7 @@ class SolutionWriter:
             self._truncate_torn_tail(len(state.times))
         self._solutions: List[np.ndarray] = []
         self._status: List[int] = []
+        self._iterations: List[int] = []
         self._time: List[float] = []
         self._camera_time: Dict[str, List[float]] = {name: [] for name in camera_names}
 
@@ -121,12 +123,16 @@ class SolutionWriter:
         status: int,
         time: float,
         camera_time: Sequence[float],
+        iterations: int = -1,
     ) -> None:
         """Buffer one frame's result (solution.cpp:44-58). ``camera_time``
-        is ordered like the camera-name list."""
+        is ordered like the camera-name list. ``iterations`` (an extension
+        over the reference schema; -1 = unknown) records the per-frame
+        convergence cost alongside the status code."""
         self._status.append(int(status))
         self._solutions.append(np.asarray(solution, np.float64))
         self._time.append(float(time))
+        self._iterations.append(int(iterations))
         for name, t in zip(self._camera_time, camera_time):
             self._camera_time[name].append(float(t))
         if len(self._solutions) >= self.max_cache_size:
@@ -142,6 +148,7 @@ class SolutionWriter:
         self.first_flush = False
         self._solutions.clear()
         self._status.clear()
+        self._iterations.clear()
         self._time.clear()
         for v in self._camera_time.values():
             v.clear()
@@ -195,6 +202,15 @@ class SolutionWriter:
                     f"time_{name}", data=np.asarray(times), maxshape=(None,),
                     chunks=(n,), dtype=np.float64, fillvalue=0.0,
                 )
+            # extension over the reference schema: per-frame iteration
+            # counts (-1 = unknown), the other half of the convergence-cost
+            # signal next to `status`. Created BEFORE `status`: the resume
+            # reader treats a missing `status` as the torn-first-flush
+            # sentinel, so `status` must stay the last-created dataset.
+            group.create_dataset(
+                "iterations", data=np.asarray(self._iterations, np.int32),
+                maxshape=(None,), chunks=(n,), dtype=np.int32, fillvalue=-1,
+            )
             group.create_dataset(
                 "status", data=np.asarray(self._status, np.int32),
                 maxshape=(None,), chunks=(n,), dtype=np.int32, fillvalue=0,
@@ -214,6 +230,11 @@ class SolutionWriter:
             dset = f["solution/status"]
             dset.resize((new_size,))
             dset[offset:] = np.asarray(self._status, np.int32)
+
+            if "iterations" in f["solution"]:  # absent when resuming a
+                dset = f["solution/iterations"]  # pre-extension file
+                dset.resize((new_size,))
+                dset[offset:] = np.asarray(self._iterations, np.int32)
 
             for name, times in self._camera_time.items():
                 dset = f[f"solution/time_{name}"]

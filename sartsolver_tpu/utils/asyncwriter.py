@@ -65,6 +65,7 @@ class AsyncSolutionWriter:
         status: int,
         time: float,
         camera_time: Sequence[float],
+        iterations: int = -1,
     ) -> None:
         self._check()
         if self._closed:
@@ -72,7 +73,8 @@ class AsyncSolutionWriter:
         # copy: the caller may reuse/donate the buffer while the write is
         # still queued
         self._queue.put((np.array(solution, np.float64, copy=True),
-                         int(status), float(time), list(camera_time)))
+                         int(status), float(time), list(camera_time),
+                         int(iterations)))
 
     def close(self) -> None:
         if self._closed:

@@ -292,16 +292,38 @@ class TestSolutionWriter:
                             max_cache_size=2) as w:
             for t in range(5):
                 w.add(sols[t], status=(0 if t % 2 == 0 else -1),
-                      time=0.1 * t, camera_time=[0.1 * t, 0.1 * t + 0.003])
+                      time=0.1 * t, camera_time=[0.1 * t, 0.1 * t + 0.003],
+                      iterations=10 + t)
 
         with h5py.File(out, "r") as f:
             np.testing.assert_allclose(f["solution/value"][:], sols)
             np.testing.assert_allclose(f["solution/time"][:], 0.1 * np.arange(5))
             np.testing.assert_array_equal(
                 f["solution/status"][:], [0, -1, 0, -1, 0])
+            np.testing.assert_array_equal(
+                f["solution/iterations"][:], 10 + np.arange(5))
             np.testing.assert_allclose(
                 f[f"solution/time_{fx.CAM_B}"][:], 0.1 * np.arange(5) + 0.003)
             assert f["solution/value"].maxshape == (None, fx.NVOXEL)
+
+    def test_resume_into_pre_iterations_file(self, tmp_path):
+        """Resuming into a file written before the `iterations` extension
+        (dataset absent) must keep appending without it."""
+        out = str(tmp_path / "old.h5")
+        with SolutionWriter(out, [fx.CAM_A], fx.NVOXEL, max_cache_size=10) as w:
+            w.add(np.zeros(fx.NVOXEL), 0, 0.0, [0.0])
+        with h5py.File(out, "r+") as f:
+            del f["solution/iterations"]  # simulate a pre-extension file
+        from sartsolver_tpu.io.solution import read_resume_state
+
+        state = read_resume_state(out, [fx.CAM_A], fx.NVOXEL)
+        assert state is not None and len(state.times) == 1
+        with SolutionWriter(out, [fx.CAM_A], fx.NVOXEL, max_cache_size=10,
+                            resume=state) as w:
+            w.add(np.ones(fx.NVOXEL), 0, 0.1, [0.1], iterations=5)
+        with h5py.File(out, "r") as f:
+            assert f["solution/value"].shape[0] == 2
+            assert "iterations" not in f["solution"]
 
 
 class TestAlignmentTieBreaks:
