@@ -1,0 +1,134 @@
+"""Hardware experiment: fused-sweep throughput vs voxel-panel size.
+
+Re-execs itself with SART_FUSED_PANEL_BYTES set per configuration (the
+panel budget is read at import time) and times the real solver path the
+same way bench.py does. Results go to stderr; run manually on TPU.
+
+Findings on v5e (2026-07-30, 8192x65536 RTM, 200 fixed iterations) that
+set the defaults in ops/fused_sweep.py:
+
+- bf16 B=1: panel size is a wash (523.5 iter/s at bs=256 vs 527.0 at
+  bs=512) — the DMA pipeline hides panel-count overhead.
+- bf16 B=32: LARGER panels lose (389.6 at bs=256 vs 306.5 at bs=512) —
+  the batch-scaled operand panels raise VMEM pressure.
+- int8 B=1: larger panels win slightly (899.8 at bs=512 -> 914.7 at
+  bs=1024); int8 B=32: larger panels win big (470.4 -> 526.5, i.e.
+  15.1k -> 16.8k frame-iter/s) — the per-panel VPU dequant makes
+  fewer/larger panels cheaper. Hence the int8-only 12 MiB panel target.
+- Casting the fp32 dot operands (w, f_new) to bf16 to match the panel
+  dtype measured slower everywhere (B=32 bf16 390 -> 365, B=32 int8
+  526 -> 507, B=1 within noise): Mosaic's mixed f32xbf16 contraction is
+  already the fastest lowering, so the kernel keeps fp32 operands.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+CONFIGS = [
+    # (dtype, B, panel_bytes, extra_env)
+    ("bfloat16", 1, 8 << 20, {}),
+    ("bfloat16", 1, 12 << 20, {}),
+    ("int8", 1, 8 << 20, {}),
+    ("int8", 1, 12 << 20, {}),
+    ("int8", 32, 8 << 20, {}),
+    ("int8", 32, 12 << 20, {}),
+    ("bfloat16", 32, 8 << 20, {}),
+    ("bfloat16", 32, 12 << 20, {}),
+]
+
+
+def child(dtype: str, B: int) -> None:
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    try:  # reuse compiled executables across sweep subprocesses
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                           "/tmp/sartsolver_jax_cache"))
+    except Exception:
+        pass
+
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import (
+        SARTProblem, compute_ray_stats, make_problem, solve_normalized_batch,
+    )
+    from sartsolver_tpu.ops.fused_sweep import pick_block_voxels
+
+    P, V, iters = 8192, 65536, 200
+    rng = np.random.default_rng(0)
+    H32 = (rng.random((P, V), dtype=np.float32) * 0.9 + 0.1)
+    f_true = rng.random((B, V), dtype=np.float32) * 1.5 + 0.5
+    G = f_true.astype(np.float64) @ H32.astype(np.float64).T
+    norms = G.max(axis=1)
+    msqs = (G**2).sum(axis=1) / norms**2
+    G_n = (G / norms[:, None]).astype(np.float32)
+
+    opts = SolverOptions(max_iterations=iters, conv_tolerance=0.0,
+                         fused_sweep="auto", rtm_dtype=dtype)
+    if dtype == "int8":
+        problem = make_problem(H32, None, opts=opts)
+    else:
+        rtm = jnp.asarray(H32, dtype=jnp.dtype(dtype))
+        dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
+        problem = SARTProblem(rtm, dens, length, None)
+    g_dev = jnp.asarray(G_n)
+    msq_dev = jnp.asarray(msqs, jnp.float32)
+    f0 = jnp.zeros((B, V), jnp.float32)
+
+    def run():
+        return solve_normalized_batch(
+            problem, g_dev, msq_dev, f0,
+            opts=opts, axis_name=None, voxel_axis=None, use_guess=True)
+
+    res = run()
+    np.asarray(res.solution)
+    n_done = max(int(res.iterations[0]), 1)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        res = run()
+        np.asarray(res.solution)
+        best = min(best, time.perf_counter() - t0)
+    itemsize = jnp.dtype(dtype).itemsize
+    bs = pick_block_voxels(P, V, itemsize, B)
+    rate = n_done / best
+    print(json.dumps({
+        "dtype": dtype, "B": B,
+        "panel_bytes": int(os.environ.get("SART_FUSED_PANEL_BYTES", 8 << 20)),
+        "bs": bs, "loop_iter_s": round(rate, 1),
+        "frame_iter_s": round(rate * B, 1),
+        "hbm_frac": round(rate * P * V * itemsize / 819e9, 3),
+    }), file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    for dtype, B, pb, extra in CONFIGS:
+        env = dict(os.environ, SART_FUSED_PANEL_BYTES=str(pb), **extra)
+        print(f"--- {dtype} B={B} panel={pb >> 20}MiB {extra}",
+              file=sys.stderr, flush=True)
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--child", dtype, str(B)],
+                env=env, timeout=900)
+            if r.returncode:
+                print(f"    FAILED rc={r.returncode}", file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print("    FAILED timeout>900s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child(sys.argv[2], int(sys.argv[3]))
+    else:
+        main()

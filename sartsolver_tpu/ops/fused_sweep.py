@@ -70,6 +70,13 @@ def _env_bytes(name: str, default: int, lo: int, hi: int) -> int:
 # compiler will grant the kernel (see the scoped-VMEM model below).
 _PANEL_BYTES_TARGET = _env_bytes(
     "SART_FUSED_PANEL_BYTES", 8 << 20, 1 << 20, 12 << 20)
+# int8 panels carry a per-element VPU dequant cost, so fewer/larger panels
+# win (measured v5e 2026-07-30, 8192x65536: bs 512 -> 1024 is +1.7% at B=1
+# and +12% at B=32), while bf16 at batch shapes *loses* from the added VMEM
+# pressure (B=32: 390 iter/s at bs=256 vs 306 at bs=512) — hence a separate,
+# larger default target for 1-byte storage only.
+_PANEL_BYTES_TARGET_INT8 = _env_bytes(
+    "SART_FUSED_PANEL_BYTES", 12 << 20, 1 << 20, 12 << 20)
 _MIN_BLOCK_VOXELS = 128  # lane width
 _SUBLANE = 8  # fp32 sublane width
 
@@ -150,15 +157,21 @@ def pick_block_voxels(
 ) -> int:
     """Largest voxel-panel width (multiple of 128, dividing nvoxel) whose
     per-panel VMEM footprint — the RTM panel plus the batch-scaled
-    [B, bs] operand panels — fits the budget; 0 if even the minimum block
-    does not fit the budget (or nvoxel is not a multiple of 128)."""
+    [B, bs] operand panels — fits the budget AND whose whole-kernel
+    scoped-VMEM estimate fits the raise cap (a panel at the byte target can
+    push a large batch past the cap, where a narrower panel still fuses);
+    0 if no width satisfies both (or nvoxel is not a multiple of 128)."""
     if nvoxel % _MIN_BLOCK_VOXELS:
         return 0
+    target = _PANEL_BYTES_TARGET_INT8 if itemsize == 1 else _PANEL_BYTES_TARGET
     per_voxel = npixel * itemsize + _VOXEL_PANEL_OPERANDS * batch * 4
-    bs = (_PANEL_BYTES_TARGET // max(per_voxel, 1)) // 128 * 128
+    bs = (target // max(per_voxel, 1)) // 128 * 128
     bs = min(bs, nvoxel)
     while bs >= _MIN_BLOCK_VOXELS:
-        if nvoxel % bs == 0:
+        if nvoxel % bs == 0 and (
+            _scoped_vmem_estimate(npixel, nvoxel, bs, itemsize, batch)
+            <= _SCOPED_VMEM_EST_CAP_BYTES
+        ):
             return bs
         bs -= _MIN_BLOCK_VOXELS
     return 0
@@ -171,11 +184,9 @@ def fused_available(npixel: int, nvoxel: int, rtm_itemsize: int, batch: int = 1)
     cap (see :func:`fused_compile_options`)."""
     if npixel % _SUBLANE:
         return False
-    bs = pick_block_voxels(npixel, nvoxel, rtm_itemsize, batch)
-    if bs <= 0:
-        return False
-    est = _scoped_vmem_estimate(npixel, nvoxel, bs, rtm_itemsize, batch)
-    return est <= _SCOPED_VMEM_EST_CAP_BYTES
+    # the picker already enforces the scoped-VMEM raise cap on its result,
+    # so a positive width IS eligibility
+    return pick_block_voxels(npixel, nvoxel, rtm_itemsize, batch) > 0
 
 
 _selftest_result: dict = {}
@@ -249,6 +260,10 @@ def _sweep_kernel(update_fn, n_aux, fwd_scale, rtm_ref, w_ref, f_ref, *rest):
         # the quantized matrix.
         panel = panel.astype(jnp.bfloat16)
     # Back-projection of this panel: contraction over the full pixel axis.
+    # The fp32 operands stay fp32: casting w / f_new to bf16 to match the
+    # panel measured *slower* at every shape tried (v5e 2026-07-30 — B=32
+    # bf16 390 -> 365 iter/s, B=32 int8 526 -> 507, B=1 unchanged), so the
+    # mixed f32xbf16 contraction is the fastest Mosaic lowering available.
     bp = jax.lax.dot_general(
         w_ref[...], panel,
         dimension_numbers=(((1,), (0,)), ((), ())),

@@ -171,6 +171,27 @@ def test_scoped_vmem_model():
     assert not fused_available(P, V, 4, batch=4096)
 
 
+def test_block_picker_steps_down_to_fit_vmem_cap():
+    """The panel width must narrow when the byte-target width would push the
+    whole-kernel scoped-VMEM estimate past the raise cap — int8 has NO
+    two-matmul fallback, so large batches must keep fusing at a narrower
+    panel instead of erroring (the 12 MiB int8 target picks bs=1024 at the
+    headline shape, which at B>=40 estimates past the 48 MiB cap where
+    bs=512 still fits)."""
+    from sartsolver_tpu.ops.fused_sweep import (
+        _SCOPED_VMEM_EST_CAP_BYTES, _scoped_vmem_estimate,
+    )
+
+    P, V = 8192, 65536
+    assert pick_block_voxels(P, V, 1, batch=1) == 1024
+    for batch in (32, 40, 48, 64):
+        bs = pick_block_voxels(P, V, 1, batch=batch)
+        assert bs > 0, f"int8 batch={batch} lost the fused sweep"
+        assert _scoped_vmem_estimate(P, V, bs, 1, batch) <= _SCOPED_VMEM_EST_CAP_BYTES
+        assert fused_available(P, V, 1, batch=batch)
+    assert pick_block_voxels(P, V, 1, batch=40) < 1024
+
+
 def test_compiler_options_dispatch_cpu_safe():
     """The dispatch wrapper must never attach the TPU-only flag off-TPU
     (auto resolves unfused on CPU) and must stay callable under an outer
