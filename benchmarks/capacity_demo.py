@@ -1,0 +1,196 @@
+"""Single-chip capacity demonstration: near-HBM-limit dense RTM solves.
+
+The reference's design target is a dense RTM of "tens or even hundreds of
+GB" spread over a GPU cluster at ~1 matrix-GB per GB of device RAM
+(manual p.3-4). This measures the *single-chip* end of that story on a
+16 GB v5e: the largest matrices one chip holds in each storage dtype,
+with the fused sweep engaged (tall shapes exercise the minimum-panel
+fallback in pick_block_voxels). Host arrays are built block-wise and
+quantization happens host-side for int8 (the on-device quantizer's fp32
+staging transient would not fit at these sizes — mirroring what
+multihost.read_and_quantize_rtm does for HDF5 ingest).
+
+Run manually on TPU; results to stderr as JSON lines.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+import numpy as np
+
+
+def _gen_blocks(P, V, block=4096, seed=0):
+    """Deterministic fp32 block stream — the single source of the synthetic
+    matrix. Re-seeding with the same ``seed`` replays the identical stream,
+    which the two-pass quantizer depends on (scales and codes must come
+    from the same matrix)."""
+    rng = np.random.default_rng(seed)
+    for r0 in range(0, P, block):
+        yield r0, (rng.random((min(block, P - r0), V), dtype=np.float32)
+                   * 0.9 + 0.1)
+
+
+def _make_host_matrix(P, V, out_dtype, seed=0):
+    """[P, V] random matrix built block-wise into the target dtype."""
+    import ml_dtypes  # bundled with jax
+
+    np_dtype = np.dtype(
+        ml_dtypes.bfloat16 if out_dtype == "bfloat16" else out_dtype)
+    H = np.empty((P, V), np_dtype)
+    for r0, blk in _gen_blocks(P, V, seed=seed):
+        H[r0:r0 + blk.shape[0]] = blk.astype(np_dtype)
+    return H
+
+
+def _quantize_host(P, V, seed=0):
+    """Two-pass host-side int8 quantization (per-voxel scales), matching
+    models.sart.quantize_rtm numerics without a device fp32 transient."""
+    colmax = np.zeros(V, np.float32)
+    for _r0, blk in _gen_blocks(P, V, seed=seed):
+        np.maximum(colmax, blk.max(axis=0), out=colmax)
+    scale = np.where(colmax > 0, colmax / 127.0, 1.0).astype(np.float32)
+    codes = np.empty((P, V), np.int8)
+    for r0, blk in _gen_blocks(P, V, seed=seed):  # same stream, second pass
+        codes[r0:r0 + blk.shape[0]] = np.clip(
+            np.round(blk / scale), -127, 127).astype(np.int8)
+    return codes, scale
+
+
+def run_case(dtype: str, P: int, V: int, iters: int = 50) -> None:
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                         "/tmp/sartsolver_jax_cache"))
+    except Exception:
+        pass
+
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import (
+        SARTProblem, compute_ray_stats, compute_ray_stats_int8,
+        solve_normalized_batch,
+    )
+    from sartsolver_tpu.ops.fused_sweep import pick_block_voxels
+
+    itemsize = jnp.dtype(dtype).itemsize
+    gb = P * V * itemsize / 1e9
+    print(f"--- {dtype} {P}x{V} = {gb:.1f} GB device", file=sys.stderr,
+          flush=True)
+    t0 = time.perf_counter()
+    if dtype == "int8":
+        codes_np, scale_np = _quantize_host(P, V)
+        t_host = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        codes = jnp.asarray(codes_np)
+        del codes_np
+        scale = jnp.asarray(scale_np)
+        jax.block_until_ready(codes)
+        t_stage = time.perf_counter() - t0
+        dens, length = compute_ray_stats_int8(codes, scale,
+                                              dtype=jnp.float32)
+        problem = SARTProblem(codes, dens, length, None, scale)
+        H_for_g = None
+    else:
+        H_np = _make_host_matrix(P, V, dtype)
+        t_host = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        rtm = jnp.asarray(H_np)
+        del H_np
+        jax.block_until_ready(rtm)
+        t_stage = time.perf_counter() - t0
+        dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
+        problem = SARTProblem(rtm, dens, length, None)
+        H_for_g = rtm
+
+    # synthetic measurement: g = H @ f_true computed ON DEVICE (a host
+    # matmul at these sizes would take minutes on one core)
+    rng = np.random.default_rng(1)
+    f_true = jnp.asarray(rng.random(V, dtype=np.float32) * 1.5 + 0.5)
+    if dtype == "int8":
+        g = jax.jit(
+            lambda c, s, f: (c.astype(jnp.bfloat16)
+                             @ (s * f).astype(jnp.bfloat16)
+                             ).astype(jnp.float32)
+        )(problem.rtm, problem.rtm_scale, f_true)
+    else:
+        g = jax.jit(
+            lambda h, f: (h @ f.astype(h.dtype)).astype(jnp.float32)
+        )(H_for_g, f_true)
+    g = np.asarray(g, np.float64)
+    norm = g.max()
+    msq = float(np.sum(g**2) / norm**2)
+
+    opts = SolverOptions(max_iterations=iters, conv_tolerance=0.0,
+                         fused_sweep="auto", rtm_dtype=dtype)
+    g_dev = jnp.asarray((g / norm)[None, :], jnp.float32)
+    msq_dev = jnp.asarray([msq], jnp.float32)
+    f0 = jnp.zeros((1, V), jnp.float32)
+
+    def run():
+        return solve_normalized_batch(
+            problem, g_dev, msq_dev, f0,
+            opts=opts, axis_name=None, voxel_axis=None, use_guess=True)
+
+    res = run()
+    np.asarray(res.solution)
+    n_done = max(int(res.iterations[0]), 1)
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        res = run()
+        np.asarray(res.solution)
+        best = min(best, time.perf_counter() - t0)
+    rate = n_done / best
+    print(json.dumps({
+        "dtype": dtype, "P": P, "V": V, "device_gb": round(gb, 2),
+        "bs": pick_block_voxels(P, V, itemsize, 1),
+        "loop_iter_s": round(rate, 1),
+        "hbm_frac": round(rate * P * V * itemsize / 819e9, 3),
+        "host_build_s": round(t_host, 1), "stage_s": round(t_stage, 1),
+    }), file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import subprocess
+
+    cases = [
+        # bf16 at 12.9 GB: tall shape -> minimum-panel (bs=128) fusion
+        ("bfloat16", 49152, 131072),
+        # int8 at 8.6 GB codes (both extents under INT8_MAX_CONTRACTION)
+        ("int8", 65536, 131072),
+        # int8 mid-size reference point (BASELINE.md capacity table row 3)
+        ("int8", 65536, 65536),
+    ]
+    # One subprocess per case: running a second near-HBM-limit case in the
+    # same process measured 20x slower (3.5 vs 70.2 iter/s for the 8.6 GB
+    # int8 case, 2026-07-30) — residual allocations/fragmentation from the
+    # previous case's buffers poison the follow-on run.
+    for dtype, P, V in cases:
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__, "--case", dtype, str(P), str(V)],
+                timeout=3600)
+            if r.returncode:
+                print(f"    FAILED {dtype} {P}x{V}: rc={r.returncode}",
+                      file=sys.stderr, flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"    FAILED {dtype} {P}x{V}: timeout>3600s",
+                  file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    if "--case" in sys.argv:
+        i = sys.argv.index("--case")
+        run_case(sys.argv[i + 1], int(sys.argv[i + 2]), int(sys.argv[i + 3]))
+    else:
+        main()

@@ -155,18 +155,22 @@ def fused_compile_options(
 def pick_block_voxels(
     npixel: int, nvoxel: int, itemsize: int, batch: int = 1
 ) -> int:
-    """Largest voxel-panel width (multiple of 128, dividing nvoxel) whose
-    per-panel VMEM footprint — the RTM panel plus the batch-scaled
-    [B, bs] operand panels — fits the budget AND whose whole-kernel
-    scoped-VMEM estimate fits the raise cap (a panel at the byte target can
-    push a large batch past the cap, where a narrower panel still fuses);
-    0 if no width satisfies both (or nvoxel is not a multiple of 128)."""
+    """Voxel-panel width (multiple of 128, dividing nvoxel) for the fused
+    sweep: the largest width under the panel-bytes target — a throughput
+    heuristic — whose whole-kernel scoped-VMEM estimate also fits the raise
+    cap, the hard constraint (a panel at the byte target can push a large
+    batch past the cap, where a narrower panel still fuses). Tall matrices
+    (npixel so large even a 128-wide panel exceeds the byte target — e.g.
+    the per-chip shard of a voxel-major mesh) fall back to the minimum
+    width rather than losing fusion, since only the estimate cap is load-
+    bearing. 0 if no width fits the cap (or nvoxel is not a multiple of
+    128)."""
     if nvoxel % _MIN_BLOCK_VOXELS:
         return 0
     target = _PANEL_BYTES_TARGET_INT8 if itemsize == 1 else _PANEL_BYTES_TARGET
     per_voxel = npixel * itemsize + _VOXEL_PANEL_OPERANDS * batch * 4
     bs = (target // max(per_voxel, 1)) // 128 * 128
-    bs = min(bs, nvoxel)
+    bs = min(max(bs, _MIN_BLOCK_VOXELS), nvoxel)
     while bs >= _MIN_BLOCK_VOXELS:
         if nvoxel % bs == 0 and (
             _scoped_vmem_estimate(npixel, nvoxel, bs, itemsize, batch)

@@ -192,6 +192,22 @@ def test_block_picker_steps_down_to_fit_vmem_cap():
     assert pick_block_voxels(P, V, 1, batch=40) < 1024
 
 
+def test_block_picker_tall_matrices_keep_minimum_panel():
+    """A tall matrix (large pixel count — the per-chip shard shape of a
+    voxel-major mesh) must fall back to the minimum 128-wide panel when
+    even that exceeds the panel-bytes target, as long as the scoped-VMEM
+    estimate cap still fits: losing fusion entirely would drop such shards
+    to the ~8x-slower two-matmul gemv path."""
+    # bf16 at 49152 pixels: a 128-panel is 12.6 MiB (> the 8 MiB target)
+    # but the kernel estimate is ~26 MiB, well under the 48 MiB cap
+    assert pick_block_voxels(49152, 131072, 2) == 128
+    assert fused_available(49152, 131072, 2)
+    # fp32 at the same height: the 128-panel estimate alone is ~50 MiB,
+    # past the cap -> genuinely ineligible
+    assert pick_block_voxels(49152, 131072, 4) == 0
+    assert not fused_available(49152, 131072, 4)
+
+
 def test_compiler_options_dispatch_cpu_safe():
     """The dispatch wrapper must never attach the TPU-only flag off-TPU
     (auto resolves unfused on CPU) and must stay callable under an outer
