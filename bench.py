@@ -193,10 +193,11 @@ def main() -> int:
     # tunnel: second-process compile 0.96 s -> 0.14 s). The env var alone
     # is not honoured by this build — set the config explicitly.
     try:
+        uid = os.getuid() if hasattr(os, "getuid") else "all"
         jax.config.update(
             "jax_compilation_cache_dir",
             os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                           "/tmp/sartsolver_jax_cache"),
+                           f"/tmp/sartsolver_jax_cache_{uid}"),
         )
     except Exception as err:
         _log(f"compilation cache unavailable: {err}")

@@ -71,7 +71,7 @@ def run_case(dtype: str, P: int, V: int, iters: int = 50) -> None:
     try:
         jax.config.update("jax_compilation_cache_dir",
                           os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                         "/tmp/sartsolver_jax_cache"))
+                                         f"/tmp/sartsolver_jax_cache_{os.getuid()}"))
     except Exception:
         pass
 

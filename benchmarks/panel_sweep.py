@@ -55,7 +55,7 @@ def child(dtype: str, B: int) -> None:
         jax.config.update(
             "jax_compilation_cache_dir",
             os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                           "/tmp/sartsolver_jax_cache"))
+                           f"/tmp/sartsolver_jax_cache_{os.getuid()}"))
     except Exception:
         pass
 

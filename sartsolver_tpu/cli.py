@@ -17,7 +17,9 @@ grouped under "tpu options".
 from __future__ import annotations
 
 import argparse
+import os
 import sys
+import tempfile
 import time as _time
 from typing import List, Optional
 
@@ -187,6 +189,26 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
+
+    # Persistent XLA compilation cache: the sharded solve costs 30-90 s to
+    # compile cold on a tunneled TPU backend, and a time-series workflow
+    # re-runs the same shapes constantly. Opt out / redirect with
+    # SART_COMPILATION_CACHE (empty string disables); the env var alone is
+    # not honoured by this JAX build, so set the config explicitly.
+    # per-user default: a fixed path in the world-writable tempdir would
+    # break (and be plantable) for the second user on a shared host
+    uid = os.getuid() if hasattr(os, "getuid") else "all"
+    cache_dir = os.environ.get(
+        "SART_COMPILATION_CACHE",
+        os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    f"sartsolver_jax_cache_{uid}")),
+    )
+    if cache_dir:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+        except Exception:
+            pass  # older jax without the option: cold compiles, not a failure
 
     if args.multihost:
         from sartsolver_tpu.parallel import multihost as mh

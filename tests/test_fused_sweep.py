@@ -192,6 +192,28 @@ def test_block_picker_steps_down_to_fit_vmem_cap():
     assert pick_block_voxels(P, V, 1, batch=40) < 1024
 
 
+def test_minimum_panel_solve_matches_unfused(monkeypatch):
+    """Numerics of the minimum-panel fallback path: shrink the panel-bytes
+    target so the target-derived width is 0 and the picker's 128-voxel
+    clamp engages, then assert the fused (interpret) solve still matches
+    the unfused reference bit-for-tolerance — the same path a tall RTM
+    (or a tall per-chip shard of a voxel-major mesh) takes on hardware."""
+    from sartsolver_tpu.ops import fused_sweep as fs
+
+    monkeypatch.setattr(fs, "_PANEL_BYTES_TARGET", 16 << 10)
+    assert (16 << 10) // (P * 4 + fs._VOXEL_PANEL_OPERANDS * 4) // 128 == 0
+    assert pick_block_voxels(P, V, 4) == 128
+
+    H, g = _case(seed=7)
+    base = SolverOptions(max_iterations=25, conv_tolerance=1e-12)
+    ref = _solve(H, g, dataclasses.replace(base, fused_sweep="off"))
+    fus = _solve(H, g, dataclasses.replace(base, fused_sweep="interpret"))
+    assert int(ref.iterations) == int(fus.iterations)
+    np.testing.assert_allclose(
+        np.asarray(fus.solution), np.asarray(ref.solution), rtol=2e-5, atol=2e-6
+    )
+
+
 def test_block_picker_tall_matrices_keep_minimum_panel():
     """A tall matrix (large pixel count — the per-chip shard shape of a
     voxel-major mesh) must fall back to the minimum 128-wide panel when
