@@ -1,0 +1,59 @@
+"""Where does the last ~30% of nominal HBM bandwidth go?
+
+Bounding probe (TPU v5e, 2026-07-30, results in docs/PERFORMANCE.md):
+
+(a) XLA bf16 gemv w@H (1 read)   2.134 ms -> 503 GB/s (61% of 819 nominal)
+(b) bare fused_sweep             2.153 ms -> 499 GB/s (61%)
+(c) XLA gemv pair, INDEPENDENT   2.168 ms -> 991 GB/s-equiv (121%)
+
+(b)==(a): the Pallas kernel has no overhead left over XLA's own
+single-read gemv — the gap to nominal is the device's achievable
+single-stream rate for this access pattern, not kernel inefficiency
+(the full solver loop actually exceeds it at ~570 GB/s via cross-
+iteration pipelining). (c): two concurrent readers of the SAME operand
+nearly double effective bandwidth (DRAM page hits), which is why the
+two-matmul path's naive 2-read hbm_frac can exceed 1.0 at batch shapes
+— but the real loop's two sweeps are data-dependent (forward needs the
+updated f), so unfused B=1 pays two serialized passes; fusing them into
+one pass is the same-dtype win (bf16 unfused 302.2 -> fused 531.2
+iter/s; fp32 162.4 -> 300.6, BENCH_tpu_2026-07-30c.json).
+
+Sync note: block_until_ready returns early on the tunneled backend —
+sync by fetching to host, like bench.py.
+"""
+import sys, time, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import numpy as np, jax, jax.numpy as jnp
+jax.config.update("jax_compilation_cache_dir", f"/tmp/sartsolver_jax_cache_{os.getuid()}")
+from sartsolver_tpu.ops.fused_sweep import fused_sweep, raised_vmem_options
+
+P, V = 8192, 65536
+rng = np.random.default_rng(0)
+H = jnp.asarray((rng.random((P, V), dtype=np.float32) * 0.9 + 0.1), jnp.bfloat16)
+w = jnp.asarray(rng.random((1, P), dtype=np.float32))
+f = jnp.asarray(rng.random((1, V), dtype=np.float32))
+
+def timeit(label, fn, *args, n=100, reads=1):
+    out = fn(*args)
+    np.asarray(jax.tree.leaves(out)[0])  # sync
+    best = float('inf')
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0])  # sync once per batch of n
+        best = min(best, (time.perf_counter() - t0) / n)
+    ms = best * 1e3
+    gbs = reads * P * V * 2 / 1e9 / ms * 1e3
+    print(f"{label}: {ms:.3f} ms -> {gbs:.0f} GB/s ({gbs/819*100:.0f}% of 819)")
+
+gemv = jax.jit(lambda w, h: jax.lax.dot_general(w, h, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32))
+timeit("(a) XLA bf16 gemv w@H       ", gemv, w, H)
+
+opts = raised_vmem_options()
+fs = jax.jit(lambda h, w, f: fused_sweep(h, w, f, [], lambda fp, bp: jnp.maximum(fp + 1e-3 * bp, 0)), compiler_options=opts)
+timeit("(b) bare fused_sweep        ", fs, H, w, f)
+
+pair = jax.jit(lambda w, h, f: (jax.lax.dot_general(w, h, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32),
+                                jax.lax.dot_general(f, h, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)))
+timeit("(c) XLA gemv pair (2 reads) ", pair, w, H, f, reads=2)
