@@ -1,0 +1,82 @@
+"""Generate a realistic-scale end-to-end world for full-pipeline timing.
+
+Two cameras (64x64 each, full masks), camera A's RTM split into two
+voxel-segment files (dense + dense), 65536 voxels (256x256x1 grid),
+8192 total pixels -> the benchmark headline shape, as actual HDF5 inputs
+the CLI ingests. 32 frames per camera on aligned clocks, measurements
+g_t = H @ (f_true * scale_t) with 1% noise. ~2.1 GB fp32 on disk.
+
+Usage: python benchmarks/e2e_world.py /tmp/e2e_world
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+
+def main(outdir: str) -> None:
+    import fixtures as fx
+
+    os.makedirs(outdir, exist_ok=True)
+    NX = NY = 256
+    fx.NX, fx.NY, fx.NZ = NX, NY, 1
+    V = NX * NY
+    cam_shape = (64, 64)
+    npix_cam = cam_shape[0] * cam_shape[1]  # 4096
+    mask = np.ones(cam_shape, np.int64)
+
+    rng = np.random.default_rng(0)
+    # banded response + diffuse reflection floor (manual p.1: reflections
+    # make the matrix dense), same construction as bench.py's converge case
+    ii = np.arange(2 * npix_cam, dtype=np.float32)[:, None] / (2 * npix_cam)
+    jj = np.arange(V, dtype=np.float32)[None, :] / V
+    H = (rng.random((2 * npix_cam, V), dtype=np.float32) * 0.9 + 0.1)
+    H *= np.exp(-((ii - jj) ** 2) * 200.0) + 0.02
+
+    cells = np.arange(V)
+    print("writing RTM segments ...", file=sys.stderr)
+    # camera A: two voxel segments (stitching path); camera B: one file
+    half = V // 2
+    # segment voxel-map values are LOCAL column indices; the reader stitches
+    # them with cumulative-nvoxel re-offsetting (hdf5files.cpp:162-201)
+    fx._write_rtm_file(os.path.join(outdir, "rtm_a_seg1.h5"), "camA", mask,
+                       H[:npix_cam, :half], cells[:half], np.arange(half))
+    fx._write_rtm_file(os.path.join(outdir, "rtm_a_seg2.h5"), "camA", mask,
+                       H[:npix_cam, half:], cells[half:], np.arange(half))
+    fx._write_rtm_file(os.path.join(outdir, "rtm_b.h5"), "camB", mask,
+                       H[npix_cam:], cells, np.arange(V))
+
+    T = 32
+    times = np.arange(T) * 0.1
+    f_true = rng.random(V, dtype=np.float32) * 1.5 + 0.5
+    scales = 1.0 + 0.3 * np.sin(np.linspace(0, 2 * np.pi, T))
+    print("computing measurements ...", file=sys.stderr)
+    F = (f_true[:, None] * scales[None, :]).astype(np.float32)  # [V, T]
+    G = H @ F  # [2*npix_cam, T] fp32 sgemm
+    G *= 1.0 + 0.01 * rng.standard_normal(G.shape).astype(np.float32)
+
+    print("writing image files ...", file=sys.stderr)
+    frames_a = G[:npix_cam].T.reshape(T, *cam_shape)
+    frames_b = G[npix_cam:].T.reshape(T, *cam_shape)
+    fx._write_image_file(os.path.join(outdir, "img_a.h5"), "camA",
+                         frames_a, times)
+    fx._write_image_file(os.path.join(outdir, "img_b.h5"), "camB",
+                         frames_b, times)
+    fx.write_laplacian_file(os.path.join(outdir, "laplacian.h5"), nvoxel=V)
+    np.save(os.path.join(outdir, "H.npy"), H)
+    np.save(os.path.join(outdir, "ftrue.npy"), f_true)
+    np.save(os.path.join(outdir, "scales.npy"), scales)
+    print(f"world ready in {outdir}: 8192x{V} RTM over 3 files, "
+          f"{T} frames x 2 cameras", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/e2e_world")
