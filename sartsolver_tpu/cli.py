@@ -502,6 +502,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 frames = (
                     item for item in frames if not already_written(item[1])
                 )
+            # Single-process runs keep solutions ON DEVICE: one packed
+            # scalar fetch per solve, solution transfer deferred to the
+            # async writer's thread, warm starts chained device-side
+            # (parallel/sharded.DeviceSolveResult — each synchronous
+            # host<->device round trip costs ~68 ms on a tunneled backend,
+            # vs ~9 ms of device work for a warm-started frame). Multi-host
+            # keeps the collective fetch on the main thread.
+            device_results = jax.process_count() == 1
+
             if args.batch_frames > 1:
                 pending = []
 
@@ -517,11 +526,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                             np.zeros((args.batch_frames - len(pending),
                                       stack.shape[1])),
                         ])
-                    result = solver.solve_batch(stack, local=use_local)
+                    result = solver.solve_batch(
+                        stack, local=use_local, device_result=device_results)
                     timer.add("solve batch", _time.perf_counter() - t0)
                     per_frame_ms = (_time.perf_counter() - t0) * 1e3 / len(pending)
                     for b, (_, ftime, cam_times) in enumerate(pending):
-                        writer.add(result.solution[b], int(result.status[b]),
+                        writer.add(result.solution_fetcher(b)
+                                   if device_results else result.solution[b],
+                                   int(result.status[b]),
                                    ftime, cam_times,
                                    iterations=int(result.iterations[b]))
                         if primary:
@@ -539,19 +551,36 @@ def main(argv: Optional[List[str]] = None) -> int:
                 if pending:
                     flush_batch()
             else:
-                warm: Optional[np.ndarray] = None
+                warm_dev = None  # device-chained warm (single-process)
+                f0_host: Optional[np.ndarray] = None  # host warm / resume seed
                 if resume_state is not None and not args.no_guess:
-                    warm = resume_state.last_solution
+                    f0_host = resume_state.last_solution
                 for frame, ftime, cam_times in frames:
                     t0 = _time.perf_counter()
-                    result = solver.solve(frame, f0=warm, local=use_local)
-                    writer.add(result.solution, result.status, ftime,
-                               cam_times, iterations=int(result.iterations))
+                    if device_results:
+                        dres = solver.solve_batch(
+                            np.asarray(frame)[None, :],
+                            None if f0_host is None else f0_host[None, :],
+                            local=use_local, device_result=True,
+                            warm=warm_dev,
+                        )
+                        f0_host = None  # resume seed consumed; chain on device
+                        warm_dev = None if args.no_guess else dres
+                        solution = dres.solution_fetcher(0)
+                        status = int(dres.status[0])
+                        iterations = int(dres.iterations[0])
+                    else:  # multi-host: collective fetch on the main thread
+                        result = solver.solve(frame, f0=f0_host, local=use_local)
+                        f0_host = None if args.no_guess else result.solution
+                        solution = result.solution
+                        status = int(result.status)
+                        iterations = int(result.iterations)
+                    writer.add(solution, status, ftime, cam_times,
+                               iterations=iterations)
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
                     timer.add("solve frame", elapsed_ms / 1e3)
                     if primary:
                         print(f"Processed in: {elapsed_ms} ms")
-                    warm = None if args.no_guess else result.solution
 
         _mark("frame loop (solve + prefetch + flush)")
         if primary:

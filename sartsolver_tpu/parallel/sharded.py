@@ -102,6 +102,50 @@ def _shard_laplacian(
     return LaplacianCOO(out_rows, out_cols, out_vals)
 
 
+class DeviceSolveResult:
+    """Batch result whose solution stays ON DEVICE (single-process only).
+
+    Motivation (measured on the tunneled v5e, 2026-07-30): one synchronous
+    host<->device round trip costs ~68 ms, and the host-side
+    :class:`SolveResult` path pays ~6 per frame (f0 staging, four result
+    fetches) — dwarfing a warm-started solve's ~9 ms of device work. Here
+    the per-frame synchronous cost is ONE round trip (the packed scalar
+    fetch); the solution transfer happens lazily via
+    :meth:`solution_fetcher` (intended for the async writer's worker
+    thread), and the normalized device solution doubles as the next
+    frame's warm start without ever visiting the host
+    (``solve_batch(warm=...)``).
+    """
+
+    def __init__(self, solver, solution_norm, norms, status, iterations,
+                 convergence):
+        self._solver = solver
+        self.solution_norm = solution_norm  # [B, padded_nvoxel] fp32, device
+        self.norms = np.asarray(norms, np.float64)  # [B]
+        self.status = np.asarray(status)  # host
+        self.iterations = np.asarray(iterations)
+        self.convergence = np.asarray(convergence, np.float64)
+        self._host: Optional[np.ndarray] = None
+
+    def fetch_solutions(self) -> np.ndarray:
+        """[B, nvoxel] fp64 physical-units solutions; one device fetch,
+        cached. Host-side fp64 denormalization — numerics identical to the
+        synchronous path (and the reference's D2H-then-multiply,
+        sartsolver_cuda.cpp:264-265)."""
+        if self._host is None:
+            sol = np.asarray(self.solution_norm).astype(np.float64)
+            self._host = (
+                sol[:, : self._solver.nvoxel] * self.norms[:, None]
+            )
+        return self._host
+
+    def solution_fetcher(self, b: int):
+        """Zero-arg callable resolving frame ``b``'s solution — hand to
+        AsyncSolutionWriter so the device fetch runs on the writer thread,
+        overlapped with the next frame's solve."""
+        return lambda: self.fetch_solutions()[b]
+
+
 class DistributedSARTSolver:
     """Upload-once / solve-many-frames driver (the reference's solver object
     lifecycle: matrix uploaded in the ctor, ``solve`` called per frame,
@@ -306,6 +350,14 @@ class DistributedSARTSolver:
             rtm_dev, ray_density, ray_length, laplacian, rtm_scale
         )
         self._solve_fns = {}
+        # Tiny device helpers for the DeviceSolveResult path; their dispatch
+        # is asynchronous, so neither adds a synchronous host round trip.
+        # Scalars pack to fp32: status (0/-1) and iterations (<= max 2000)
+        # are exact; convergence is already computed in the device dtype.
+        self._rescale_fn = jax.jit(lambda f, s: f * s[:, None].astype(f.dtype))
+        self._pack_fn = jax.jit(lambda s, i, c: jnp.stack([
+            s.astype(jnp.float32), i.astype(jnp.float32),
+            c.astype(jnp.float32)]))
 
     def _batch_fn(self, use_guess: bool):
         """Compiled batched solve over the mesh (one program per use_guess;
@@ -399,7 +451,15 @@ class DistributedSARTSolver:
             arrays,
         )
 
-    def solve_batch(self, measurements, f0=None, *, local: bool = False) -> SolveResult:
+    def solve_batch(
+        self,
+        measurements,
+        f0=None,
+        *,
+        local: bool = False,
+        device_result: bool = False,
+        warm: Optional[DeviceSolveResult] = None,
+    ) -> SolveResult | DeviceSolveResult:
         """Solve B independent frames in one batched device program.
 
         Per-frame semantics are identical to :meth:`solve`; intended for
@@ -411,9 +471,26 @@ class DistributedSARTSolver:
         rows (``local_pixel_range``); the measurement max/'norm' and
         ``||g||^2`` are combined across processes, and staging is
         per-device-sharded instead of replicated per host.
+
+        ``device_result=True`` (single-process only) returns a
+        :class:`DeviceSolveResult`: the solution stays on device, the
+        status/iterations/convergence scalars arrive in one packed fetch.
+        ``warm`` chains a previous frame's device result as this frame's
+        initial guess — the normalized solution is rescaled on device by
+        ``norm_prev/norm_new`` (the host path's fp64 round trip through
+        physical units is numerically a no-op up to one fp32 ulp, and a
+        warm start is only an initial guess).
         """
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
+        if (device_result or warm is not None) and jax.process_count() > 1:
+            raise ValueError(
+                "device_result/warm chaining is single-process only (the "
+                "multi-host fetch is collective and must stay on the main "
+                "thread)."
+            )
+        if warm is not None and f0 is not None:
+            raise ValueError("Pass either warm= (device) or f0= (host), not both.")
         G = np.asarray(measurements, np.float64)
         if local:
             rng = self.local_pixel_range()
@@ -465,15 +542,32 @@ class DistributedSARTSolver:
                 norms[b], msqs[b] = norm, msq
 
             g_dev = _stage(g_stage, self.mesh, P(None, PIXEL_AXIS))
-        use_guess = f0 is None
-        f0_np = np.zeros((B, self.padded_nvoxel), dtype)
-        if not use_guess:
-            f0_np[:, : self.nvoxel] = np.asarray(f0, np.float64) / norms[:, None]
-        f0_dev = _stage(f0_np, self.mesh, P(None, VOXEL_AXIS))
+        use_guess = f0 is None and warm is None
+        if warm is not None:
+            if warm.solution_norm.shape != (B, self.padded_nvoxel):
+                raise ValueError(
+                    f"warm result has shape {tuple(warm.solution_norm.shape)}, "
+                    f"expected {(B, self.padded_nvoxel)}."
+                )
+            scale = (warm.norms / norms).astype(np.float32)
+            f0_dev = self._rescale_fn(warm.solution_norm, jnp.asarray(scale))
+        else:
+            f0_np = np.zeros((B, self.padded_nvoxel), dtype)
+            if not use_guess:
+                f0_np[:, : self.nvoxel] = np.asarray(f0, np.float64) / norms[:, None]
+            f0_dev = _stage(f0_np, self.mesh, P(None, VOXEL_AXIS))
 
         res = self._batch_fn(use_guess)(
             self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev
         )
+        if device_result:
+            packed = np.asarray(self._pack_fn(res.status, res.iterations,
+                                              res.convergence))  # ONE fetch
+            return DeviceSolveResult(
+                self, res.solution, norms,
+                packed[0].astype(np.int32), packed[1].astype(np.int32),
+                packed[2],
+            )
         solution = _fetch(res.solution).astype(np.float64)[:, : self.nvoxel] * norms[:, None]
         return SolveResult(
             solution,

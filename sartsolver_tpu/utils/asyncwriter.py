@@ -47,7 +47,13 @@ class AsyncSolutionWriter:
             if self._error is not None:
                 continue  # latched: drain every later frame, write none
             try:
-                self._writer.add(*item)
+                solution, *rest = item
+                if callable(solution):
+                    # lazy solution (e.g. a DeviceSolveResult fetcher): the
+                    # device->host transfer runs HERE, overlapped with the
+                    # main thread's next solve
+                    solution = np.array(solution(), np.float64, copy=True)
+                self._writer.add(solution, *rest)
             except BaseException as err:
                 self._error = err
 
@@ -61,20 +67,24 @@ class AsyncSolutionWriter:
 
     def add(
         self,
-        solution: np.ndarray,
+        solution,
         status: int,
         time: float,
         camera_time: Sequence[float],
         iterations: int = -1,
     ) -> None:
+        """``solution``: an array, or a zero-arg callable returning one —
+        the callable is resolved on the worker thread (deferring e.g. a
+        device fetch off the solve loop's critical path)."""
         self._check()
         if self._closed:
             raise RuntimeError("Writer is closed.")
         # copy: the caller may reuse/donate the buffer while the write is
-        # still queued
-        self._queue.put((np.array(solution, np.float64, copy=True),
-                         int(status), float(time), list(camera_time),
-                         int(iterations)))
+        # still queued (callables defer-copy in the worker instead)
+        payload = (solution if callable(solution)
+                   else np.array(solution, np.float64, copy=True))
+        self._queue.put((payload, int(status), float(time),
+                         list(camera_time), int(iterations)))
 
     def close(self) -> None:
         if self._closed:

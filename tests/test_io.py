@@ -400,6 +400,30 @@ class TestAsyncSolutionWriter:
                     a[f"solution/{key}"][:], b[f"solution/{key}"][:]
                 )
 
+    def test_lazy_callable_solution_resolved_on_worker(self, tmp_path):
+        """A callable solution (DeviceSolveResult.solution_fetcher) must be
+        resolved on the worker thread and written like a plain array."""
+        import threading
+
+        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+
+        out = str(tmp_path / "lazy.h5")
+        caller = threading.get_ident()
+        resolved_on = []
+        value = np.linspace(0.0, 1.0, fx.NVOXEL)
+
+        def fetch():
+            resolved_on.append(threading.get_ident())
+            return value
+
+        with AsyncSolutionWriter(
+            SolutionWriter(out, [fx.CAM_A], fx.NVOXEL, max_cache_size=2)
+        ) as w:
+            w.add(fetch, 0, 0.5, [0.5])
+        with h5py.File(out) as f:
+            np.testing.assert_allclose(f["solution/value"][0], value)
+        assert resolved_on and resolved_on[0] != caller
+
     def test_write_error_surfaces(self):
         from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
 

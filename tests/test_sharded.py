@@ -89,6 +89,41 @@ def test_sharded_multiple_frames_warm_chain():
         assert np.isfinite(f).all()
 
 
+def test_device_result_chain_matches_host_chain():
+    """Device-resident warm chaining (DeviceSolveResult + warm=) must
+    reproduce the host round-trip chain: same statuses/iterations, same
+    solutions up to the one-fp32-ulp rescale difference in the initial
+    guess. Also pins the packed scalar fetch and the lazy fetcher."""
+    H, g, _ = make_case(seed=15, P=48, V=32)
+    opts = SolverOptions(max_iterations=12, conv_tolerance=1e-12)
+    scales = (1.0, 1.3, 0.8)
+
+    host_solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8))
+    f = None
+    host_results = []
+    for s in scales:
+        res = host_solver.solve(g * s, f0=f)
+        f = res.solution
+        host_results.append(res)
+
+    dev_solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8))
+    warm = None
+    for s, ref in zip(scales, host_results):
+        dres = dev_solver.solve_batch(
+            (g * s)[None, :], device_result=True, warm=warm)
+        assert int(dres.status[0]) == ref.status
+        assert int(dres.iterations[0]) == ref.iterations
+        fetched = dres.solution_fetcher(0)()
+        np.testing.assert_allclose(fetched, ref.solution, rtol=2e-5, atol=1e-7)
+        # cached: second fetch returns the same host array
+        assert dres.fetch_solutions() is dres.fetch_solutions()
+        warm = dres
+
+    with pytest.raises(ValueError, match="not both"):
+        dev_solver.solve_batch(g[None, :], f0=np.ones((1, H.shape[1])),
+                               device_result=True, warm=warm)
+
+
 @pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (1, 8)])
 @pytest.mark.parametrize("logarithmic", [False, True])
 def test_2d_mesh_equals_single_device(mesh_shape, logarithmic):
