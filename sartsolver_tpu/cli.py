@@ -55,6 +55,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Weight of the regularization factor.")
     p.add_argument("-R", "--relaxation", type=float, default=1.0,
                    help="Relaxation parameter.")
+    p.add_argument("--relaxation_decay", type=float, default=1.0,
+                   help="Geometric relaxation schedule: iteration k uses "
+                        "relaxation * decay^k. Default 1.0 (fixed "
+                        "relaxation, reference behavior).")
     p.add_argument("-n", "--raytransfer_name", default="with_reflections",
                    help="Ray transfer matrix dataset name.")
     p.add_argument("-L", "--logarithmic", action="store_true",
@@ -153,6 +157,9 @@ def _validate(args) -> None:
         fail(f"Argument conv_tolerance must be > 0, {args.conv_tolerance} given.")
     if not (0 < args.relaxation <= 1.0):
         fail(f"Argument relaxation must be within (0, 1] interval, {args.relaxation} given.")
+    if not (0 < args.relaxation_decay <= 1.0):
+        fail("Argument relaxation_decay must be within (0, 1] interval, "
+             f"{args.relaxation_decay} given.")
     if args.beta_laplace < 0:
         fail("Argument beta_laplace must be positive.")
     if args.rtm_dtype == "int8" and args.use_cpu:
@@ -294,6 +301,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 conv_tolerance=args.conv_tolerance,
                 beta_laplace=args.beta_laplace,
                 relaxation=args.relaxation,
+                relaxation_decay=args.relaxation_decay,
                 max_iterations=args.max_iterations,
                 # forwarded so an explicit --fused_sweep on fails loudly
                 # (the fused sweep is fp32-only) instead of silently
@@ -310,6 +318,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 conv_tolerance=args.conv_tolerance,
                 beta_laplace=args.beta_laplace,
                 relaxation=args.relaxation,
+                relaxation_decay=args.relaxation_decay,
                 max_iterations=args.max_iterations,
                 rtm_dtype=args.rtm_dtype,
                 fused_sweep=args.fused_sweep,

@@ -121,6 +121,12 @@ class SolverOptions:
     conv_tolerance: float = 1.0e-5
     beta_laplace: float = 2.0e-2
     relaxation: float = 1.0
+    # Geometric relaxation schedule alpha_k = relaxation * decay^k (beyond
+    # the reference, whose alpha is fixed — arguments.cpp -R; a decaying
+    # relaxation is standard SART practice for damping late-iteration
+    # oscillation, and BASELINE.json config 3 names a relaxation schedule).
+    # 1.0 (default) reproduces the reference's fixed-alpha behavior exactly.
+    relaxation_decay: float = 1.0
     max_iterations: int = 2000
     logarithmic: bool = False
 
@@ -175,6 +181,10 @@ class SolverOptions:
             raise ValueError("Attribute beta_laplace must be non-negative.")
         if not (0 < self.relaxation <= 1.0):
             raise ValueError("Attribute relaxation must be within (0, 1] interval.")
+        if not (0 < self.relaxation_decay <= 1.0):
+            raise ValueError(
+                "Attribute relaxation_decay must be within (0, 1] interval."
+            )
         if self.max_iterations <= 0:
             raise ValueError("Attribute max_iterations must be positive.")
         if self.dtype not in ("float32", "float64"):

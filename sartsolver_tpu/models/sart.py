@@ -507,6 +507,11 @@ def _solve_normalized_batch_impl(
             "tile-aligned shapes), or fp32/bfloat16 storage."
         )
     has_pen = problem.laplacian is not None
+    # Geometric relaxation schedule alpha_k = alpha * decay^k. decay is a
+    # Python float, so `scheduled` is a trace-time constant: the default
+    # (decay == 1) traces byte-identical HLO to the unscheduled solver.
+    decay = float(opts.relaxation_decay)
+    scheduled = decay != 1.0
     if fused is not None:
         alpha = float(opts.relaxation)
         # same clamping rule as the unfused path's `eps` (_tiny leaves
@@ -523,16 +528,25 @@ def _solve_normalized_batch_impl(
         if opts.logarithmic:
             vm32 = vmask.astype(dtype)[None, :]
 
-            def _log_update(f_p, bp_p, vm_p, obs_p, *pen_p):
+            # scheduled log solves pass alpha_k as an extra [1, V] aux
+            # panel (a traced value cannot be captured by the kernel
+            # closure); fixed-alpha solves keep the literal exponent
+            def _log_update(f_p, bp_p, vm_p, obs_p, *rest):
+                if scheduled:
+                    a_p, *pen_p = rest
+                else:
+                    pen_p = rest
                 fit = bp_p * vm_p
                 ratio = (obs_p + eps_f) / (fit + eps_f)
-                if alpha != 1.0:
+                if scheduled:
+                    ratio = ratio ** a_p
+                elif alpha != 1.0:
                     ratio = ratio ** alpha
                 return f_p * ratio * jnp.exp(-pen_p[0]) if pen_p else f_p * ratio
 
             if is_int8:
-                def update_fn(f_p, bp_p, s_p, vm_p, obs_p, *pen_p):
-                    return _log_update(f_p, bp_p * s_p, vm_p, obs_p, *pen_p)
+                def update_fn(f_p, bp_p, s_p, vm_p, obs_p, *rest):
+                    return _log_update(f_p, bp_p * s_p, vm_p, obs_p, *rest)
             else:
                 update_fn = _log_update
         else:
@@ -556,17 +570,32 @@ def _solve_normalized_batch_impl(
                            fwd_scale=0 if is_int8 else None,
                            interpret=fused == "interpret")
 
-    def run_sweep(f, fitted, penalty):
-        """(f_upd, fitted_upd or None): the iteration's two RTM sweeps."""
+    def run_sweep(f, fitted, penalty, dk):
+        """(f_upd, fitted_upd or None): the iteration's two RTM sweeps.
+        ``dk`` is the schedule factor decay^k (a traced scalar; 1 when the
+        schedule is off, in which case it is never materialized)."""
         if opts.logarithmic:
             w = jnp.where(meas_mask, fitted, 0) * inv_length
             if fused is not None:
-                return run_fused(w, f, [vm32, obs] + ([penalty] if has_pen else []))
+                aux = [vm32, obs]
+                if scheduled:
+                    aux.append(jnp.full(
+                        (1, nvoxel), jnp.asarray(opts.relaxation, dtype) * dk,
+                        dtype))
+                return run_fused(w, f, aux + ([penalty] if has_pen else []))
             fit = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
             fit = jnp.where(vmask[None, :], fit, 0)
-            ratio = ((obs + eps) / (fit + eps)) ** jnp.asarray(opts.relaxation, dtype)
+            exponent = jnp.asarray(opts.relaxation, dtype)
+            if scheduled:
+                exponent = exponent * dk
+            ratio = ((obs + eps) / (fit + eps)) ** exponent
             return f * ratio * jnp.exp(-penalty), None
         w = jnp.where(meas_mask, g - fitted, 0) * inv_length
+        if scheduled:
+            # the linear update is linear in w, so alpha_k = alpha * dk
+            # folds into the pixel weights (inv_density keeps the base
+            # alpha) — the same fold for the fused and two-matmul paths
+            w = w * dk
         if fused is not None:
             return run_fused(w, f, [inv_density[None, :]] + ([penalty] if has_pen else []))
         bp = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
@@ -578,7 +607,9 @@ def _solve_normalized_batch_impl(
             penalty = beta * batched_penalty(jnp.log(gather_voxels(f)))
         else:
             penalty = beta * batched_penalty(gather_voxels(f))
-        f_upd, fitted_upd = run_sweep(f, fitted, penalty)
+        dk = (jnp.asarray(decay, dtype) ** it.astype(dtype)
+              if scheduled else None)
+        f_upd, fitted_upd = run_sweep(f, fitted, penalty, dk)
 
         f_new = jnp.where(done[:, None], f, f_upd)  # converged frames freeze
         if fitted_upd is not None:

@@ -183,3 +183,86 @@ def test_bfloat16_rtm_tracks_fp32():
     assert rel < 0.03, f"bf16 deviates {rel:.3%} from fp32"
     # ray stats are computed in fp32 regardless of storage dtype
     assert problem.ray_density.dtype == jnp.float32
+
+
+class TestRelaxationSchedule:
+    """alpha_k = relaxation * decay^k (SolverOptions.relaxation_decay).
+
+    The pinning property: an N-iteration scheduled solve must equal N
+    chained 1-iteration solves whose fixed relaxation is alpha * decay^k —
+    each SART iteration depends on the schedule only through its own
+    alpha_k, so the unrolled chain is an independent implementation of the
+    same math.
+    """
+
+    @pytest.mark.parametrize("logarithmic", [False, True])
+    @pytest.mark.parametrize("fused", ["off", "interpret"])
+    def test_matches_unrolled_fixed_alpha_chain(self, logarithmic, fused):
+        import dataclasses
+
+        H, g, _ = make_case(seed=21, P=24, V=256, neg_pixels=2,
+                            zero_voxels=1, zero_pixels=1)
+        alpha, decay, n = 0.9, 0.7, 4
+        base = SolverOptions(
+            relaxation=alpha, relaxation_decay=decay, logarithmic=logarithmic,
+            max_iterations=n, conv_tolerance=0.0, fused_sweep=fused,
+        )
+        problem = make_problem(H, opts=base)
+        res_sched = solve(problem, g, opts=base)
+        assert int(res_sched.iterations) == n
+
+        f = None
+        for k in range(n):
+            step = dataclasses.replace(
+                base, relaxation=alpha * decay**k, relaxation_decay=1.0,
+                max_iterations=1,
+            )
+            # k=0 uses the same initial guess as the scheduled run
+            res = solve(problem, g, f0=f, opts=step)
+            f = np.asarray(res.solution)
+        np.testing.assert_allclose(
+            np.asarray(res_sched.solution), f, rtol=3e-5, atol=1e-7
+        )
+
+    def test_decay_one_traces_the_default_program(self):
+        """decay == 1.0 must be trace-time inert: the solver jaxpr is
+        byte-identical to the default options' jaxpr (no schedule ops in
+        the loop), while any decay < 1 traces a different program.
+        (End-to-end counterpart: a default CLI run after this feature is
+        bit-identical to one from before it.)"""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from sartsolver_tpu.models.sart import solve_normalized_batch
+
+        H, _, _ = make_case(seed=22, P=24, V=128)
+
+        def jaxpr_text(decay):
+            opts = SolverOptions(max_iterations=8, conv_tolerance=0.0,
+                                 relaxation=0.9, relaxation_decay=decay)
+            problem = make_problem(H, opts=opts)
+            fn = functools.partial(
+                solve_normalized_batch, problem,
+                opts=opts, axis_name=None, voxel_axis=None, use_guess=True,
+            )
+            args = (jnp.ones((1, H.shape[0]), jnp.float32),
+                    jnp.ones((1,), jnp.float32),
+                    jnp.zeros((1, H.shape[1]), jnp.float32))
+            return str(jax.make_jaxpr(fn)(*args))
+
+        default = jaxpr_text(1.0)
+        scheduled = jaxpr_text(0.9)
+        # the linear solver has no pow anywhere; the schedule's decay^k is
+        # exactly one — so its presence IS the scheduled branch having
+        # been traced, regardless of which side regresses
+        assert "pow" not in default
+        assert "pow" in scheduled
+        assert default != scheduled
+
+    def test_decay_validation(self):
+        with pytest.raises(ValueError, match="relaxation_decay"):
+            SolverOptions(relaxation_decay=0.0)
+        with pytest.raises(ValueError, match="relaxation_decay"):
+            SolverOptions(relaxation_decay=1.5)
