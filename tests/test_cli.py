@@ -60,6 +60,22 @@ def test_no_guess_flag(world):
         assert f["solution/value"].shape[0] > 0
 
 
+def test_relaxation_decay_flag(world, capsys):
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths, "-R", "0.9", "--relaxation_decay", "0.9") == 0
+    with h5py.File(paths["output"], "r") as f:
+        value = f["solution/value"][:]
+    # heavily damped but still reconstructing (geometric schedule shrinks
+    # late steps; quality bound is looser than the fixed-alpha test's)
+    for i, s in enumerate(scales):
+        np.testing.assert_allclose(H @ value[i], H @ (f_true * s), rtol=0.25)
+    capsys.readouterr()
+    # out-of-range decay takes the polite validation exit
+    with pytest.raises(SystemExit):
+        run_cli(paths, "--relaxation_decay", "0")
+    assert "relaxation_decay" in capsys.readouterr().err
+
+
 def test_logarithmic_mode(world):
     paths, H, f_true, times, scales = world
     assert run_cli(paths, "-L") == 0
