@@ -17,9 +17,7 @@ grouped under "tpu options".
 from __future__ import annotations
 
 import argparse
-import os
 import sys
-import tempfile
 import time as _time
 from typing import List, Optional
 
@@ -153,6 +151,9 @@ def _validate(args) -> None:
         fail(f"Argument ray_length_threshold must be >= 0, {args.ray_length_threshold} given.")
     if args.max_iterations < 1:
         fail(f"Argument max_iterations must be >= 1, {args.max_iterations} given.")
+    if args.max_iterations > 2**24:
+        fail(f"Argument max_iterations must be <= {2**24}, "
+             f"{args.max_iterations} given.")
     if args.conv_tolerance <= 0:
         fail(f"Argument conv_tolerance must be > 0, {args.conv_tolerance} given.")
     if not (0 < args.relaxation <= 1.0):
@@ -197,25 +198,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.debug_nans:
         jax.config.update("jax_debug_nans", True)
 
-    # Persistent XLA compilation cache: the sharded solve costs 30-90 s to
-    # compile cold on a tunneled TPU backend, and a time-series workflow
-    # re-runs the same shapes constantly. Opt out / redirect with
-    # SART_COMPILATION_CACHE (empty string disables); the env var alone is
-    # not honoured by this JAX build, so set the config explicitly.
-    # per-user default: a fixed path in the world-writable tempdir would
-    # break (and be plantable) for the second user on a shared host
-    uid = os.getuid() if hasattr(os, "getuid") else "all"
-    cache_dir = os.environ.get(
-        "SART_COMPILATION_CACHE",
-        os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                       os.path.join(tempfile.gettempdir(),
-                                    f"sartsolver_jax_cache_{uid}")),
-    )
-    if cache_dir:
-        try:
-            jax.config.update("jax_compilation_cache_dir", cache_dir)
-        except Exception:
-            pass  # older jax without the option: cold compiles, not a failure
+    # Persistent XLA compilation cache (utils/cache.py: safe per-user
+    # directory, SART_COMPILATION_CACHE/JAX_COMPILATION_CACHE_DIR honored,
+    # empty string disables).
+    from sartsolver_tpu.utils.cache import configure_compilation_cache
+
+    configure_compilation_cache()
 
     if args.multihost:
         from sartsolver_tpu.parallel import multihost as mh

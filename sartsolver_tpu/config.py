@@ -148,6 +148,15 @@ class SolverOptions:
     # problem is not pixel-sharded and shapes are tile-aligned; "interpret"
     # runs the kernel in the Pallas interpreter (CPU testing).
     fused_sweep: str = "auto"
+    # Accumulate the convergence metric's ||Hf||^2 in fp64 (emulated as
+    # float32 pairs on TPU) even when the compute dtype is fp32, so the
+    # |dC| < tol stall crossing (Eq. 5, sartsolver.cpp:224-228) stops
+    # drifting with storage-dtype noise (BASELINE.md dtype study: stop
+    # iterations shifted 70->96->81 across fp32/bf16/int8 storage). The
+    # reference CUDA path accepts an fp32 metric (cublasSdot,
+    # sartsolver_cuda.cpp:253); False reproduces that. O(B x npixel) per
+    # iteration — noise-floor cost next to the O(npixel x nvoxel) sweeps.
+    precise_convergence: bool = True
 
     @classmethod
     def cpu_parity(cls, *, logarithmic: bool = False, **kw) -> "SolverOptions":
@@ -187,6 +196,14 @@ class SolverOptions:
             )
         if self.max_iterations <= 0:
             raise ValueError("Attribute max_iterations must be positive.")
+        if self.max_iterations > 2**24:
+            # DeviceSolveResult packs the iteration count through an fp32
+            # stack (parallel/sharded.py:_pack_fn), exact only up to 2^24;
+            # the reference default is 2000, so this bounds nothing real.
+            raise ValueError(
+                "Attribute max_iterations must be <= 2**24 (iteration "
+                "counts are packed through fp32 in the device-result path)."
+            )
         if self.dtype not in ("float32", "float64"):
             raise ValueError("dtype must be 'float32' or 'float64'.")
         if self.rtm_dtype not in (None, "float32", "float64", "bfloat16", "int8"):

@@ -139,6 +139,34 @@ def _tiny(value: float, dtype) -> Array:
     return jnp.asarray(value, dtype)
 
 
+def _sumsq_precise(x: Array, dtype) -> Array:
+    """Within-shard ``sum(x**2, axis=1)`` accumulated in fp64, rounded back
+    to the compute dtype.
+
+    The convergence metric ``C = (||g||^2 - ||Hf||^2)/||g||^2`` (Eq. 5)
+    subtracts two nearly-equal O(1) quantities near the stall threshold; the
+    fp32 accumulation error of the sum over npixel elements (~eps*sqrt(P))
+    is what makes the stop iteration drift with storage dtype. Accumulating
+    in fp64 (emulated as float32 pairs on TPU) pins the summation error at
+    one fp32 ulp of the result; the final fp32 subtraction is then exact by
+    Sterbenz's lemma whenever ``||Hf||^2`` is within 2x of ``||g||^2``.
+    The cross-shard psum stays fp32 — summing a handful of already-rounded
+    partials adds no meaningful error and avoids fp64 collectives.
+    """
+    if jnp.dtype(dtype) == jnp.float64 or jax.config.jax_enable_x64:
+        x64 = x.astype(jnp.float64)
+        return jnp.sum(x64 * x64, axis=1).astype(dtype)
+    # jax 0.9 removed jax.experimental.enable_x64; the config State itself
+    # is the supported scoped switch (it only affects dtype canonicalization
+    # during this trace — the compiled fp64 ops are what we want).
+    from jax._src.config import enable_x64
+
+    with enable_x64(True):
+        x64 = x.astype(jnp.float64)
+        s = jnp.sum(x64 * x64, axis=1)
+    return s.astype(dtype)
+
+
 def compute_ray_stats(
     rtm: Array, *, dtype, axis_name=None, voxel_axis=None
 ) -> Tuple[Array, Array]:
@@ -618,7 +646,10 @@ def _solve_normalized_batch_impl(
             )
         else:
             fitted_new = _psum(forward_project(rtm, f_new, accum_dtype=dtype), voxel_axis)
-        fsq = _psum(jnp.sum(fitted_new * fitted_new, axis=1), axis_name)
+        if opts.precise_convergence:
+            fsq = _psum(_sumsq_precise(fitted_new, dtype), axis_name)
+        else:  # the reference CUDA path's fp32 dot (sartsolver_cuda.cpp:253)
+            fsq = _psum(jnp.sum(fitted_new * fitted_new, axis=1), axis_name)
         conv = (msq - fsq) / msq
         newly = (~done) & (it >= 1) & (jnp.abs(conv - conv_prev) < tol)
         iters = jnp.where(newly, it + 1, iters)

@@ -74,9 +74,13 @@ _PANEL_BYTES_TARGET = _env_bytes(
 # win (measured v5e 2026-07-30, 8192x65536: bs 512 -> 1024 is +1.7% at B=1
 # and +12% at B=32), while bf16 at batch shapes *loses* from the added VMEM
 # pressure (B=32: 390 iter/s at bs=256 vs 306 at bs=512) — hence a separate,
-# larger default target for 1-byte storage only.
+# larger default target for 1-byte storage only. Its own env var overrides
+# first, so tuning SART_FUSED_PANEL_BYTES no longer silently collapses the
+# measured int8-vs-bf16 split into one value.
 _PANEL_BYTES_TARGET_INT8 = _env_bytes(
-    "SART_FUSED_PANEL_BYTES", 12 << 20, 1 << 20, 12 << 20)
+    "SART_FUSED_PANEL_BYTES_INT8",
+    _env_bytes("SART_FUSED_PANEL_BYTES", 12 << 20, 1 << 20, 12 << 20),
+    1 << 20, 12 << 20)
 _MIN_BLOCK_VOXELS = 128  # lane width
 _SUBLANE = 8  # fp32 sublane width
 

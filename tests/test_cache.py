@@ -1,0 +1,51 @@
+"""utils/cache.py — shared compilation-cache configuration (ADVICE r2:
+the default must live under the user's own tree with safe permissions, and
+both entry points must honor the same opt-out)."""
+
+import os
+import stat
+
+import pytest
+
+from sartsolver_tpu.utils.cache import (
+    configure_compilation_cache,
+    default_cache_dir,
+)
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    monkeypatch.delenv("SART_COMPILATION_CACHE", raising=False)
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("XDG_CACHE_HOME", raising=False)
+
+
+def test_default_under_user_cache_tree(clean_env, monkeypatch, tmp_path):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path / "xdg"))
+    assert default_cache_dir() == str(tmp_path / "xdg" / "sartsolver" / "jax")
+    warned = []
+    d = configure_compilation_cache(warn=warned.append)
+    assert d == default_cache_dir() and not warned
+    mode = stat.S_IMODE(os.stat(d).st_mode)
+    assert not (mode & (stat.S_IWGRP | stat.S_IWOTH))
+
+
+def test_empty_opt_out_disables(clean_env, monkeypatch):
+    monkeypatch.setenv("SART_COMPILATION_CACHE", "")
+    assert configure_compilation_cache(warn=lambda m: None) is None
+
+
+def test_jax_env_var_honored(clean_env, monkeypatch, tmp_path):
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path / "jc"))
+    assert configure_compilation_cache(warn=lambda m: None) == str(tmp_path / "jc")
+
+
+@pytest.mark.skipif(not hasattr(os, "getuid"), reason="POSIX only")
+def test_world_writable_dir_refused(clean_env, monkeypatch, tmp_path):
+    unsafe = tmp_path / "unsafe"
+    unsafe.mkdir()
+    os.chmod(unsafe, 0o777)
+    monkeypatch.setenv("SART_COMPILATION_CACHE", str(unsafe))
+    warned = []
+    assert configure_compilation_cache(warn=warned.append) is None
+    assert warned and "refusing" in warned[0]

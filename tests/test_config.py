@@ -69,6 +69,9 @@ class TestSolverOptions:
             {"relaxation": 0},
             {"relaxation": 1.5},
             {"max_iterations": 0},
+            # iteration counts pack through fp32 in the device-result path
+            # (exact only to 2^24) — guarded at construction
+            {"max_iterations": 2**24 + 1},
             {"dtype": "int8"},
         ],
     )
