@@ -15,7 +15,7 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 import numpy as np
-from jax import Array
+from jax import Array, lax
 
 
 class LaplacianCOO(NamedTuple):
@@ -45,6 +45,131 @@ def make_laplacian(rows, cols, vals, *, dtype=jnp.float32, pad_to: int | None = 
         cols = np.concatenate([cols, np.zeros(pad, np.int32)])
         vals = np.concatenate([vals, np.zeros(pad, vals.dtype)])
     return LaplacianCOO(jnp.asarray(rows), jnp.asarray(cols), jnp.asarray(vals, dtype=dtype))
+
+
+class ShardedLaplacian(NamedTuple):
+    """Halo-exchange partition of a COO Laplacian over voxel (column) shards.
+
+    Replaces the per-iteration ``all_gather`` of the full solution that a
+    row-partitioned COO with global column indices forces (the round-2
+    design): real regularizers are local stencils (the reference's Laplacian
+    couples a voxel to its grid neighbors, laplacian.cpp), so after
+    partitioning both rows and columns by voxel block, almost every triplet
+    is block-diagonal — computable purely from the shard's own solution
+    block. The few cross-block triplets only need the *boundary* values,
+    which travel in a compact static export table (one small all_gather of
+    ``[B, n_shards * n_export]`` instead of ``[B, V_global]``). A worst-case
+    dense coupling degrades gracefully toward the full gather; a
+    block-diagonal split needs no communication at all.
+
+    Host-built by :func:`shard_laplacian_halo` with a leading shard
+    dimension on every field; inside ``shard_map`` each device slices its
+    own row (leading dim dropped) and calls :func:`sharded_penalty`.
+
+    Fields (S = voxel shards, padded per shard to the max count with inert
+    ``(0, 0, 0.0)`` triplets / index-0 exports):
+
+    - ``rows_loc, cols_loc, vals_loc`` — block-diagonal triplets; rows and
+      cols are block-local.
+    - ``rows_halo, gidx_halo, vals_halo`` — cross-block triplets; rows are
+      block-local, ``gidx_halo`` indexes the gathered export table
+      (``owner_shard * n_export + position``).
+    - ``export_idx`` — block-local solution indices this shard publishes
+      (the union of what every other shard needs from it).
+    """
+
+    rows_loc: Array  # [S, nnz_loc] int32
+    cols_loc: Array  # [S, nnz_loc] int32
+    vals_loc: Array  # [S, nnz_loc] float
+    rows_halo: Array  # [S, nnz_halo] int32
+    gidx_halo: Array  # [S, nnz_halo] int32
+    vals_halo: Array  # [S, nnz_halo] float
+    export_idx: Array  # [S, n_export] int32
+
+
+def shard_laplacian_halo(
+    lap: LaplacianCOO, n_shards: int, block: int, dtype
+) -> ShardedLaplacian:
+    """Partition COO triplets into block-diagonal + halo sets (host-side).
+
+    ``block`` is the padded per-shard voxel count; triplet indices are
+    global and must lie in ``[0, n_shards * block)``.
+    """
+    rows = np.asarray(lap.rows, np.int64)
+    cols = np.asarray(lap.cols, np.int64)
+    vals = np.asarray(lap.vals)
+    np_dtype = np.dtype(dtype)
+
+    own_r = rows // block
+    own_c = cols // block
+    is_loc = own_r == own_c
+
+    # Export sets: for each publishing shard t, the sorted unique
+    # block-local indices any OTHER shard reads from it.
+    exports = []
+    for t in range(n_shards):
+        sel = (~is_loc) & (own_c == t)
+        exports.append(np.unique(cols[sel] - t * block).astype(np.int64))
+    n_export = max((len(e) for e in exports), default=0)
+
+    def padded(mats, n, fill=0, dt=np.int32):
+        out = np.full((n_shards, n), fill, dt)
+        for s, m in enumerate(mats):
+            out[s, : len(m)] = m
+        return out
+
+    loc_r, loc_c, loc_v = [], [], []
+    halo_r, halo_g, halo_v = [], [], []
+    for s in range(n_shards):
+        sel = is_loc & (own_r == s)
+        loc_r.append(rows[sel] - s * block)
+        loc_c.append(cols[sel] - s * block)
+        loc_v.append(vals[sel])
+        sel = (~is_loc) & (own_r == s)
+        halo_r.append(rows[sel] - s * block)
+        t = own_c[sel]
+        c_loc = cols[sel] - t * block
+        # vectorized per owner shard (a per-triplet searchsorted loop is
+        # O(nnz) interpreter work in the dense-coupling worst case)
+        pos = np.zeros(len(t), np.int64)
+        for ti in np.unique(t):
+            m = t == ti
+            pos[m] = np.searchsorted(exports[ti], c_loc[m])
+        halo_g.append(t * n_export + pos)
+        halo_v.append(vals[sel])
+
+    nnz_loc = max(1, max((len(v) for v in loc_v), default=0))
+    nnz_halo = max((len(v) for v in halo_v), default=0)
+    return ShardedLaplacian(
+        padded(loc_r, nnz_loc),
+        padded(loc_c, nnz_loc),
+        padded(loc_v, nnz_loc, 0.0, np_dtype),
+        padded(halo_r, nnz_halo),
+        padded(halo_g, nnz_halo),
+        padded(halo_v, nnz_halo, 0.0, np_dtype),
+        padded(exports, n_export),
+    )
+
+
+def sharded_penalty(slap: ShardedLaplacian, x: Array, axis_name) -> Array:
+    """``(L @ x_global)`` restricted to this shard's voxel block.
+
+    ``x`` is the batched local solution block ``[B, voxel_block]``; fields
+    of ``slap`` are this device's slices (no leading shard dim). The only
+    communication is the compact boundary all_gather — skipped entirely
+    when the partition has no cross-block triplets.
+    """
+    pen = jnp.zeros_like(x).at[:, slap.rows_loc].add(
+        slap.vals_loc.astype(x.dtype)[None, :] * x[:, slap.cols_loc]
+    )
+    if slap.rows_halo.shape[-1] == 0 or axis_name is None:
+        return pen
+    table = lax.all_gather(
+        x[:, slap.export_idx], axis_name, axis=1, tiled=True
+    )  # [B, S * n_export]
+    return pen.at[:, slap.rows_halo].add(
+        slap.vals_halo.astype(x.dtype)[None, :] * table[:, slap.gidx_halo]
+    )
 
 
 def coo_matvec(lap: LaplacianCOO | None, x: Array, nvoxel: int) -> Array:

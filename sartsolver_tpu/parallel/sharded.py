@@ -15,8 +15,10 @@ solution vector, main.cpp:67-68) with ``jax.shard_map`` over a
 - every ``MPI_Allreduce`` site (16 in the reference, SURVEY §2) is a
   ``lax.psum`` *inside* the jitted while_loop, riding ICI with no
   per-iteration host staging (contrast sartsolver_cuda.cpp:242-244);
-  the 2-D path adds a forward-projection psum over 'voxels' and an
-  all_gather of f for the Laplacian's global column indexing.
+  the 2-D path adds a forward-projection psum over 'voxels', and the
+  Laplacian penalty is halo-exchanged (compact boundary all_gather,
+  ops/laplacian.py:ShardedLaplacian) — no [B, V_global] traffic in
+  the loop.
 
 Unequal MPI-style blocks become equal SPMD blocks by padding (see
 ``parallel.mesh``): padded pixels are excluded by the solver's own masking
@@ -39,9 +41,14 @@ from sartsolver_tpu.models.sart import (
     SolveResult,
     compute_ray_stats,
     prepare_measurement,
+    solve_chain_normalized,
     solve_normalized_batch,
 )
-from sartsolver_tpu.ops.laplacian import LaplacianCOO
+from sartsolver_tpu.ops.laplacian import (
+    LaplacianCOO,
+    ShardedLaplacian,
+    shard_laplacian_halo,
+)
 from sartsolver_tpu.parallel.mesh import (
     COL_ALIGN,
     PIXEL_AXIS,
@@ -71,35 +78,6 @@ def _fetch(x) -> np.ndarray:
     return fetch(x)
 
 
-def _shard_laplacian(
-    laplacian: LaplacianCOO, n_voxel_shards: int, voxel_block: int, dtype
-) -> LaplacianCOO:
-    """Partition COO triplets by output-row block for the voxel shards.
-
-    Returns arrays shaped [n_voxel_shards, nnz_max]: rows are block-local,
-    cols stay global (the solver all_gathers f for the column lookup), and
-    per-shard nnz is padded to the max with inert (0, 0, 0.0) entries.
-    """
-    rows = np.asarray(laplacian.rows, np.int64)
-    cols = np.asarray(laplacian.cols, np.int64)
-    vals = np.asarray(laplacian.vals)
-
-    shard_sel = [
-        (rows >= s * voxel_block) & (rows < (s + 1) * voxel_block)
-        for s in range(n_voxel_shards)
-    ]
-    nnz_max = max(int(sel.sum()) for sel in shard_sel) if len(rows) else 0
-    nnz_max = max(nnz_max, 1)
-
-    out_rows = np.zeros((n_voxel_shards, nnz_max), np.int32)
-    out_cols = np.zeros((n_voxel_shards, nnz_max), np.int32)
-    out_vals = np.zeros((n_voxel_shards, nnz_max), np.dtype(dtype))
-    for s, sel in enumerate(shard_sel):
-        n = int(sel.sum())
-        out_rows[s, :n] = rows[sel] - s * voxel_block
-        out_cols[s, :n] = cols[sel]
-        out_vals[s, :n] = vals[sel]
-    return LaplacianCOO(out_rows, out_cols, out_vals)
 
 
 class DeviceSolveResult:
@@ -336,14 +314,17 @@ class DistributedSARTSolver:
         ray_density, ray_length = stats_fn(*stats_args)
 
         if laplacian is not None:
-            sharded_lap = _shard_laplacian(
+            # Halo-exchange partition over the voxel shards: block-diagonal
+            # triplets read the local block; boundary values travel in a
+            # compact export table instead of a [B, V_global] all_gather of
+            # the solution every iteration (ops/laplacian.py). A 1-shard
+            # mesh degenerates to all-local triplets, no communication.
+            sharded_lap = shard_laplacian_halo(
                 laplacian, self.n_voxel_shards, self.voxel_block, dtype
             )
             lap_spec = P(VOXEL_AXIS, None)
-            laplacian = LaplacianCOO(
-                _stage(sharded_lap.rows, self.mesh, lap_spec),
-                _stage(sharded_lap.cols, self.mesh, lap_spec),
-                _stage(sharded_lap.vals, self.mesh, lap_spec),
+            laplacian = ShardedLaplacian(
+                *(_stage(f, self.mesh, lap_spec) for f in sharded_lap)
             )
 
         self.problem = SARTProblem(
@@ -358,48 +339,60 @@ class DistributedSARTSolver:
         self._pack_fn = jax.jit(lambda s, i, c: jnp.stack([
             s.astype(jnp.float32), i.astype(jnp.float32),
             c.astype(jnp.float32)]))
+        # last frame of a chain result, kept sharded on device — the next
+        # chain's frame-0 seed (rescale folded into the chain's rescale[0])
+        self._last_row_fn = jax.jit(lambda sol: sol[-1:])
+
+    def _problem_spec(self) -> SARTProblem:
+        has_lap = self.problem.laplacian is not None
+        lap_spec = ShardedLaplacian(
+            *(P(VOXEL_AXIS, None),) * len(ShardedLaplacian._fields)
+        ) if has_lap else None
+        return SARTProblem(
+            P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS), P(PIXEL_AXIS),
+            lap_spec,
+            P(VOXEL_AXIS) if self.problem.rtm_scale is not None else None,
+        )
+
+    def _compiler_options(self):
+        """The per-shard fused Pallas sweep can need a raised scoped-VMEM
+        limit (ops/fused_sweep.py); the option must sit on the outer jit
+        (the solver core is inlined under shard_map). Attaching the raised
+        limit when fusion is merely possible is harmless — it is a bound,
+        not an allocation (measured throughput unchanged)."""
+        if (
+            self._pixel_axis is None
+            and self.opts.fused_sweep != "off"
+            and jax.default_backend() == "tpu"
+        ):
+            from sartsolver_tpu.ops.fused_sweep import raised_vmem_options
+
+            return raised_vmem_options()
+        return None
+
+    @staticmethod
+    def _drop_lap_shard_dim(problem: SARTProblem) -> SARTProblem:
+        lap = problem.laplacian
+        if lap is None:
+            return problem
+        # drop the leading per-shard dim added by shard_laplacian_halo
+        return problem._replace(
+            laplacian=ShardedLaplacian(*(a[0] for a in lap))
+        )
 
     def _batch_fn(self, use_guess: bool):
         """Compiled batched solve over the mesh (one program per use_guess;
         XLA re-specializes per batch size on call)."""
         if use_guess not in self._solve_fns:
-            has_lap = self.problem.laplacian is not None
-            lap_spec = LaplacianCOO(P(VOXEL_AXIS, None), P(VOXEL_AXIS, None),
-                                    P(VOXEL_AXIS, None)) if has_lap else None
-            problem_spec = SARTProblem(
-                P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS), P(PIXEL_AXIS),
-                lap_spec,
-                P(VOXEL_AXIS) if self.problem.rtm_scale is not None else None,
-            )
             opts = self.opts
             pixel_axis = self._pixel_axis
             voxel_axis = self._voxel_axis
-
-            # The per-shard fused Pallas sweep can need a raised scoped-VMEM
-            # limit (ops/fused_sweep.py); the option must sit on THIS outer
-            # jit (the solver core is inlined under shard_map). Attaching the
-            # raised limit when fusion is merely possible is harmless — it is
-            # a bound, not an allocation (measured throughput unchanged).
-            options = None
-            if (
-                pixel_axis is None
-                and opts.fused_sweep != "off"
-                and jax.default_backend() == "tpu"
-            ):
-                from sartsolver_tpu.ops.fused_sweep import raised_vmem_options
-
-                options = raised_vmem_options()
+            options = self._compiler_options()
             vmem_raised = options is not None
 
             def run(problem, g, msq, f0):
-                lap = problem.laplacian
-                if lap is not None:
-                    # drop the leading per-shard dim added by _shard_laplacian
-                    problem = problem._replace(
-                        laplacian=LaplacianCOO(lap.rows[0], lap.cols[0], lap.vals[0])
-                    )
                 return solve_normalized_batch(
-                    problem, g, msq, f0,
+                    self._drop_lap_shard_dim(problem), g, msq, f0,
                     opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
                     use_guess=use_guess, _vmem_raised=vmem_raised,
                 )
@@ -407,12 +400,43 @@ class DistributedSARTSolver:
             fn = jax.shard_map(
                 run,
                 mesh=self.mesh,
-                in_specs=(problem_spec, P(None, PIXEL_AXIS), P(), P(None, VOXEL_AXIS)),
+                in_specs=(self._problem_spec(), P(None, PIXEL_AXIS), P(), P(None, VOXEL_AXIS)),
                 out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
                 check_vma=False,
             )
             self._solve_fns[use_guess] = jax.jit(fn, compiler_options=options)
         return self._solve_fns[use_guess]
+
+    def _chain_fn(self, use_guess_first: bool):
+        """Compiled K-frame warm chain over the mesh (lax.scan over frames
+        with the while_loop inside; models/sart.solve_chain_normalized)."""
+        key = ("chain", use_guess_first)
+        if key not in self._solve_fns:
+            opts = self.opts
+            pixel_axis = self._pixel_axis
+            voxel_axis = self._voxel_axis
+            options = self._compiler_options()
+            vmem_raised = options is not None
+
+            def run(problem, g, msq, f0, rescale):
+                return solve_chain_normalized(
+                    self._drop_lap_shard_dim(problem), g, msq, f0, rescale,
+                    opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
+                    use_guess_first=use_guess_first, _vmem_raised=vmem_raised,
+                )
+
+            fn = jax.shard_map(
+                run,
+                mesh=self.mesh,
+                in_specs=(
+                    self._problem_spec(), P(None, PIXEL_AXIS), P(),
+                    P(None, VOXEL_AXIS), P(),
+                ),
+                out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
+                check_vma=False,
+            )
+            self._solve_fns[key] = jax.jit(fn, compiler_options=options)
+        return self._solve_fns[key]
 
     def local_pixel_range(self):
         """See :func:`multihost.process_pixel_range`."""
@@ -449,6 +473,127 @@ class DistributedSARTSolver:
             (B, self.padded_npixel),
             NamedSharding(self.mesh, P(None, PIXEL_AXIS)),
             arrays,
+        )
+
+    def _check_frames(self, measurements, local: bool) -> np.ndarray:
+        G = np.asarray(measurements, np.float64)
+        if local:
+            rng = self.local_pixel_range()
+            if rng is None:
+                raise ValueError(
+                    "local measurement staging needs this process's row "
+                    "blocks to be contiguous; pass full frames instead."
+                )
+            expected = rng[1]
+        else:
+            expected = self.npixel
+        if G.ndim != 2 or G.shape[1] != expected:
+            raise ValueError(
+                f"Measurements must be [B, {expected}], got {G.shape}."
+            )
+        return G
+
+    def _stage_frames(self, G: np.ndarray, local: bool):
+        """Stage B frames onto the mesh: ``(g_dev, norms [B], msqs [B])``.
+
+        Shared by :meth:`solve_batch` and :meth:`solve_chain`.
+        """
+        opts = self.opts
+        dtype = jnp.dtype(opts.dtype)
+        B = G.shape[0]
+        if local:
+            # prepare_measurement semantics over process-local slices:
+            # global max (the fp32 normalization guard, MPI_Allreduce MAX
+            # parity, sartsolver_cuda.cpp:146-150) and global masked
+            # ||g||^2 (sartsolver.cpp:161-164) from cheap scalar gathers.
+            lmax = G.max(axis=1, initial=0.0)
+            lsum = np.sum(np.where(G > 0, G, 0.0) ** 2, axis=1)
+            if jax.process_count() > 1:
+                from jax.experimental import multihost_utils as mhu
+
+                allv = np.asarray(mhu.process_allgather(np.stack([lmax, lsum])))
+                gmax = allv[:, 0].max(axis=0)
+                gsum = allv[:, 1].sum(axis=0)
+            else:
+                gmax, gsum = lmax, lsum
+            if opts.normalize:
+                norms = np.where(gmax > 0, gmax, 1.0)
+            else:
+                norms = np.ones(B)
+            msqs = gsum / norms ** 2
+            msqs = np.where(msqs > 0, msqs, 1.0)
+            g_dev = self._stage_measurement_local(G, norms, dtype)
+        else:
+            norms = np.empty(B)
+            msqs = np.empty(B)
+            g_stage = np.empty((B, self.padded_npixel), dtype)
+            for b in range(B):
+                g64, msq, norm = prepare_measurement(G[b], opts)
+                g_stage[b] = pad_measurement(
+                    g64, self.n_pixel_shards, target=self.padded_npixel
+                )
+                norms[b], msqs[b] = norm, msq
+
+            g_dev = _stage(g_stage, self.mesh, P(None, PIXEL_AXIS))
+        return g_dev, norms, msqs
+
+    def solve_chain(
+        self,
+        measurements,
+        f0=None,
+        *,
+        warm: Optional[DeviceSolveResult] = None,
+        local: bool = False,
+    ) -> DeviceSolveResult:
+        """Solve K serially warm-chained frames in ONE device program.
+
+        The reference's core workload (main.cpp:131-140: each frame
+        warm-starts from the previous solution) dispatched per frame costs
+        one synchronous host round trip per frame; this chains the frames
+        on device (``lax.scan`` carrying the warm start, the while_loop
+        inside) so the whole chain pays ONE packed scalar fetch — per-frame
+        semantics identical to K separate :meth:`solve` calls by
+        construction. Single-process only (like ``device_result``).
+
+        Frame 0 seeds from ``warm`` (a previous chain's result — its LAST
+        frame carries over, staying on device), else from host ``f0``,
+        else from the Eq. 4 initial guess. Returns a
+        :class:`DeviceSolveResult` over the K frames.
+        """
+        opts = self.opts
+        dtype = jnp.dtype(opts.dtype)
+        if jax.process_count() > 1:
+            raise ValueError(
+                "solve_chain is single-process only (the multi-host fetch "
+                "is collective and must stay on the main thread)."
+            )
+        if warm is not None and f0 is not None:
+            raise ValueError("Pass either warm= (device) or f0= (host), not both.")
+        G = self._check_frames(measurements, local)
+        K = G.shape[0]
+        g_dev, norms, msqs = self._stage_frames(G, local)
+        # carry renormalization between per-frame measurement norms
+        rescale = np.ones(K)
+        rescale[1:] = norms[:-1] / norms[1:]
+        use_guess_first = f0 is None and warm is None
+        if warm is not None:
+            rescale[0] = warm.norms[-1] / norms[0]
+            f0_dev = self._last_row_fn(warm.solution_norm)
+        else:
+            f0_np = np.zeros((1, self.padded_nvoxel), dtype)
+            if f0 is not None:
+                f0_np[0, : self.nvoxel] = np.asarray(f0, np.float64) / norms[0]
+            f0_dev = _stage(f0_np, self.mesh, P(None, VOXEL_AXIS))
+        res = self._chain_fn(use_guess_first)(
+            self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev,
+            jnp.asarray(rescale, dtype),
+        )
+        packed = np.asarray(self._pack_fn(res.status, res.iterations,
+                                          res.convergence))  # ONE fetch
+        return DeviceSolveResult(
+            self, res.solution, norms,
+            packed[0].astype(np.int32), packed[1].astype(np.int32),
+            packed[2],
         )
 
     def solve_batch(
@@ -491,57 +636,9 @@ class DistributedSARTSolver:
             )
         if warm is not None and f0 is not None:
             raise ValueError("Pass either warm= (device) or f0= (host), not both.")
-        G = np.asarray(measurements, np.float64)
-        if local:
-            rng = self.local_pixel_range()
-            if rng is None:
-                raise ValueError(
-                    "local measurement staging needs this process's row "
-                    "blocks to be contiguous; pass full frames instead."
-                )
-            expected = rng[1]
-        else:
-            expected = self.npixel
-        if G.ndim != 2 or G.shape[1] != expected:
-            raise ValueError(
-                f"Measurements must be [B, {expected}], got {G.shape}."
-            )
+        G = self._check_frames(measurements, local)
         B = G.shape[0]
-
-        if local:
-            # prepare_measurement semantics over process-local slices:
-            # global max (the fp32 normalization guard, MPI_Allreduce MAX
-            # parity, sartsolver_cuda.cpp:146-150) and global masked
-            # ||g||^2 (sartsolver.cpp:161-164) from cheap scalar gathers.
-            lmax = G.max(axis=1, initial=0.0)
-            lsum = np.sum(np.where(G > 0, G, 0.0) ** 2, axis=1)
-            if jax.process_count() > 1:
-                from jax.experimental import multihost_utils as mhu
-
-                allv = np.asarray(mhu.process_allgather(np.stack([lmax, lsum])))
-                gmax = allv[:, 0].max(axis=0)
-                gsum = allv[:, 1].sum(axis=0)
-            else:
-                gmax, gsum = lmax, lsum
-            if opts.normalize:
-                norms = np.where(gmax > 0, gmax, 1.0)
-            else:
-                norms = np.ones(B)
-            msqs = gsum / norms ** 2
-            msqs = np.where(msqs > 0, msqs, 1.0)
-            g_dev = self._stage_measurement_local(G, norms, dtype)
-        else:
-            norms = np.empty(B)
-            msqs = np.empty(B)
-            g_stage = np.empty((B, self.padded_npixel), dtype)
-            for b in range(B):
-                g64, msq, norm = prepare_measurement(G[b], opts)
-                g_stage[b] = pad_measurement(
-                    g64, self.n_pixel_shards, target=self.padded_npixel
-                )
-                norms[b], msqs[b] = norm, msq
-
-            g_dev = _stage(g_stage, self.mesh, P(None, PIXEL_AXIS))
+        g_dev, norms, msqs = self._stage_frames(G, local)
         use_guess = f0 is None and warm is None
         if warm is not None:
             if warm.solution_norm.shape != (B, self.padded_nvoxel):

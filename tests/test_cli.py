@@ -126,6 +126,40 @@ def test_batch_frames_flag(world):
     assert t.shape[0] == len(times)  # partial final batch flushed too
 
 
+def test_chain_frames_matches_serial(world, capsys):
+    """--chain_frames K (device-chained warm-start loop, the default) must
+    write byte-identical results to serial dispatch (--chain_frames 1):
+    same statuses, same iteration counts, same solutions. K=3 over 4
+    frames also exercises the padded tail (one duplicated frame whose
+    output is discarded)."""
+    paths, *_ = world
+    assert run_cli(paths, "--chain_frames", "1") == 0
+    with h5py.File(paths["output"], "r") as f:
+        val_serial = f["solution/value"][:]
+        st_serial = f["solution/status"][:]
+        it_serial = f["solution/iterations"][:]
+
+    assert run_cli(paths, "--chain_frames", "3") == 0
+    out = capsys.readouterr().out
+    assert "average over chain" in out
+    with h5py.File(paths["output"], "r") as f:
+        val_chain = f["solution/value"][:]
+        st_chain = f["solution/status"][:]
+        it_chain = f["solution/iterations"][:]
+
+    np.testing.assert_array_equal(st_chain, st_serial)
+    np.testing.assert_array_equal(it_chain, it_serial)
+    np.testing.assert_allclose(val_chain, val_serial, rtol=1e-12, atol=1e-14)
+
+
+def test_chain_frames_validation(world, capsys):
+    paths, *_ = world
+    with pytest.raises(SystemExit):
+        main(["-o", paths["output"], paths["rtm_a1"], paths["img_a"],
+              "--chain_frames", "0"])
+    assert "chain_frames" in capsys.readouterr().err
+
+
 def test_batch_frames_requires_no_guess(world):
     paths, *_ = world
     with pytest.raises(SystemExit):
@@ -239,7 +273,8 @@ def test_timing_flag_prints_summary(world, capsys):
     out = capsys.readouterr().out
     assert "timing summary" in out
     for phase in ("validate + index inputs", "ingest RTM + upload",
-                  "solve frame", "write voxel map"):
+                  "solve chain",  # the default device-chained frame loop
+                  "write voxel map"):
         assert phase in out
 
 
@@ -253,6 +288,7 @@ def test_internal_error_propagates(world, monkeypatch):
         raise ValueError("internal solver bug")
 
     monkeypatch.setattr(sharded.DistributedSARTSolver, "solve_batch", boom)
+    monkeypatch.setattr(sharded.DistributedSARTSolver, "solve_chain", boom)
     with pytest.raises(ValueError, match="internal solver bug"):
         run_cli(paths)
 

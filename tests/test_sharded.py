@@ -17,6 +17,62 @@ from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
 from test_sart_core import laplacian_1d_chain, make_case
 
 
+def test_halo_laplacian_partition_matches_dense():
+    """shard_laplacian_halo + sharded_penalty == dense L @ x, shard by
+    shard, on a random sparse L with cross-block couplings; and the export
+    table stays boundary-sized (the whole point vs a full gather)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from sartsolver_tpu.ops.laplacian import (
+        make_laplacian, shard_laplacian_halo, sharded_penalty,
+    )
+
+    rng = np.random.default_rng(3)
+    S, vb = 4, 32
+    V = S * vb
+    nnz = 300
+    rows = rng.integers(0, V, nnz)
+    # mostly-local couplings plus some genuine cross-block ones
+    cols = np.clip(rows + rng.integers(-40, 41, nnz), 0, V - 1)
+    vals = rng.standard_normal(nnz)
+    lap = make_laplacian(rows, cols, vals, dtype="float64")
+    slap = shard_laplacian_halo(lap, S, vb, np.float64)
+
+    L = np.zeros((V, V))
+    np.add.at(L, (rows, cols), vals)
+    x = rng.standard_normal((2, V))
+    want = x @ L.T  # [B, V]
+
+    mesh = make_mesh(1, S)
+    got = jax.jit(jax.shard_map(
+        lambda sl, xb: sharded_penalty(
+            type(slap)(*(a[0] for a in sl)), xb, "voxels"
+        ),
+        mesh=mesh,
+        in_specs=(type(slap)(*(P("voxels", None),) * 7), P(None, "voxels")),
+        out_specs=P(None, "voxels"),
+        check_vma=False,
+    ))(slap, x)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-12, atol=1e-12)
+    # export table is the set of boundary cols, far smaller than a block
+    assert 0 < slap.export_idx.shape[1] < vb
+
+
+def test_halo_laplacian_block_diagonal_needs_no_exchange():
+    """A block-diagonal L (all couplings within one shard's block) must
+    partition with an empty halo — sharded_penalty then issues no
+    collective at all."""
+    from sartsolver_tpu.ops.laplacian import make_laplacian, shard_laplacian_halo
+
+    S, vb = 4, 16
+    rows = np.arange(S * vb)
+    lap = make_laplacian(rows, rows, np.ones(S * vb), dtype="float32")
+    slap = shard_laplacian_halo(lap, S, vb, np.float32)
+    assert slap.rows_halo.shape[1] == 0
+    assert slap.export_idx.shape[1] == 0
+
+
 def test_row_block_partition_matches_reference_formula():
     """main.cpp:67-68: offset = r*(n/P) + min(r, n%P); count = n/P (+1)."""
     for npixel, nshards in [(100, 8), (17, 4), (8, 8), (7, 3)]:
@@ -154,6 +210,73 @@ def test_device_result_chain_voxel_major_mesh():
         # psum reduction-order differences across mesh layouts perturb the
         # fp32 near-stall test: compare solutions loosely, not iterations
         rtol=2e-4, atol=1e-5, iteration_parity=False)
+
+
+@pytest.mark.parametrize("mesh_shape", [(8, 1), (1, 8)])
+@pytest.mark.parametrize("with_lap", [False, True])
+@pytest.mark.parametrize("seed_mode", ["guess", "host_f0", "warm"])
+def test_solve_chain_matches_per_frame_solves(mesh_shape, with_lap, seed_mode):
+    """solve_chain (scan-over-frames, one device program) must reproduce
+    the per-frame warm-start loop EXACTLY: same statuses, same iteration
+    counts, same solutions — the chain is the same math dispatched once
+    (VERDICT r2 next #1)."""
+    H, g, _ = make_case(seed=17, P=48, V=64)
+    lap = (make_laplacian(*laplacian_1d_chain(H.shape[1], 0.05),
+                          dtype="float32") if with_lap else None)
+    opts = SolverOptions(max_iterations=15, conv_tolerance=1e-10)
+    solver = DistributedSARTSolver(H, lap, opts=opts, mesh=make_mesh(*mesh_shape))
+    frames = np.stack([g, g * 1.2, g * 0.7, g * 1.05])
+
+    f0_host = np.full(H.shape[1], 0.5) if seed_mode == "host_f0" else None
+    warm0 = (solver.solve_chain(frames[:1] * 0.9)
+             if seed_mode == "warm" else None)
+
+    # reference: the per-frame device_result warm chain
+    refs = []
+    warm = warm0
+    f0 = f0_host
+    for k in range(frames.shape[0]):
+        dres = solver.solve_batch(frames[k][None],
+                                  None if f0 is None else f0[None],
+                                  device_result=True, warm=warm)
+        f0 = None
+        warm = dres
+        refs.append(dres)
+
+    chained = solver.solve_chain(frames, f0=f0_host, warm=warm0)
+    assert chained.status.shape == (4,)
+    for k, ref in enumerate(refs):
+        assert int(chained.status[k]) == int(ref.status[0]), k
+        assert int(chained.iterations[k]) == int(ref.iterations[0]), k
+        np.testing.assert_allclose(
+            chained.fetch_solutions()[k], ref.fetch_solutions()[0],
+            rtol=2e-6, atol=1e-8, err_msg=f"frame {k}",
+        )
+
+    # chain-to-chain warm handoff == one long chain
+    two = solver.solve_chain(frames[2:], warm=solver.solve_chain(frames[:2],
+                                                                 f0=f0_host,
+                                                                 warm=warm0))
+    for k in (2, 3):
+        assert int(two.status[k - 2]) == int(chained.status[k])
+        np.testing.assert_allclose(
+            two.fetch_solutions()[k - 2], chained.fetch_solutions()[k],
+            rtol=2e-6, atol=1e-8,
+        )
+
+
+def test_solve_chain_single_frame_and_errors():
+    H, g, _ = make_case(seed=18, P=24, V=32)
+    opts = SolverOptions(max_iterations=8, conv_tolerance=1e-10)
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8))
+    one = solver.solve_chain(g[None])
+    ref = solver.solve_batch(g[None], device_result=True)
+    assert int(one.status[0]) == int(ref.status[0])
+    assert int(one.iterations[0]) == int(ref.iterations[0])
+    np.testing.assert_allclose(one.fetch_solutions()[0],
+                               ref.fetch_solutions()[0], rtol=1e-7)
+    with pytest.raises(ValueError, match="not both"):
+        solver.solve_chain(g[None], f0=np.ones(H.shape[1]), warm=one)
 
 
 @pytest.mark.parametrize("mesh_shape", [(4, 2), (2, 4), (1, 8)])
