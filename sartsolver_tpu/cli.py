@@ -175,10 +175,6 @@ def _validate(args) -> None:
     if args.rtm_dtype == "int8" and args.use_cpu:
         fail("Argument rtm_dtype='int8' needs the fp32 device profile; "
              "it cannot be combined with --use_cpu.")
-    if args.rtm_dtype == "int8" and args.multihost:
-        fail("Argument rtm_dtype='int8' is single-host for now (multi-host "
-             "forces the pixel-sharded layout, which cannot run the fused "
-             "sweep int8 requires).")
     if args.max_cached_frames <= 0:
         fail("Argument max_cached_frames must be positive.")
     if args.max_cached_solutions <= 0:
@@ -413,18 +409,24 @@ def main(argv: Optional[List[str]] = None) -> int:
         # ---- data model (main.cpp:70-86) ---------------------------------
         # Multi-host: each process reads and caches only its own devices'
         # pixel rows of every frame (the reference's per-rank measurement
-        # slice, image.cpp:282-321) and the solver stages the measurement
-        # sharded. The local and replicated staging paths issue different
-        # collectives, so the choice is made from the full device grid —
-        # unanimously TRUE only when EVERY process has a contiguous,
-        # non-empty range — never from this process's own range alone.
-        use_local = args.multihost and mh.all_processes_sliceable(mesh, npixel)
-        offset_pix, npix_read = (
-            mh.process_pixel_range(mesh, npixel) if use_local else (0, npixel)
+        # slice, image.cpp:282-321) — as a list of runs when its row
+        # blocks are non-contiguous — and the solver stages the
+        # measurement sharded. The local and replicated staging paths
+        # issue different collectives, so the choice is made from the full
+        # device grid (deterministic, unanimous across processes); only a
+        # degenerate process owning nothing but padding rows forces the
+        # replicated fallback.
+        use_local = args.multihost and mh.all_processes_local_capable(
+            mesh, npixel
+        )
+        pixel_runs = (
+            mh.process_pixel_runs(mesh, npixel) if use_local
+            else [(0, npixel)]
         )
         composite_image = CompositeImage(
             sorted_image_files, rtm_frame_masks, time_intervals,
-            npix_read, offset_pix, max_cache_size=args.max_cached_frames,
+            npixel, max_cache_size=args.max_cached_frames,
+            pixel_runs=pixel_runs,
         )
 
         # Striped chunked ingest on every path (the reference's per-rank

@@ -38,13 +38,22 @@ class CompositeImage:
         npixel: int,
         offset_pixel: int = 0,
         max_cache_size: int = 100,
+        pixel_runs: Optional[Sequence[Tuple[int, int]]] = None,
     ):
-        if npixel == 0:
+        """``(npixel, offset_pixel)`` selects one contiguous pixel range
+        (the reference's per-rank slice, image.cpp:282-321); ``pixel_runs``
+        — a list of disjoint increasing ``(offset, count)`` runs —
+        generalizes it for processes whose device row blocks are not
+        contiguous: emitted frames are the concatenation of the runs, and
+        nothing outside them is read or cached."""
+        if pixel_runs is None:
+            pixel_runs = [(offset_pixel, npixel)]
+        self.runs = [(int(o), int(c)) for o, c in pixel_runs if c > 0]
+        if not self.runs:
             raise ValueError("Argument npixel must be positive.")
         self.files = dict(image_files)
         self.rtm_frame_masks = {k: np.asarray(v).ravel() for k, v in rtm_frame_masks.items()}
-        self.npix = npixel
-        self.offset_pix = offset_pixel
+        self.npix = sum(c for _, c in self.runs)
         self.max_cache_size = max_cache_size
         self.cache_offset = 0
         self._cached_frames: Optional[np.ndarray] = None  # [n_cached, npix]
@@ -205,37 +214,43 @@ class CompositeImage:
     def _cache_hdf5(self, itime: int) -> None:
         """Fill the block cache starting at composite frame ``itime``
         (image.cpp:268-331): per overlapping camera, hyperslab-read each
-        needed frame, compress via the RTM frame mask, slice our pixel range.
+        needed frame ONCE, compress via the RTM frame mask, and scatter it
+        into the pixel runs this instance serves (a contiguous range is
+        the one-run case).
         """
         cache_size_t = min(self.max_cache_size, len(self.time) - itime)
         cached = np.zeros((cache_size_t, self.npix))
+        last_needed = max(off + cnt for off, cnt in self.runs)
 
         start_pixel = 0
         for icam, (camera, mask) in enumerate(self.rtm_frame_masks.items()):
             npixel_masked = int(np.sum(mask != 0))
-            if self.offset_pix < start_pixel + npixel_masked:
-                mask_indices = np.nonzero(mask != 0)[0].astype(np.int64)
-                ipix_begin = max(self.offset_pix - start_pixel, 0)
-                ipix_end = (
-                    npixel_masked
-                    if self.offset_pix + self.npix > start_pixel + npixel_masked
-                    else self.offset_pix + self.npix - start_pixel
-                )
-                pix_offset = (
-                    0 if self.offset_pix > start_pixel else start_pixel - self.offset_pix
-                )
-                # this block's slice of this camera's masked pixels
-                slice_indices = mask_indices[ipix_begin:ipix_end]
+            cam_end = start_pixel + npixel_masked
+            # (buffer offset, this camera's masked-pixel indices) per run
+            # overlapping this camera's global pixel range
+            needs = []
+            mask_indices = None
+            buf_pos = 0
+            for off, cnt in self.runs:
+                lo, hi = max(off, start_pixel), min(off + cnt, cam_end)
+                if hi > lo:
+                    if mask_indices is None:
+                        mask_indices = np.nonzero(mask != 0)[0].astype(np.int64)
+                    needs.append((
+                        buf_pos + (lo - off),
+                        mask_indices[lo - start_pixel:hi - start_pixel],
+                    ))
+                buf_pos += cnt
+            if needs:
                 with h5py.File(self.files[camera], "r") as f:
                     dset = f["image/frame"]
                     for it in range(cache_size_t):
                         frame_idx = self.frame_indices[itime + it][icam]
                         full = np.asarray(dset[frame_idx], np.float64).ravel()
-                        cached[it, pix_offset:pix_offset + len(slice_indices)] = (
-                            full[slice_indices]
-                        )
-            start_pixel += npixel_masked
-            if self.offset_pix + self.npix < start_pixel:
+                        for buf_lo, sl in needs:
+                            cached[it, buf_lo:buf_lo + len(sl)] = full[sl]
+            start_pixel = cam_end
+            if last_needed <= start_pixel:
                 break
 
         self._cached_frames = cached

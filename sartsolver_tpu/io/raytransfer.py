@@ -1,13 +1,30 @@
 """Ray-transfer-matrix block reader.
 
-Loads one pixel row block ``[npixel_local, nvoxel]`` of the global RTM from
-the per-camera, per-segment file layout, matching the reference's
+Loads one (row, column) block of the global RTM from the per-camera,
+per-segment file layout, matching the reference's
 ``RayTransferMatrix::read_hdf5`` (raytransfer.cpp:27-127):
 
 - cameras (sorted order) advance the global *pixel* offset,
 - segments within a camera advance the global *voxel* offset,
 - sparse segments are COO scattered into the dense block,
-- dense segments are hyperslab-read only for rows in this block's range.
+- dense segments are hyperslab-read only for rows/columns in range.
+
+Beyond the reference's row-block-only read (its one distribution axis,
+raytransfer.cpp:49):
+
+- **Column-range reads** (``offset_voxel``/``nvoxel_local``) let a
+  voxel-sharded (column-striped) ingest read only the columns a process
+  owns — per-host I/O proportional to its share on voxel-major meshes.
+- **One-pass sparse segments**: the reference scatters each sparse segment
+  in one pass over its triplets (raytransfer.cpp:67-91). The chunked
+  striped ingest calls this reader once per row chunk; without indexing
+  that re-reads the segment's full ``pixel_index``/``voxel_index``/
+  ``value`` arrays every chunk — O(nnz x n_chunks) I/O. Passing a
+  ``sparse_cache`` dict reads each segment ONCE (filtered to the
+  caller's row/column window, sorted by pixel), after which every chunk
+  slices it via ``searchsorted`` — O(nnz + chunks) total. A byte budget
+  (``SART_SPARSE_CACHE_MB``, default 1024) guards host memory: segments
+  over budget fall back to per-chunk re-reads.
 
 The reference's two read modes (``--parallel_read`` vs barrier-serialized,
 main.cpp:78-86) are an HDD-era MPI concern; here each host process reads its
@@ -16,12 +33,104 @@ own stripes directly (single process reads everything).
 
 from __future__ import annotations
 
-from typing import Dict, List
+import os
+from typing import Dict, List, Optional, Tuple
 
 import h5py
 import numpy as np
 
 from sartsolver_tpu.config import SartInputError
+
+# Cumulative payload bytes pulled from HDF5 by this process (dense hyperslab
+# data + sparse triplet arrays, counted once at read time — cached sparse
+# slices add nothing). Introspection hook for ingest tests/diagnostics.
+READ_STATS = {"data_bytes": 0}
+
+
+def _sparse_budget_bytes() -> int:
+    try:
+        return int(os.environ.get("SART_SPARSE_CACHE_MB", 1024)) << 20
+    except ValueError:
+        return 1024 << 20
+
+
+def _load_sparse_segment(
+    group, filename: str, start_pixel: int, start_voxel: int, nvoxel: int,
+    dtype,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Read a sparse segment's triplets (global indices), bounds-checked."""
+    pixel_index = np.asarray(group["pixel_index"], np.int64) + start_pixel
+    voxel_index = np.asarray(group["voxel_index"], np.int64) + start_voxel
+    value = np.asarray(group["value"], dtype)
+    READ_STATS["data_bytes"] += (
+        pixel_index.nbytes + voxel_index.nbytes + value.nbytes
+    )
+    if voxel_index.size and (
+        int(voxel_index.max()) >= nvoxel or int(voxel_index.min()) < 0
+    ):
+        raise SartInputError(
+            f"Sparse RTM segment {filename} has voxel "
+            f"indices outside [0, {nvoxel})."
+        )
+    return pixel_index, voxel_index, value
+
+
+def _sparse_segment_window(
+    group, filename: str, start_pixel: int, start_voxel: int, nvoxel: int,
+    dtype,
+    sparse_cache: Optional[dict],
+    cache_rows: Optional[Tuple[int, int]],
+    cache_cols: Optional[Tuple[int, int]],
+):
+    """Triplets of one sparse segment, via the one-pass cache when enabled.
+
+    Cache entries hold the segment's triplets filtered to the caller's
+    row/column window and sorted by global pixel index; ``None`` marks a
+    segment that exceeded the byte budget (per-call re-reads).
+    """
+    if sparse_cache is None:
+        return _load_sparse_segment(
+            group, filename, start_pixel, start_voxel, nvoxel, dtype
+        ), False
+    key = (filename, start_pixel, start_voxel)
+    if key not in sparse_cache:
+        pix, vox, val = _load_sparse_segment(
+            group, filename, start_pixel, start_voxel, nvoxel, dtype
+        )
+        if cache_rows is not None:
+            sel = (pix >= cache_rows[0]) & (pix < cache_rows[1])
+            pix, vox, val = pix[sel], vox[sel], val[sel]
+        if cache_cols is not None:
+            sel = (vox >= cache_cols[0]) & (vox < cache_cols[1])
+            pix, vox, val = pix[sel], vox[sel], val[sel]
+        used = sum(
+            arr.nbytes
+            for entry in sparse_cache.values() if entry is not None
+            for arr in entry[:3]  # only the triplet arrays carry bytes
+        )
+        if pix.nbytes + vox.nbytes + val.nbytes + used > _sparse_budget_bytes():
+            sparse_cache[key] = None  # over budget: re-read per chunk
+            # ...but THIS call already has the (filtered) triplets — use
+            # them instead of an immediate duplicate HDF5 read; the
+            # unsorted path applies the full row/col masks, which the
+            # window prefilter only tightens
+            return (pix, vox, val), False
+        order = np.argsort(pix, kind="stable")
+        sparse_cache[key] = (
+            pix[order], vox[order], val[order], cache_rows, cache_cols
+        )
+    entry = sparse_cache[key]
+    if entry is not None:
+        pix, vox, val, rows_win, cols_win = entry
+        # a request outside the cached window must bypass the cache (it
+        # would silently come back empty); callers pass consistent windows
+        rows_ok = rows_win is None or cache_rows == rows_win
+        cols_ok = cols_win is None or cache_cols == cols_win
+        if rows_ok and cols_ok:
+            return (pix, vox, val), True
+    return _load_sparse_segment(
+        group, filename, start_pixel, start_voxel, nvoxel, dtype
+    ), False
 
 
 def read_rtm_block(
@@ -33,8 +142,20 @@ def read_rtm_block(
     *,
     dtype=np.float32,
     scatter_coo=None,
+    offset_voxel: int = 0,
+    nvoxel_local: Optional[int] = None,
+    sparse_cache: Optional[dict] = None,
+    cache_rows: Optional[Tuple[int, int]] = None,
+    cache_cols: Optional[Tuple[int, int]] = None,
 ) -> np.ndarray:
-    """Read rows [offset_pixel, offset_pixel + npixel_local) of the global RTM.
+    """Read rows ``[offset_pixel, offset_pixel + npixel_local)`` x columns
+    ``[offset_voxel, offset_voxel + nvoxel_local)`` of the global RTM.
+
+    ``nvoxel`` is the GLOBAL voxel count (bounds checks + segment layout);
+    ``nvoxel_local=None`` reads the full width. ``sparse_cache`` (a dict
+    owned by the caller, shared across chunked calls) enables the one-pass
+    sparse path; ``cache_rows``/``cache_cols`` bound what it retains — pass
+    the caller's full row/column window.
 
     ``scatter_coo(mat, rows, cols, vals)`` may be supplied to override the
     sparse scatter; by default the native C++ helper is used when the
@@ -42,11 +163,13 @@ def read_rtm_block(
     otherwise. Triplets are bounds-checked here either way — the native
     store loop is unchecked by design.
     """
-    if npixel_local <= 0 or nvoxel <= 0:
+    ncols = nvoxel - offset_voxel if nvoxel_local is None else nvoxel_local
+    if npixel_local <= 0 or ncols <= 0 or nvoxel <= 0:
         raise ValueError("To read a ray-transfer block, its size must be non-zero.")
 
-    mat = np.zeros((npixel_local, nvoxel), dtype=dtype)
+    mat = np.zeros((npixel_local, ncols), dtype=dtype)
     last_pixel = offset_pixel + npixel_local
+    last_voxel = offset_voxel + ncols
 
     start_pixel = 0
     for camera, filenames in sorted_matrix_files.items():
@@ -59,22 +182,33 @@ def read_rtm_block(
                 with h5py.File(filename, "r") as f:
                     rtm_group = f["rtm"]
                     nvoxel_data = int(rtm_group.attrs["nvoxel"])
+                    if (start_voxel + nvoxel_data <= offset_voxel
+                            or start_voxel >= last_voxel):
+                        start_voxel += nvoxel_data
+                        continue  # segment entirely outside the col window
                     group = rtm_group[rtm_name]
                     is_sparse = int(group.attrs["is_sparse"])
 
                     if is_sparse:
-                        pixel_index = np.asarray(group["pixel_index"], np.int64) + start_pixel
-                        voxel_index = np.asarray(group["voxel_index"], np.int64) + start_voxel
-                        value = np.asarray(group["value"], dtype)
-                        sel = (pixel_index >= offset_pixel) & (pixel_index < last_pixel)
-                        rows = pixel_index[sel] - offset_pixel
-                        cols = voxel_index[sel]
-                        vals = value[sel]
-                        if cols.size and (int(cols.max()) >= nvoxel or int(cols.min()) < 0):
-                            raise SartInputError(
-                                f"Sparse RTM segment {filename} has voxel "
-                                f"indices outside [0, {nvoxel})."
+                        (pix, vox, val), presorted = _sparse_segment_window(
+                            group, filename, start_pixel, start_voxel,
+                            nvoxel, dtype, sparse_cache, cache_rows,
+                            cache_cols,
+                        )
+                        if presorted:
+                            lo, hi = np.searchsorted(
+                                pix, [offset_pixel, last_pixel]
                             )
+                            pix, vox, val = pix[lo:hi], vox[lo:hi], val[lo:hi]
+                            sel = (vox >= offset_voxel) & (vox < last_voxel)
+                        else:
+                            sel = (
+                                (pix >= offset_pixel) & (pix < last_pixel)
+                                & (vox >= offset_voxel) & (vox < last_voxel)
+                            )
+                        rows = pix[sel] - offset_pixel
+                        cols = vox[sel] - offset_voxel
+                        vals = val[sel]
                         if scatter_coo is None:
                             from sartsolver_tpu.native import scatter_coo
                         scatter_coo(mat, rows, cols, vals)
@@ -84,13 +218,17 @@ def read_rtm_block(
                         ipix_begin = max(offset_pixel - start_pixel, 0)
                         ipix_end = min(npixel_data, offset_pixel + npixel_local - start_pixel)
                         pix_offset = 0 if offset_pixel > start_pixel else start_pixel - offset_pixel
-                        if ipix_end > ipix_begin:
+                        # columns of this segment inside our window
+                        col_lo = max(offset_voxel - start_voxel, 0)
+                        col_hi = min(nvoxel_data, last_voxel - start_voxel)
+                        if ipix_end > ipix_begin and col_hi > col_lo:
                             out_rows = slice(
                                 pix_offset, pix_offset + (ipix_end - ipix_begin)
                             )
-                            mat[out_rows, start_voxel:start_voxel + nvoxel_data] = dset[
-                                ipix_begin:ipix_end, :
-                            ]
+                            out_col = start_voxel + col_lo - offset_voxel
+                            piece = dset[ipix_begin:ipix_end, col_lo:col_hi]
+                            READ_STATS["data_bytes"] += piece.nbytes
+                            mat[out_rows, out_col:out_col + (col_hi - col_lo)] = piece
 
                 start_voxel += nvoxel_data
 

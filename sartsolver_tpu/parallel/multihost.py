@@ -113,26 +113,54 @@ def read_and_quantize_rtm(
     staged fp32 matrix on device, a matrix that only *fits* as int8 can be
     loaded this way (the 4x capacity headroom is real, at the cost of
     reading the HDF5 bytes twice). Matches the int8 quantization recipe of
-    ``models.sart.quantize_rtm``. Single-process only (the per-column
-    scales would need a cross-process max; multi-host runs are
-    pixel-sharded, which int8 cannot use anyway).
+    ``models.sart.quantize_rtm``.
+
+    Multi-process runs need a voxel-major mesh (pixel axis unsharded) —
+    which int8 requires anyway for the fused sweep: each process then owns
+    *complete* columns, so its per-column maxima (pass 1, read over its own
+    column range only) are already global and no cross-process reduction is
+    needed.
     """
-    if jax.process_count() > 1:
-        raise ValueError("int8 RTM ingest is single-process only.")
+    from sartsolver_tpu.config import SartInputError
+
+    n_pix = mesh.shape.get(PIXEL_AXIS, 1)
+    if jax.process_count() > 1 and n_pix > 1:
+        # reachable from CLI flags (--rtm_dtype int8 --multihost
+        # --pixel_shards N) -> the polite message + exit(1) contract
+        raise SartInputError(
+            "rtm_dtype='int8' across processes needs a voxel-major mesh "
+            "(pixel axis unsharded) so per-column maxima stay process-"
+            "local; use --voxel_shards N (pixels=1) or fp32/bfloat16 "
+            "storage."
+        )
     chunk = chunk_rows or int(os.environ.get(
         "SART_INGEST_CHUNK_ROWS", max(ROW_ALIGN, (256 << 20) // max(nvoxel * 4, 1))
     ))
-    colmax = np.zeros(nvoxel, np.float32)
-    for r0 in range(0, npixel, chunk):
-        n = min(chunk, npixel - r0)
-        stripe = read_rtm_block(
-            sorted_matrix_files, rtm_name, n, nvoxel, r0, dtype=np.float32,
-        )
-        np.maximum(colmax, np.abs(stripe).max(axis=0), out=colmax)
     n_vox = mesh.shape.get(VOXEL_AXIS, 1)
     padded_cols = padded_size(nvoxel, n_vox * COL_ALIGN)
+    col_block = padded_cols // n_vox
+    # this process's column bounding range (full width single-process)
+    my_j = sorted({
+        int(j) for (_i, j), dev in np.ndenumerate(_device_grid(mesh))
+        if dev.process_index == jax.process_index()
+    })
+    c_lo = my_j[0] * col_block if my_j else 0
+    c_hi = min((my_j[-1] + 1) * col_block, nvoxel) if my_j else 0
+    sparse_cache: dict = {}
     scale_np = np.ones(padded_cols, np.float32)
-    scale_np[:nvoxel] = np.where(colmax > 0, colmax / 127.0, 1.0)
+    if c_hi > c_lo:
+        colmax = np.zeros(c_hi - c_lo, np.float32)
+        for r0 in range(0, npixel, chunk):
+            n = min(chunk, npixel - r0)
+            stripe = read_rtm_block(
+                sorted_matrix_files, rtm_name, n, nvoxel, r0,
+                dtype=np.float32,
+                offset_voxel=c_lo, nvoxel_local=c_hi - c_lo,
+                sparse_cache=sparse_cache,
+                cache_rows=(0, npixel), cache_cols=(c_lo, c_hi),
+            )
+            np.maximum(colmax, np.abs(stripe).max(axis=0), out=colmax)
+        scale_np[c_lo:c_hi] = np.where(colmax > 0, colmax / 127.0, 1.0)
 
     def quantize_chunk(stripe: np.ndarray, col0: int) -> np.ndarray:
         s = scale_np[col0:col0 + stripe.shape[1]]
@@ -144,9 +172,11 @@ def read_and_quantize_rtm(
         sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
         dtype="int8", chunk_rows=chunk, _quantize_chunk=quantize_chunk,
     )
-    scale = jax.device_put(
-        scale_np,
-        NamedSharding(mesh, P(VOXEL_AXIS if VOXEL_AXIS in mesh.shape else None)),
+    # make_global: each process supplies only its own (addressable) column
+    # shards — scale_np holds real values exactly there
+    scale = make_global(
+        scale_np, mesh,
+        P(VOXEL_AXIS if VOXEL_AXIS in mesh.shape else None),
     )
     return codes, scale
 
@@ -210,6 +240,25 @@ def read_and_shard_rtm(
         if dev.process_index == jax.process_index():
             mine.setdefault(int(i), []).append((int(j), dev))
 
+    # Column-striped reads: each row stripe is read only over the bounding
+    # column range of this process's own column blocks, so on a voxel-major
+    # mesh per-host I/O is proportional to its columns (a pixel-major mesh
+    # degenerates to the full width — the reference's per-rank row read,
+    # raytransfer.cpp:49). Non-adjacent column blocks in one row group read
+    # their bounding range (over-read bounded by the gap).
+    row_span = (
+        (min(mine) * row_block, min((max(mine) + 1) * row_block, npixel))
+        if mine else (0, 0)
+    )
+    all_j = sorted({j for cols in mine.values() for j, _ in cols})
+    col_span = (
+        (all_j[0] * col_block, min((all_j[-1] + 1) * col_block, nvoxel))
+        if all_j else (0, 0)
+    )
+    # one-pass sparse segments: triplets read once per segment into this
+    # window, sliced per chunk (io/raytransfer.py docstring; VERDICT r2 #4)
+    sparse_cache: dict = {}
+
     @functools.partial(jax.jit, donate_argnums=0)
     def _scatter(buf, piece, row_start):
         return jax.lax.dynamic_update_slice(
@@ -231,12 +280,20 @@ def read_and_shard_rtm(
                 )()
                 for j, dev in sorted(cols)
             }
+            js = sorted(j for j, _ in cols)
+            c_lo = js[0] * col_block
+            c_hi = min((js[-1] + 1) * col_block, nvoxel)
             for cs in range(0, rows_have, chunk_rows):
                 n = min(chunk_rows, rows_have - cs)
-                stripe = read_rtm_block(
-                    sorted_matrix_files, rtm_name, n, nvoxel, r0 + cs,
-                    dtype=np.float32,
-                )
+                stripe = None
+                if c_hi > c_lo:
+                    stripe = read_rtm_block(
+                        sorted_matrix_files, rtm_name, n, nvoxel, r0 + cs,
+                        dtype=np.float32,
+                        offset_voxel=c_lo, nvoxel_local=c_hi - c_lo,
+                        sparse_cache=sparse_cache,
+                        cache_rows=row_span, cache_cols=col_span,
+                    )
                 # fixed piece height (except one trailing shape) keeps the
                 # jitted scatter at <= 2 compiled variants
                 n_write = min(chunk_rows, row_block - cs)
@@ -245,8 +302,8 @@ def read_and_shard_rtm(
                     cols_have = max(0, min(nvoxel - c0, col_block))
                     piece_np = np.int8 if _quantize_chunk is not None else np.float32
                     piece = np.zeros((n_write, col_block), piece_np)
-                    if cols_have > 0:
-                        sl = stripe[:, c0:c0 + cols_have]
+                    if cols_have > 0 and stripe is not None:
+                        sl = stripe[:, c0 - c_lo:c0 - c_lo + cols_have]
                         piece[:n, :cols_have] = (
                             _quantize_chunk(sl, c0) if _quantize_chunk else sl
                         )
@@ -300,15 +357,43 @@ def process_pixel_range(mesh, npixel: int):
     return (start, stop - start)
 
 
-def all_processes_sliceable(mesh, npixel: int) -> bool:
-    """True iff EVERY process has a contiguous, non-empty pixel range.
+def process_pixel_runs(mesh, npixel: int):
+    """This process's pixel rows as a list of contiguous ``(offset, count)``
+    runs (adjacent row blocks merged, clipped to ``npixel``, empty runs
+    dropped). The general form of :func:`process_pixel_range` for
+    non-contiguous device layouts: each host reads and stages exactly the
+    union of its own row blocks — never full frames (VERDICT r2 #8)."""
+    n_pix = mesh.shape.get(PIXEL_AXIS, 1)
+    padded_rows = padded_size(npixel, n_pix * ROW_ALIGN)
+    row_block = padded_rows // n_pix
+    blocks = sorted({
+        int(i)
+        for (i, _j), dev in np.ndenumerate(_device_grid(mesh))
+        if dev.process_index == jax.process_index()
+    })
+    runs = []
+    for b in blocks:
+        start = min(b * row_block, npixel)
+        stop = min((b + 1) * row_block, npixel)
+        if stop <= start:
+            continue
+        if runs and runs[-1][0] + runs[-1][1] == start:
+            runs[-1] = (runs[-1][0], runs[-1][1] + (stop - start))
+        else:
+            runs.append((start, stop - start))
+    return runs
 
-    Deterministic in (mesh, npixel) — every process sees the full device
-    grid, so all processes compute the same answer with no communication.
-    This is the gate for per-process measurement slicing: the local and
-    replicated staging paths issue different collectives, so the choice
-    must be unanimous or the multihost run desynchronizes.
-    """
+
+def all_processes_local_capable(mesh, npixel: int) -> bool:
+    """True iff EVERY process owns at least one logical pixel row —
+    the gate for per-process (multi-run) measurement slicing.
+
+    Deterministic in (mesh, npixel): every process sees the full device
+    grid, so the answer is unanimous with no communication (the local and
+    replicated staging paths issue different collectives). A process whose
+    blocks are all padding has nothing to read locally and would still
+    need the global measurement scalars — such degenerate layouts fall
+    back to replicated staging."""
     n_pix = mesh.shape.get(PIXEL_AXIS, 1)
     padded_rows = padded_size(npixel, n_pix * ROW_ALIGN)
     row_block = padded_rows // n_pix
@@ -316,13 +401,8 @@ def all_processes_sliceable(mesh, npixel: int) -> bool:
     for (i, _j), dev in np.ndenumerate(_device_grid(mesh)):
         blocks_by_proc.setdefault(dev.process_index, []).append(int(i))
     for blocks in blocks_by_proc.values():
-        blocks = sorted(set(blocks))
-        if blocks != list(range(blocks[0], blocks[0] + len(blocks))):
+        if not any(b * row_block < npixel for b in blocks):
             return False
-        start = min(blocks[0] * row_block, npixel)
-        stop = min((blocks[-1] + 1) * row_block, npixel)
-        if stop - start <= 0:
-            return False  # a process owning only padding rows
     return True
 
 

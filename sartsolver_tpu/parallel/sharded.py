@@ -444,19 +444,29 @@ class DistributedSARTSolver:
 
         return process_pixel_range(self.mesh, self.npixel)
 
+    def local_pixel_runs(self):
+        """See :func:`multihost.process_pixel_runs` — the general
+        (possibly non-contiguous) form of :meth:`local_pixel_range`;
+        ``local`` measurements are the concatenation of these runs."""
+        from sartsolver_tpu.parallel.multihost import process_pixel_runs
+
+        return process_pixel_runs(self.mesh, self.npixel)
+
     def _stage_measurement_local(self, G: np.ndarray, norms: np.ndarray,
                                  dtype) -> jax.Array:
         """Per-device staging of process-local measurement slices.
 
-        ``G`` holds only this process's pixel rows (``local_pixel_range``).
-        Each device gets its padded row block directly (padding rows are -1
-        = saturated, excluded everywhere, Eq. 6); the global array is
-        assembled sharded ``P(None, 'pixels')`` with no replicated
-        [B, padded_npixel] host copy (the reference's per-rank measurement
-        slice, image.cpp:282-321)."""
+        ``G`` holds only this process's pixel rows — the concatenation of
+        its ``local_pixel_runs`` (one contiguous slice in the common
+        case). Each device gets its padded row block directly (padding
+        rows are -1 = saturated, excluded everywhere, Eq. 6); the global
+        array is assembled sharded ``P(None, 'pixels')`` with no
+        replicated [B, padded_npixel] host copy (the reference's per-rank
+        measurement slice, image.cpp:282-321)."""
         from sartsolver_tpu.parallel.multihost import _device_grid
 
-        off0, _cnt = self.local_pixel_range()
+        runs = self.local_pixel_runs()
+        starts = np.cumsum([0] + [cnt for _, cnt in runs])
         B = G.shape[0]
         rb = self.padded_npixel // self.n_pixel_shards
         arrays = []
@@ -467,7 +477,20 @@ class DistributedSARTSolver:
             block = np.full((B, rb), -1.0, dtype)
             n_log = max(0, min(self.npixel - r0, rb))
             if n_log > 0:
-                block[:, :n_log] = G[:, r0 - off0:r0 - off0 + n_log] / norms[:, None]
+                # locate this device block inside the run buffer; a block
+                # with logical rows always starts inside one run (runs are
+                # unions of whole blocks clipped at npixel) and its logical
+                # rows never extend past that run's end
+                for (off, cnt), s in zip(runs, starts):
+                    if off <= r0 < off + cnt:
+                        pos = int(s) + (r0 - off)
+                        block[:, :n_log] = G[:, pos:pos + n_log] / norms[:, None]
+                        break
+                else:
+                    raise AssertionError(
+                        f"device row block at {r0} not covered by local "
+                        f"pixel runs {runs}"
+                    )
             arrays.append(jax.device_put(block, dev))
         return jax.make_array_from_single_device_arrays(
             (B, self.padded_npixel),
@@ -478,13 +501,14 @@ class DistributedSARTSolver:
     def _check_frames(self, measurements, local: bool) -> np.ndarray:
         G = np.asarray(measurements, np.float64)
         if local:
-            rng = self.local_pixel_range()
-            if rng is None:
+            runs = self.local_pixel_runs()
+            if not runs:
                 raise ValueError(
-                    "local measurement staging needs this process's row "
-                    "blocks to be contiguous; pass full frames instead."
+                    "local measurement staging needs this process to own "
+                    "at least one logical pixel row; pass full frames "
+                    "instead."
                 )
-            expected = rng[1]
+            expected = sum(cnt for _, cnt in runs)
         else:
             expected = self.npixel
         if G.ndim != 2 or G.shape[1] != expected:
@@ -676,8 +700,7 @@ class DistributedSARTSolver:
     def solve(self, measurement, f0=None, *, local: bool = False) -> SolveResult:
         """Solve one frame — the B=1 case of :meth:`solve_batch`."""
         if local:
-            rng = self.local_pixel_range()
-            expected = rng[1] if rng is not None else np.shape(measurement)[0]
+            expected = sum(cnt for _, cnt in self.local_pixel_runs())
         else:
             expected = self.npixel
         if np.shape(measurement)[0] != expected:

@@ -31,10 +31,23 @@ def main() -> int:
 
     from sartsolver_tpu.cli import main as cli_main
 
-    return cli_main([
-        "-o", outfile, *inputs, "--use_cpu", "-m", "100", "-c", "1e-8",
+    # "--no_default_profile" marker: drop --use_cpu so extras can select
+    # device-profile-only features (e.g. --rtm_dtype int8)
+    extra = list(extra)
+    profile = ["--use_cpu", "-c", "1e-8"]
+    if "--no_default_profile" in extra:
+        extra.remove("--no_default_profile")
+        profile = []
+    rc = cli_main([
+        "-o", outfile, *inputs, "-m", "100", *profile,
         "--multihost", *extra,
     ])
+    # ingest byte accounting for the column-striping test (per-host I/O
+    # must be proportional to its share of the matrix)
+    from sartsolver_tpu.io.raytransfer import READ_STATS
+
+    print(f"INGEST_DATA_BYTES={READ_STATS['data_bytes']}", flush=True)
+    return rc
 
 
 if __name__ == "__main__":

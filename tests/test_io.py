@@ -132,6 +132,104 @@ class TestRTMBlockReader:
         ])
         np.testing.assert_allclose(rebuilt, H, rtol=1e-6)
 
+    def test_column_range_blocks_tile_the_matrix(self, world):
+        """(row, column)-block reads reassemble to the full matrix —
+        column striping is what lets a voxel-major multi-host mesh read
+        only its own columns (round 3). The column cuts deliberately
+        straddle the dense/sparse segment boundary and skip segments."""
+        paths, H, *_ = world
+        m, _ = hf.categorize_input_files(all_input_files(paths))
+        sm = hf.sort_rtm_files(m)
+        row_cuts = [(0, 5), (5, fx.NPIXEL - 5)]
+        col_cuts = [(0, 3), (3, 7), (10, fx.NVOXEL - 10)]
+        for r0, nr in row_cuts:
+            for c0, nc in col_cuts:
+                block = read_rtm_block(
+                    sm, "with_reflections", nr, fx.NVOXEL, r0,
+                    offset_voxel=c0, nvoxel_local=nc,
+                )
+                np.testing.assert_allclose(
+                    block, H[r0:r0 + nr, c0:c0 + nc], rtol=1e-6,
+                    err_msg=f"rows {r0}+{nr}, cols {c0}+{nc}",
+                )
+
+    def test_one_pass_sparse_cache(self, world):
+        """With a sparse_cache, chunked row reads load each sparse
+        segment's triplet arrays ONCE (O(nnz + chunks) I/O, the
+        reference's one-pass scatter, raytransfer.cpp:67-91) instead of
+        per chunk — asserted via READ_STATS byte accounting — and produce
+        identical blocks."""
+        from sartsolver_tpu.io import raytransfer as rt
+
+        paths, H, *_ = world
+        m, _ = hf.categorize_input_files(all_input_files(paths))
+        sm = hf.sort_rtm_files(m)
+        chunks = [(i, 2) for i in range(0, fx.NPIXEL, 2)]
+
+        def run(cache):
+            rt.READ_STATS["data_bytes"] = 0
+            blocks = [
+                read_rtm_block(sm, "with_reflections", n, fx.NVOXEL, off,
+                               sparse_cache=cache,
+                               cache_rows=(0, fx.NPIXEL) if cache is not None
+                               else None)
+                for off, n in chunks
+            ]
+            return np.concatenate(blocks), rt.READ_STATS["data_bytes"]
+
+        got_plain, bytes_plain = run(None)
+        got_cached, bytes_cached = run({})
+        np.testing.assert_allclose(got_cached, got_plain, rtol=0)
+        np.testing.assert_allclose(got_cached, H, rtol=1e-6)
+        # the sparse segment's nnz-sized arrays were pulled once, not once
+        # per touching chunk (dense hyperslab bytes are identical in both
+        # runs, so the delta is exactly the avoided triplet re-reads)
+        assert bytes_cached < bytes_plain
+        H_a = fx.make_rtm_matrices(0)[0]
+        n_touch = sum(1 for off, _n in chunks if off < H_a.shape[0])
+        nnz = np.count_nonzero(H_a[:, 8:])
+        triplet_bytes = nnz * (8 + 8 + 4)
+        assert bytes_plain - bytes_cached == (n_touch - 1) * triplet_bytes
+
+    def test_sparse_cache_two_segments(self, tmp_path):
+        """Two sparse segments through ONE cache (regression: the byte-
+        budget scan must skip the cached window metadata; with >= 2
+        sparse segments it used to crash on the second)."""
+        rng = np.random.default_rng(5)
+        npix, half = 8, 8
+        H = rng.uniform(0.1, 1.0, (npix, 2 * half)).astype(np.float32)
+        H *= rng.random(H.shape) < 0.6
+        cells = np.arange(2 * half, dtype=np.int64)
+        mask = np.ones((2, 4), np.int64)
+        p1 = str(tmp_path / "s1.h5")
+        p2 = str(tmp_path / "s2.h5")
+        fx._write_rtm_file(p1, "camX", mask, H[:, :half], cells[:half],
+                           cells[:half], sparse=True)
+        fx._write_rtm_file(p2, "camX", mask, H[:, half:], cells[half:],
+                           cells[:half], sparse=True)
+        sm = hf.sort_rtm_files([p1, p2])
+        cache = {}
+        blocks = [
+            read_rtm_block(sm, "with_reflections", 2, 2 * half, off,
+                           sparse_cache=cache, cache_rows=(0, npix))
+            for off in range(0, npix, 2)
+        ]
+        np.testing.assert_allclose(np.concatenate(blocks), H, rtol=1e-6)
+        assert len(cache) == 2  # both segments cached independently
+
+    def test_sparse_cache_budget_fallback(self, world, monkeypatch):
+        """A zero byte budget disables caching (entry None) but keeps
+        results correct via per-chunk re-reads."""
+        paths, H, *_ = world
+        m, _ = hf.categorize_input_files(all_input_files(paths))
+        sm = hf.sort_rtm_files(m)
+        monkeypatch.setenv("SART_SPARSE_CACHE_MB", "0")
+        cache = {}
+        block = read_rtm_block(sm, "with_reflections", fx.NPIXEL, fx.NVOXEL,
+                               0, sparse_cache=cache)
+        np.testing.assert_allclose(block, H, rtol=1e-6)
+        assert None in cache.values()
+
 
 class TestLaplacian:
     def test_read_and_sorted(self, world):
@@ -281,6 +379,45 @@ class TestCompositeImage:
         # threshold 0.01 < jitter 0.049 => no composite frames possible
         with pytest.raises(ValueError, match="No composite images"):
             CompositeImage(si, masks, [(0.0, 10.0, 0.1, 0.01)], fx.NPIXEL, 0)
+
+
+class TestCompositeImagePixelRuns:
+    def test_non_contiguous_runs_match_full_frame_slices(self, world):
+        """pixel_runs=[...] emits the concatenation of the full frame's
+        slices and caches only sum(counts) pixels — per-host cache memory
+        proportional to its own rows (VERDICT r2 #8)."""
+        from sartsolver_tpu.io.image import CompositeImage
+
+        paths, H, *_ = world
+        m, imgs = hf.categorize_input_files(all_input_files(paths))
+        sm = hf.sort_rtm_files(m)
+        si = hf.sort_image_files(imgs)
+        masks = hf.read_rtm_frame_masks(sm)
+
+        full = CompositeImage(si, masks, [(0.0, 1.0, 0.0, 0.0)], fx.NPIXEL)
+        runs = [(2, 4), (9, 3)]  # straddles the camera A/B boundary (8)
+        part = CompositeImage(
+            si, masks, [(0.0, 1.0, 0.0, 0.0)], fx.NPIXEL, pixel_runs=runs,
+        )
+        assert len(part) == len(full)
+        for i in range(len(full)):
+            want = np.concatenate([
+                full.frame(i)[off:off + cnt] for off, cnt in runs
+            ])
+            np.testing.assert_array_equal(part.frame(i), want)
+        # cache holds only the runs' pixels
+        assert part._cached_frames.shape[1] == sum(c for _, c in runs)
+
+    def test_empty_runs_rejected(self, world):
+        from sartsolver_tpu.io.image import CompositeImage
+
+        paths, *_ = world
+        m, imgs = hf.categorize_input_files(all_input_files(paths))
+        si = hf.sort_image_files(imgs)
+        masks = hf.read_rtm_frame_masks(hf.sort_rtm_files(m))
+        with pytest.raises(ValueError):
+            CompositeImage(si, masks, [(0.0, 1.0, 0.0, 0.0)], fx.NPIXEL,
+                           pixel_runs=[])
 
 
 class TestSolutionWriter:

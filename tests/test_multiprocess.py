@@ -4,8 +4,9 @@ The rest of the suite tests distribution on a single process with 8
 virtual devices; these tests launch two actual processes so the
 cross-process paths run for real: `jax.distributed.initialize`, the
 serialized striped ingest barrier, per-process measurement slicing
-(`all_processes_sliceable` is True here: 2 procs x 1 device, contiguous
-row blocks), process-0-only output writing, and the resume broadcast.
+(`all_processes_local_capable` is True here: 2 procs x 1 device,
+contiguous row blocks), process-0-only output writing, and the resume
+broadcast.
 
 Equivalent of the reference's `mpirun -np 2 sartsolver` against
 `-np 1` (main.cpp:63-68) — which its math assumes but never asserts.
@@ -93,6 +94,148 @@ def test_two_process_run_matches_single(world, tmp_path):
             fm["solution/status"][:], fr["solution/status"][:]
         )
         assert "voxel_map" in fm
+
+
+def _write_wide_world(tmp_path, monkeypatch, V=512, npix=16):
+    """One-camera world wide enough for 128-aligned column blocks:
+    voxels [0, V/2) dense, [V/2, V) sparse, 2 frames."""
+    monkeypatch.setattr(fx, "NX", V // 16)
+    monkeypatch.setattr(fx, "NY", 16)
+    monkeypatch.setattr(fx, "NZ", 1)
+    rng = np.random.default_rng(7)
+    mask = np.ones((4, 4), np.int64)
+    H = rng.uniform(0.1, 1.0, (npix, V)).astype(np.float32)
+    half = V // 2
+    H[:, half:] *= rng.random((npix, half)) < 0.5  # genuinely sparse half
+    cells = np.arange(V, dtype=np.int64)
+    p = {
+        "seg_dense": str(tmp_path / "wide_dense.h5"),
+        "seg_sparse": str(tmp_path / "wide_sparse.h5"),
+        "img": str(tmp_path / "wide_img.h5"),
+    }
+    fx._write_rtm_file(p["seg_dense"], "camW", mask, H[:, :half],
+                       cells[:half], cells[:half], sparse=False)
+    fx._write_rtm_file(p["seg_sparse"], "camW", mask, H[:, half:],
+                       cells[half:], cells[:half], sparse=True)
+    f_true = rng.uniform(0.5, 2.0, V)
+    times = np.array([0.1, 0.2])
+    frames = np.stack([
+        fx.frame_from_measurement(mask, H @ (f_true * s))
+        for s in (1.0, 1.2)
+    ])
+    fx._write_image_file(p["img"], "camW", frames, times)
+    return p, H, times
+
+
+def test_two_process_voxel_major_column_striped(tmp_path, monkeypatch):
+    """Voxel-major mesh across two REAL processes (VERDICT r2 next #2):
+    the column-striped ingest must (a) reproduce the single-process
+    solution, and (b) read per host only its own columns' bytes — the
+    property that makes voxel-major (and with it the fused sweep)
+    reachable beyond one host. Block 0 is the dense segment, block 1 the
+    sparse one, so the byte accounting separates exactly."""
+    p, H, times = _write_wide_world(tmp_path, monkeypatch)
+    inputs = [p["seg_dense"], p["seg_sparse"], p["img"]]
+
+    from sartsolver_tpu.cli import main
+    ref_out = str(tmp_path / "ref_vm.h5")
+    assert main([
+        "-o", ref_out, *inputs, "--use_cpu", "-m", "100", "-c", "1e-8",
+        "--pixel_shards", "1", "--voxel_shards", "1",
+    ]) == 0
+
+    mp_out = str(tmp_path / "mp_vm.h5")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "mp_worker.py"),
+             str(rank), "2", str(port), mp_out,
+             "--pixel_shards", "1", "--voxel_shards", "2", "--", *inputs],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = [pp.communicate(timeout=240)[0] for pp in procs]
+    assert all(pp.returncode == 0 for pp in procs), (
+        f"rc={[pp.returncode for pp in procs]}\n{outs[0][-2000:]}\n"
+        f"{outs[1][-2000:]}"
+    )
+
+    with h5py.File(ref_out, "r") as fr, h5py.File(mp_out, "r") as fm:
+        np.testing.assert_allclose(
+            fm["solution/value"][:], fr["solution/value"][:],
+            rtol=1e-9, atol=1e-12,
+        )
+
+    byte_counts = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines()
+                 if ln.startswith("INGEST_DATA_BYTES=")]
+        assert lines, out[-2000:]
+        byte_counts.append(int(lines[-1].split("=")[1]))
+    npix, V = H.shape
+    half = V // 2
+    # process 0 (columns [0, 256)) reads exactly the dense payload and no
+    # sparse triplets; process 1 reads only the sparse segment's triplets,
+    # once (not once per chunk)
+    nnz = np.count_nonzero(H[:, half:])
+    assert byte_counts[0] == npix * half * 4, byte_counts
+    assert byte_counts[1] == nnz * (8 + 8 + 4), (byte_counts, nnz)
+
+
+def test_two_process_int8_voxel_major(tmp_path, monkeypatch):
+    """int8 RTM storage across two REAL processes on a voxel-major mesh:
+    the two-pass quantized ingest computes per-column scales process-
+    locally (complete columns per process) and must reproduce the
+    single-process int8 solve."""
+    p, H, times = _write_wide_world(tmp_path, monkeypatch)
+    inputs = [p["seg_dense"], p["seg_sparse"], p["img"]]
+
+    from sartsolver_tpu.cli import main
+    ref_out = str(tmp_path / "ref_i8.h5")
+    assert main([
+        "-o", ref_out, *inputs, "-m", "1000",
+        "--rtm_dtype", "int8", "--fused_sweep", "interpret",
+        "--pixel_shards", "1", "--voxel_shards", "1",
+    ]) == 0
+
+    mp_out = str(tmp_path / "mp_i8.h5")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(_HERE, "mp_worker.py"),
+             str(rank), "2", str(port), mp_out, "--no_default_profile",
+             "-m", "1000",  # argparse last-wins over the worker's default
+             "--rtm_dtype", "int8", "--fused_sweep", "interpret",
+             "--pixel_shards", "1", "--voxel_shards", "2", "--", *inputs],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    outs = [pp.communicate(timeout=300)[0] for pp in procs]
+    assert all(pp.returncode == 0 for pp in procs), (
+        f"rc={[pp.returncode for pp in procs]}\n{outs[0][-2000:]}\n"
+        f"{outs[1][-2000:]}"
+    )
+    with h5py.File(ref_out, "r") as fr, h5py.File(mp_out, "r") as fm:
+        assert (fm["solution/status"][:] == 0).all()
+        assert (fr["solution/status"][:] == 0).all()
+        ref, got = fr["solution/value"][:], fm["solution/value"][:]
+        # same quantized system (process-local scales == global scales);
+        # psum ordering across shards shifts the fp32 stall iteration, so
+        # compare converged reconstructions in fitted space
+        for i in range(ref.shape[0]):
+            fit_ref, fit_got = H @ ref[i], H @ got[i]
+            rel = np.linalg.norm(fit_got - fit_ref) / np.linalg.norm(fit_ref)
+            assert rel < 0.01, (i, rel)
 
 
 def test_two_process_resume(world, tmp_path):
