@@ -36,6 +36,7 @@ All human-facing progress goes to stderr.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import subprocess
@@ -209,7 +210,7 @@ def main() -> int:
 
     from sartsolver_tpu.config import SolverOptions
     from sartsolver_tpu.models.sart import (
-        SARTProblem, _resolve_fused, compute_ray_stats, make_problem,
+        SARTProblem, _resolve_fused, compute_ray_stats,
         solve_normalized_batch,
     )
     from sartsolver_tpu.ops.laplacian import make_laplacian
@@ -247,7 +248,47 @@ def main() -> int:
     bw_gbs = _detect_hbm_bw_gbs(platform, devices[0].device_kind)
     our_bw = len(devices) * bw_gbs * 1e9
 
-    def run_config(fused_mode: str, rtm_dtype: str, B: int) -> dict:
+    # The matrix is staged to the device ONCE (fp32) and the bf16/int8
+    # variants are derived on device — through a tunneled backend each
+    # host->device upload of the 2.1 GB operand costs tens of seconds, and
+    # re-staging per config (14 configs) was what blew the round-2/3 budget,
+    # not compiles.
+    problems: dict = {}
+
+    def get_problem(rtm_dtype: str):
+        if rtm_dtype not in problems:
+            if "float32" not in problems:
+                rtm = jnp.asarray(H32, jnp.float32)
+                dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
+                problems["float32"] = SARTProblem(rtm, dens, length, None)
+            if rtm_dtype == "bfloat16":
+                base = problems["float32"]
+                problems[rtm_dtype] = SARTProblem(
+                    jax.jit(lambda r: r.astype(jnp.bfloat16))(base.rtm),
+                    base.ray_density, base.ray_length, None,
+                )
+            elif rtm_dtype == "int8":
+                from sartsolver_tpu.models.sart import (
+                    INT8_MAX_CONTRACTION, compute_ray_stats_int8,
+                    quantize_rtm,
+                )
+
+                if max(P, V) > INT8_MAX_CONTRACTION:
+                    # same guard make_problem applies: int8xint8 dots
+                    # accumulate in int32, bounding the contraction extent
+                    raise ValueError(
+                        f"int8 RTM extent {max(P, V)} exceeds the int32-"
+                        f"accumulation bound {INT8_MAX_CONTRACTION}"
+                    )
+                codes, scale = jax.jit(quantize_rtm)(problems["float32"].rtm)
+                dens, length = jax.jit(functools.partial(
+                    compute_ray_stats_int8, dtype=jnp.float32))(codes, scale)
+                problems[rtm_dtype] = SARTProblem(
+                    codes, dens, length, None, scale)
+        return problems[rtm_dtype]
+
+    def run_config(fused_mode: str, rtm_dtype: str, B: int,
+                   timed_reps: int = 3) -> dict:
         """Fixed-iteration throughput of one configuration."""
         # conv_tolerance=0 disables the stall test: quantized (int8) solves
         # can reach their fixed point bit-exactly within a few iterations,
@@ -256,13 +297,8 @@ def main() -> int:
             max_iterations=iters, conv_tolerance=0.0,
             fused_sweep=fused_mode, rtm_dtype=rtm_dtype,
         )
-        if rtm_dtype == "int8":
-            problem = make_problem(H32, None, opts=opts)
-            rtm = problem.rtm
-        else:
-            rtm = jnp.asarray(H32, dtype=jnp.dtype(rtm_dtype))
-            dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
-            problem = SARTProblem(rtm, dens, length, None)
+        problem = get_problem(rtm_dtype)
+        rtm = problem.rtm
         # trace-time fused decision, recorded so the judge can see which
         # path actually ran (VERDICT r1: "fused path confirmed selected");
         # vmem_raised=True mirrors the dispatcher, which attaches whatever
@@ -286,7 +322,7 @@ def main() -> int:
         _tick()  # compile finished: a legitimately silent long phase
         n_done = max(int(res.iterations[0]), 1)
         best = float("inf")
-        for _ in range(3):
+        for _ in range(timed_reps):
             t0 = time.perf_counter()
             res = run()
             np.asarray(res.solution)
@@ -307,8 +343,13 @@ def main() -> int:
 
     # --- throughput sweep -------------------------------------------------
     # Priority order under the time budget: fused (headline) configs, then
-    # time-to-converge (the north-star's second half), then the two-matmul
-    # reference points — a budget cut drops the least informative numbers.
+    # the batched two-matmul reference points (the fused-vs-unfused
+    # comparison at gemm shapes), then time-to-converge, then the B=1
+    # two-matmul point (a known-pathological gemv, least informative) — a
+    # budget cut drops the least informative numbers. Cold remote compiles
+    # are the real cost (30-90 s/config); the persistent compilation cache
+    # (utils/cache.py, warmed by any previous run on this machine) makes
+    # re-runs complete the whole sweep in minutes.
     sweep: list = []
     fused_possible = jax.default_backend() == "tpu"
     if on_accel and not quick:
@@ -325,24 +366,27 @@ def main() -> int:
             primary.append(("auto", "int8", 32))
         secondary = [
             ("off", dt, B)
-            for B in (1, 8, 32)
+            for B in (8, 32)
             for dt in ("bfloat16", "float32")
+        ] if fused_possible else []
+        tertiary = [
+            ("off", dt, 1) for dt in ("bfloat16", "float32")
         ] if fused_possible else []
     elif fused_possible:
         primary = [("auto", "float32", 1), ("off", "float32", 1)]
-        secondary = []
+        secondary = tertiary = []
     else:  # 'auto' resolves to unfused off-TPU — don't time it twice
         primary = [("off", "float32", 1)]
-        secondary = []
+        secondary = tertiary = []
 
-    def run_sweep_configs(configs, budget):
+    def run_sweep_configs(configs, budget, timed_reps=3):
         for fm, dt, B in configs:
             if time.perf_counter() - t_start > budget and sweep:
                 _log(f"budget {budget:.0f}s exhausted; "
                      "skipping remaining configs")
                 return
             try:
-                r = run_config(fm, dt, B)
+                r = run_config(fm, dt, B, timed_reps=timed_reps)
                 _log(f"  config fused={fm} rtm={dt} B={B}: "
                      f"{r['loop_iter_s']} loop-iter/s, {r['frame_iter_s']} "
                      f"frame-iter/s, hbm_frac={r['hbm_frac']}")
@@ -354,17 +398,20 @@ def main() -> int:
                               "error": f"{type(err).__name__}: {err}"})
             _partial["sweep_partial"] = sweep
 
-    run_sweep_configs(primary, budget_s * 0.6)
+    run_sweep_configs(primary, budget_s * 0.5)
     ok = [r for r in sweep if "error" not in r]
     if not ok:
         # e.g. a kernel-compile regression breaking every fused config:
         # the two-matmul reference points still produce a valid headline
-        run_sweep_configs(secondary, budget_s)
-        secondary = []
+        run_sweep_configs(secondary + tertiary, budget_s)
+        secondary = tertiary = []
         ok = [r for r in sweep if "error" not in r]
     if not ok:
         return _emit(0.0, "UNAVAILABLE: all sweep configs failed", 0.0,
                      {"sweep": sweep})
+    # batched reference points before converge: 2 timed reps suffice for
+    # non-headline numbers
+    run_sweep_configs(secondary, budget_s * 0.7, timed_reps=2)
 
     # --- time-to-converge (north-star second half) ------------------------
     converge: dict = {}
@@ -435,8 +482,8 @@ def main() -> int:
                 _log(f"  converge {name} FAILED: {err}")
             _partial["time_to_converge_partial"] = converge
 
-    # --- two-matmul reference points (lowest priority) --------------------
-    run_sweep_configs(secondary, budget_s)
+    # --- B=1 two-matmul reference points (lowest priority) ----------------
+    run_sweep_configs(tertiary, budget_s, timed_reps=2)
     ok = [r for r in sweep if "error" not in r]
 
     # --- roofline-referenced baseline ------------------------------------

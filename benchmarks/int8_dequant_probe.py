@@ -1,0 +1,65 @@
+"""int8 B=32 dequant experiments on the real TPU (task: VERDICT r2 #5)."""
+import os, sys, time, functools
+import numpy as np
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp
+from sartsolver_tpu.utils.cache import configure_compilation_cache
+configure_compilation_cache(warn=lambda m: None)
+from sartsolver_tpu.config import SolverOptions
+from sartsolver_tpu.models.sart import make_problem, solve_normalized_batch
+import sartsolver_tpu.ops.fused_sweep as fs
+
+P, V, iters, B = 8192, 65536, 200, int(sys.argv[1]) if len(sys.argv) > 1 else 32
+variant = sys.argv[2] if len(sys.argv) > 2 else "bf16"
+
+# patch the kernel's dequant target
+orig = fs._sweep_kernel
+def patched(update_fn, n_aux, fwd_scale, rtm_ref, w_ref, f_ref, *rest):
+    aux_refs = rest[:n_aux]
+    f_new_ref, fitted_ref = rest[n_aux:]
+    panel = rtm_ref[...]
+    if panel.dtype == jnp.int8:
+        if variant == "f32":
+            panel = panel.astype(jnp.float32)
+        elif variant == "i16bf16":
+            panel = panel.astype(jnp.int16).astype(jnp.bfloat16)
+        elif variant == "f32viaint":
+            panel = panel.astype(jnp.int32).astype(jnp.float32)
+        else:
+            panel = panel.astype(jnp.bfloat16)
+    bp = jax.lax.dot_general(w_ref[...], panel,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    f_new = update_fn(f_ref[...], bp, *[a[...] for a in aux_refs])
+    f_new_ref[...] = f_new
+    fwd = f_new if fwd_scale is None else f_new * aux_refs[fwd_scale][...]
+    contrib = jax.lax.dot_general(fwd, panel,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    from jax.experimental import pallas as pl
+    @pl.when(pl.program_id(0) == 0)
+    def _():
+        fitted_ref[...] = contrib
+    @pl.when(pl.program_id(0) > 0)
+    def _():
+        fitted_ref[...] += contrib
+fs._sweep_kernel = patched
+
+rng = np.random.default_rng(0)
+H32 = (rng.random((P, V), dtype=np.float32) * 0.9 + 0.1)
+opts = SolverOptions(max_iterations=iters, conv_tolerance=0.0, rtm_dtype="int8", fused_sweep="on")
+problem = make_problem(H32, None, opts=opts)
+G = rng.random((B, P)).astype(np.float64)
+norms = G.max(axis=1); msqs = (G**2).sum(axis=1)/norms**2
+g = jnp.asarray((G/norms[:,None]).astype(np.float32)); msq = jnp.asarray(msqs, jnp.float32)
+f0 = jnp.zeros((B, V), jnp.float32)
+def run():
+    return solve_normalized_batch(problem, g, msq, f0, opts=opts, axis_name=None, voxel_axis=None, use_guess=True)
+res = run(); np.asarray(res.solution)
+best = float("inf")
+for _ in range(3):
+    t0 = time.perf_counter(); res = run(); np.asarray(res.solution)
+    best = min(best, time.perf_counter() - t0)
+li = iters / best
+bw = li * P * V * 1 / (819e9)
+print(f"variant={variant} B={B}: {li:.1f} loop-iter/s, {li*B:.0f} frame-iter/s, hbm_frac={bw:.3f}")

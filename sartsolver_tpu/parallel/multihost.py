@@ -200,11 +200,14 @@ def read_and_shard_rtm(
     **bounded row chunks** that are streamed straight into the device
     buffers (in-place ``dynamic_update_slice`` with donated outputs). Peak
     host allocation is one chunk (``chunk_rows x nvoxel`` fp32, default
-    ~256 MB, env ``SART_INGEST_CHUNK_ROWS``), *never* the full matrix or
-    even a full device block — which is what lets a "tens or even hundreds
-    of GB" RTM (/root/reference/README.md:4-8) pass through a host whose
-    RAM is smaller than the chips' aggregate HBM. Works for any process
-    count; the single-process multi-device CLI path uses it too.
+    ~256 MB, env ``SART_INGEST_CHUNK_ROWS``) — TWO chunks when the
+    reader-thread prefetch is active (on by default on multi-core hosts;
+    ``SART_INGEST_PREFETCH=0`` restores the one-chunk peak) — *never* the
+    full matrix or even a full device block, which is what lets a "tens
+    or even hundreds of GB" RTM (/root/reference/README.md:4-8) pass
+    through a host whose RAM is smaller than the chips' aggregate HBM.
+    Works for any process count; the single-process multi-device CLI path
+    uses it too.
 
     ``serialize=True`` staggers the reads process-by-process with a global
     barrier between turns — the reference's default HDD-friendly
@@ -266,52 +269,78 @@ def read_and_shard_rtm(
         )
 
     def read_my_blocks() -> list:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # One reader thread prefetches the NEXT chunk's HDF5 read while the
+        # main thread quantizes/slices and DMAs the current one — ingest
+        # wall approaches max(read, upload) instead of their sum. h5py is
+        # used by the reader thread alone (the single worker serializes all
+        # file access). Defaults on only with >1 host core: both stages are
+        # CPU-driven, so on a single core the overlap cannot win (measured
+        # 2026-07-30 on the 1-core tunnel host: 41.1 s off vs 43-51 s on).
+        # Override either way with SART_INGEST_PREFETCH=1/0.
+        env = os.environ.get("SART_INGEST_PREFETCH", "")
+        prefetch = (env == "1") if env else (os.cpu_count() or 1) > 1
         arrays = []
-        for i, cols in sorted(mine.items()):
-            r0 = i * row_block
-            rows_have = max(0, min(npixel - r0, row_block))
-            # allocate the zero blocks *on device* — a device_put of host
-            # zeros would DMA a full matrix footprint of zeros before the
-            # data chunks stream the same bytes again
-            bufs = {
-                j: jax.jit(
-                    functools.partial(jnp.zeros, (row_block, col_block), jdtype),
-                    out_shardings=jax.sharding.SingleDeviceSharding(dev),
-                )()
-                for j, dev in sorted(cols)
-            }
-            js = sorted(j for j, _ in cols)
-            c_lo = js[0] * col_block
-            c_hi = min((js[-1] + 1) * col_block, nvoxel)
-            for cs in range(0, rows_have, chunk_rows):
-                n = min(chunk_rows, rows_have - cs)
-                stripe = None
-                if c_hi > c_lo:
-                    stripe = read_rtm_block(
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            for i, cols in sorted(mine.items()):
+                r0 = i * row_block
+                rows_have = max(0, min(npixel - r0, row_block))
+                # allocate the zero blocks *on device* — a device_put of
+                # host zeros would DMA a full matrix footprint of zeros
+                # before the data chunks stream the same bytes again
+                bufs = {
+                    j: jax.jit(
+                        functools.partial(jnp.zeros, (row_block, col_block), jdtype),
+                        out_shardings=jax.sharding.SingleDeviceSharding(dev),
+                    )()
+                    for j, dev in sorted(cols)
+                }
+                js = sorted(j for j, _ in cols)
+                c_lo = js[0] * col_block
+                c_hi = min((js[-1] + 1) * col_block, nvoxel)
+
+                def _read(cs: int):
+                    if c_hi <= c_lo:
+                        return None
+                    n = min(chunk_rows, rows_have - cs)
+                    return read_rtm_block(
                         sorted_matrix_files, rtm_name, n, nvoxel, r0 + cs,
                         dtype=np.float32,
                         offset_voxel=c_lo, nvoxel_local=c_hi - c_lo,
                         sparse_cache=sparse_cache,
                         cache_rows=row_span, cache_cols=col_span,
                     )
-                # fixed piece height (except one trailing shape) keeps the
-                # jitted scatter at <= 2 compiled variants
-                n_write = min(chunk_rows, row_block - cs)
-                for j, dev in sorted(cols):
-                    c0 = j * col_block
-                    cols_have = max(0, min(nvoxel - c0, col_block))
-                    piece_np = np.int8 if _quantize_chunk is not None else np.float32
-                    piece = np.zeros((n_write, col_block), piece_np)
-                    if cols_have > 0 and stripe is not None:
-                        sl = stripe[:, c0 - c_lo:c0 - c_lo + cols_have]
-                        piece[:n, :cols_have] = (
-                            _quantize_chunk(sl, c0) if _quantize_chunk else sl
+
+                chunk_starts = list(range(0, rows_have, chunk_rows))
+                fut = (pool.submit(_read, chunk_starts[0])
+                       if prefetch and chunk_starts else None)
+                for k, cs in enumerate(chunk_starts):
+                    n = min(chunk_rows, rows_have - cs)
+                    if prefetch:
+                        stripe = fut.result()
+                        fut = (pool.submit(_read, chunk_starts[k + 1])
+                               if k + 1 < len(chunk_starts) else None)
+                    else:
+                        stripe = _read(cs)
+                    # fixed piece height (except one trailing shape) keeps
+                    # the jitted scatter at <= 2 compiled variants
+                    n_write = min(chunk_rows, row_block - cs)
+                    for j, dev in sorted(cols):
+                        c0 = j * col_block
+                        cols_have = max(0, min(nvoxel - c0, col_block))
+                        piece_np = np.int8 if _quantize_chunk is not None else np.float32
+                        piece = np.zeros((n_write, col_block), piece_np)
+                        if cols_have > 0 and stripe is not None:
+                            sl = stripe[:, c0 - c_lo:c0 - c_lo + cols_have]
+                            piece[:n, :cols_have] = (
+                                _quantize_chunk(sl, c0) if _quantize_chunk else sl
+                            )
+                        bufs[j] = _scatter(
+                            bufs[j], jax.device_put(piece, dev),
+                            np.int32(cs),
                         )
-                    bufs[j] = _scatter(
-                        bufs[j], jax.device_put(piece, dev),
-                        np.int32(cs),
-                    )
-            arrays.extend(bufs[j] for j, _ in sorted(cols))
+                arrays.extend(bufs[j] for j, _ in sorted(cols))
         return arrays
 
     if serialize and jax.process_count() > 1:
