@@ -1,0 +1,109 @@
+"""Stop-iteration stability vs RTM storage dtype (VERDICT r2 #7 closure).
+
+Round 2 recorded the |dC| < tol stall crossing shifting with storage dtype
+(fp32 96 / bf16 70 / int8 81 iterations on the config-3-style problem) —
+the fp32 accumulation of ||Hf||^2 added metric noise on top of the genuine
+storage perturbation. `SolverOptions.precise_convergence` (fp64-emulated
+accumulation, models/sart.py:_sumsq_precise) removes the metric's own
+contribution; this study re-runs the same construction for both metric
+modes across storage dtypes. Run on TPU: results land on stderr.
+
+Expectation: per-dtype iteration counts still differ (bf16/int8 storage
+genuinely perturbs the iterates — that part is physical), but the precise
+metric's counts are reproducible run-to-run and unchanged vs the fp32
+metric only where the fp32 metric happened to be lucky; the metric no
+longer adds its own noise floor near the threshold.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def main() -> None:
+
+    import jax.numpy as jnp
+
+    from sartsolver_tpu.utils.cache import configure_compilation_cache
+
+    configure_compilation_cache(warn=lambda m: None)
+
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import make_problem, solve_normalized_batch
+    from sartsolver_tpu.ops.laplacian import make_laplacian
+
+    P, V = 8192, 65536
+    rng = np.random.default_rng(0)
+    H32 = (rng.random((P, V), dtype=np.float32) * 0.9 + 0.1)
+    ii = np.arange(P, dtype=np.float32)[:, None] / P
+    jj = np.arange(V, dtype=np.float32)[None, :] / V
+    H_c = (H32 * (np.exp(-((ii - jj) ** 2) * 200.0) + 0.02)).astype(np.float32)
+    f_true = rng.random(V).astype(np.float64) * 1.5 + 0.5
+    g = H_c.astype(np.float64) @ f_true
+    g_noisy = g * (1.0 + 0.01 * rng.standard_normal(P))
+    norm = g_noisy.max()
+    msq = float(np.sum(np.where(g_noisy > 0, g_noisy, 0.0) ** 2) / norm**2)
+    gn = (g_noisy / norm).astype(np.float32)
+
+    li = np.arange(V)
+    lap = make_laplacian(
+        np.r_[li, li[1:], li[:-1]], np.r_[li, li[:-1], li[1:]],
+        np.r_[np.full(V, 2.0), np.full(V - 1, -1.0), np.full(V - 1, -1.0)
+              ].astype(np.float32),
+    )
+
+    # stage the matrix ONCE (a tunneled 2.1 GB upload costs tens of
+    # seconds); derive the bf16/int8 problems on device, mirroring
+    # make_problem semantics (stats from fp32; storage cast after)
+    import jax
+
+    from sartsolver_tpu.models.sart import (
+        SARTProblem, compute_ray_stats, compute_ray_stats_int8, quantize_rtm,
+    )
+
+    rtm32 = jnp.asarray(H_c)
+    dens, length = compute_ray_stats(rtm32, dtype=jnp.float32)
+    problems = {"float32": SARTProblem(rtm32, dens, length, lap)}
+    problems["bfloat16"] = SARTProblem(
+        jax.jit(lambda r: r.astype(jnp.bfloat16))(rtm32), dens, length, lap)
+    codes, scale = jax.jit(quantize_rtm)(rtm32)
+    dens8, length8 = jax.jit(functools.partial(
+        compute_ray_stats_int8, dtype=jnp.float32))(codes, scale)
+    problems["int8"] = SARTProblem(codes, dens8, length8, lap, scale)
+
+    print("storage    metric    variant  iters/status", file=sys.stderr)
+    for dtype in ("float32", "bfloat16", "int8"):
+        for precise in (True, False):
+            for log_variant in (False, True):
+                opts = SolverOptions(
+                    max_iterations=2000, conv_tolerance=1e-5,
+                    beta_laplace=2.0e-2, logarithmic=log_variant,
+                    rtm_dtype=None if dtype == "float32" else dtype,
+                    precise_convergence=precise,
+                )
+                res = solve_normalized_batch(
+                    problems[dtype], jnp.asarray(gn[None, :]),
+                    jnp.asarray([msq], jnp.float32),
+                    jnp.zeros((1, V), jnp.float32),
+                    opts=opts, axis_name=None, voxel_axis=None,
+                    use_guess=True,
+                )
+                print(
+                    f"{dtype.ljust(10)} "
+                    f"{('fp64' if precise else 'fp32').ljust(9)} "
+                    f"{('log' if log_variant else 'linear').ljust(8)} "
+                    f"{int(res.iterations[0])}/{int(res.status[0])}",
+                    file=sys.stderr, flush=True,
+                )
+
+
+if __name__ == "__main__":
+    main()
