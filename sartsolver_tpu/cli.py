@@ -109,8 +109,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "round trip per N frames instead of per frame, "
                           "with per-frame results identical to serial "
                           "dispatch. 1 disables. Applies to the default "
-                          "warm-start loop on single-process runs; ignored "
-                          "with --no_guess/--batch_frames/--multihost.")
+                          "warm-start loop, including --multihost runs; "
+                          "ignored with --no_guess/--batch_frames.")
     tpu.add_argument("--rtm_dtype", default=None,
                      choices=["float32", "bfloat16", "float64", "int8"],
                      help="On-device RTM storage dtype. bfloat16 halves the "
@@ -512,14 +512,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 frames = (
                     item for item in frames if not already_written(item[1])
                 )
-            # Single-process runs keep solutions ON DEVICE: one packed
-            # scalar fetch per solve, solution transfer deferred to the
+            # Solutions stay ON DEVICE on every path: one packed scalar
+            # fetch per solve group, solution transfer deferred to the
             # async writer's thread, warm starts chained device-side
             # (parallel/sharded.DeviceSolveResult — each synchronous
             # host<->device round trip costs ~68 ms on a tunneled backend,
-            # vs ~9 ms of device work for a warm-started frame). Multi-host
-            # keeps the collective fetch on the main thread.
-            device_results = jax.process_count() == 1
+            # vs ~9 ms of device work for a warm-started frame). In
+            # multi-host runs the packed scalars are replicated (each
+            # process reads its local copy) and the solution is
+            # asynchronously all-gathered for process 0's writer; all
+            # collectives stay on the main thread.
 
             def run_grouped(K, pad_tail, solve_group, label):
                 """Shared frame-group protocol for the batch and chain
@@ -542,10 +544,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     dt = _time.perf_counter() - t0
                     timer.add(f"solve {label}", dt)
                     per_frame_ms = dt * 1e3 / len(pending)
-                    device_res = hasattr(result, "solution_fetcher")
                     for b, (_, ftime, cam_times) in enumerate(pending):
-                        writer.add(result.solution_fetcher(b)
-                                   if device_res else result.solution[b],
+                        writer.add(result.solution_fetcher(b),
                                    int(result.status[b]), ftime, cam_times,
                                    iterations=int(result.iterations[b]))
                         if primary:
@@ -566,10 +566,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # inert dark frames (independent solves, no carry)
                     lambda stack, n: np.zeros((n, stack.shape[1])),
                     lambda stack: solver.solve_batch(
-                        stack, local=use_local, device_result=device_results),
+                        stack, local=use_local, device_result=True),
                     "batch",
                 )
-            elif device_results and args.chain_frames > 1 and not args.no_guess:
+            elif args.chain_frames > 1 and not args.no_guess:
                 # Warm-start loop chained on device: K frames per program
                 # (lax.scan carrying the previous solution), ONE packed
                 # scalar fetch per chain instead of per frame — per-frame
@@ -598,32 +598,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "chain",
                 )
             else:
-                warm_dev = None  # device-chained warm (single-process)
+                warm_dev = None  # device-chained warm start
                 f0_host: Optional[np.ndarray] = None  # host warm / resume seed
                 if resume_state is not None and not args.no_guess:
                     f0_host = resume_state.last_solution
                 for frame, ftime, cam_times in frames:
                     t0 = _time.perf_counter()
-                    if device_results:
-                        dres = solver.solve_batch(
-                            np.asarray(frame)[None, :],
-                            None if f0_host is None else f0_host[None, :],
-                            local=use_local, device_result=True,
-                            warm=warm_dev,
-                        )
-                        f0_host = None  # resume seed consumed; chain on device
-                        warm_dev = None if args.no_guess else dres
-                        solution = dres.solution_fetcher(0)
-                        status = int(dres.status[0])
-                        iterations = int(dres.iterations[0])
-                    else:  # multi-host: collective fetch on the main thread
-                        result = solver.solve(frame, f0=f0_host, local=use_local)
-                        f0_host = None if args.no_guess else result.solution
-                        solution = result.solution
-                        status = int(result.status)
-                        iterations = int(result.iterations)
-                    writer.add(solution, status, ftime, cam_times,
-                               iterations=iterations)
+                    dres = solver.solve_batch(
+                        np.asarray(frame)[None, :],
+                        None if f0_host is None else f0_host[None, :],
+                        local=use_local, device_result=True,
+                        warm=warm_dev,
+                    )
+                    f0_host = None  # resume seed consumed; chain on device
+                    warm_dev = None if args.no_guess else dres
+                    writer.add(dres.solution_fetcher(0), int(dres.status[0]),
+                               ftime, cam_times,
+                               iterations=int(dres.iterations[0]))
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
                     timer.add("solve frame", elapsed_ms / 1e3)
                     if primary:

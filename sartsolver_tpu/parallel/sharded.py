@@ -81,7 +81,7 @@ def _fetch(x) -> np.ndarray:
 
 
 class DeviceSolveResult:
-    """Batch result whose solution stays ON DEVICE (single-process only).
+    """Batch result whose solution stays ON DEVICE.
 
     Motivation (measured on the tunneled v5e, 2026-07-30): one synchronous
     host<->device round trip costs ~68 ms, and the host-side
@@ -93,12 +93,26 @@ class DeviceSolveResult:
     thread), and the normalized device solution doubles as the next
     frame's warm start without ever visiting the host
     (``solve_batch(warm=...)``).
+
+    Multi-host runs work the same way: the packed scalars are fully
+    replicated, so each process reads them from its own devices (a local
+    D2H, no host collective), and the solution arrives via
+    ``solution_fetch`` — an asynchronously dispatched device-side
+    all-gather to a replicated layout — so the lazy fetch on process 0's
+    writer thread is also a purely local D2H. No collective ever leaves
+    the main thread (the constraint that kept round 3's implementation
+    single-process).
     """
 
     def __init__(self, solver, solution_norm, norms, status, iterations,
-                 convergence):
+                 convergence, solution_fetch=None):
         self._solver = solver
         self.solution_norm = solution_norm  # [B, padded_nvoxel] fp32, device
+        # replicated copy for cross-process-safe fetching (multi-host);
+        # same array as solution_norm on a single process
+        self._solution_fetch = (
+            solution_fetch if solution_fetch is not None else solution_norm
+        )
         self.norms = np.asarray(norms, np.float64)  # [B]
         self.status = np.asarray(status)  # host
         self.iterations = np.asarray(iterations)
@@ -111,7 +125,7 @@ class DeviceSolveResult:
         synchronous path (and the reference's D2H-then-multiply,
         sartsolver_cuda.cpp:264-265)."""
         if self._host is None:
-            sol = np.asarray(self.solution_norm).astype(np.float64)
+            sol = np.asarray(self._solution_fetch).astype(np.float64)
             self._host = (
                 sol[:, : self._solver.nvoxel] * self.norms[:, None]
             )
@@ -335,13 +349,50 @@ class DistributedSARTSolver:
         # is asynchronous, so neither adds a synchronous host round trip.
         # Scalars pack to fp32: status (0/-1) and iterations (<= max 2000)
         # are exact; convergence is already computed in the device dtype.
+        # The pack output is pinned fully replicated so every process of a
+        # multi-host run reads it from its own devices (no host collective).
         self._rescale_fn = jax.jit(lambda f, s: f * s[:, None].astype(f.dtype))
-        self._pack_fn = jax.jit(lambda s, i, c: jnp.stack([
-            s.astype(jnp.float32), i.astype(jnp.float32),
-            c.astype(jnp.float32)]))
+        self._pack_fn = jax.jit(
+            lambda s, i, c: jnp.stack([
+                s.astype(jnp.float32), i.astype(jnp.float32),
+                c.astype(jnp.float32)]),
+            out_shardings=NamedSharding(self.mesh, P()),
+        )
         # last frame of a chain result, kept sharded on device — the next
         # chain's frame-0 seed (rescale folded into the chain's rescale[0])
         self._last_row_fn = jax.jit(lambda sol: sol[-1:])
+        # Device-side reshard of the [B, padded_nvoxel] solution to a fully
+        # replicated layout (an all_gather over the voxel axis riding ICI).
+        # Dispatched asynchronously by every process of a multi-host run so
+        # DeviceSolveResult's lazy fetch is a local D2H on any process —
+        # the collective stays on the main thread.
+        self._replicate_fn = jax.jit(
+            lambda sol: sol, out_shardings=NamedSharding(self.mesh, P())
+        )
+
+    # Replicating [B, padded_nvoxel] fp32 on every device is the fast fetch
+    # path, but above this per-device byte budget it would reintroduce the
+    # replicated-solution footprint that voxel sharding exists to remove
+    # (module docstring) — there the solution is instead allgathered to the
+    # HOST on the main thread (synchronous, but still once per solve group).
+    _REPLICATE_FETCH_LIMIT = 1 << 30
+
+    def _fetch_handle(self, solution) -> Optional[object]:
+        """Cross-process-safe fetch handle for a device solution (None on a
+        single process: the sharded array itself is locally fetchable)."""
+        if jax.process_count() == 1:
+            return None
+        import os
+
+        limit = int(os.environ.get(
+            "SART_REPLICATE_FETCH_LIMIT", self._REPLICATE_FETCH_LIMIT
+        ))
+        nbytes = int(np.prod(solution.shape)) * solution.dtype.itemsize
+        if nbytes <= limit:
+            return self._replicate_fn(solution)  # async dispatch
+        from sartsolver_tpu.parallel.multihost import fetch
+
+        return fetch(solution)  # collective now, on the main thread
 
     def _problem_spec(self) -> SARTProblem:
         has_lap = self.problem.laplacian is not None
@@ -577,7 +628,15 @@ class DistributedSARTSolver:
         on device (``lax.scan`` carrying the warm start, the while_loop
         inside) so the whole chain pays ONE packed scalar fetch — per-frame
         semantics identical to K separate :meth:`solve` calls by
-        construction. Single-process only (like ``device_result``).
+        construction.
+
+        Multi-host runs chain too (every process calls this collectively,
+        like :meth:`solve`): the packed scalars come back replicated so
+        each process's fetch is a local D2H, and the solution is
+        asynchronously all-gathered to a replicated layout for process 0's
+        lazy writer fetch — the reference's serial warm-started loop keeps
+        its one-round-trip-per-K-frames cost at any rank count
+        (main.cpp:131-140 runs identically under any `mpirun -np`).
 
         Frame 0 seeds from ``warm`` (a previous chain's result — its LAST
         frame carries over, staying on device), else from host ``f0``,
@@ -586,13 +645,14 @@ class DistributedSARTSolver:
         """
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
-        if jax.process_count() > 1:
-            raise ValueError(
-                "solve_chain is single-process only (the multi-host fetch "
-                "is collective and must stay on the main thread)."
-            )
         if warm is not None and f0 is not None:
             raise ValueError("Pass either warm= (device) or f0= (host), not both.")
+        if warm is not None and warm.solution_norm.shape[-1] != self.padded_nvoxel:
+            raise ValueError(
+                f"warm result has {warm.solution_norm.shape[-1]} padded "
+                f"voxels, expected {self.padded_nvoxel}; it must come from "
+                "a solver with the same voxel layout."
+            )
         G = self._check_frames(measurements, local)
         K = G.shape[0]
         g_dev, norms, msqs = self._stage_frames(G, local)
@@ -612,12 +672,13 @@ class DistributedSARTSolver:
             self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev,
             jnp.asarray(rescale, dtype),
         )
+        sol_fetch = self._fetch_handle(res.solution)
         packed = np.asarray(self._pack_fn(res.status, res.iterations,
                                           res.convergence))  # ONE fetch
         return DeviceSolveResult(
             self, res.solution, norms,
             packed[0].astype(np.int32), packed[1].astype(np.int32),
-            packed[2],
+            packed[2], solution_fetch=sol_fetch,
         )
 
     def solve_batch(
@@ -641,23 +702,18 @@ class DistributedSARTSolver:
         ``||g||^2`` are combined across processes, and staging is
         per-device-sharded instead of replicated per host.
 
-        ``device_result=True`` (single-process only) returns a
-        :class:`DeviceSolveResult`: the solution stays on device, the
-        status/iterations/convergence scalars arrive in one packed fetch.
-        ``warm`` chains a previous frame's device result as this frame's
-        initial guess — the normalized solution is rescaled on device by
-        ``norm_prev/norm_new`` (the host path's fp64 round trip through
-        physical units is numerically a no-op up to one fp32 ulp, and a
-        warm start is only an initial guess).
+        ``device_result=True`` returns a :class:`DeviceSolveResult`: the
+        solution stays on device, the status/iterations/convergence scalars
+        arrive in one packed fetch (replicated, so multi-host processes
+        each read their local copy). ``warm`` chains a previous frame's
+        device result as this frame's initial guess — the normalized
+        solution is rescaled on device by ``norm_prev/norm_new`` (the host
+        path's fp64 round trip through physical units is numerically a
+        no-op up to one ulp of the compute dtype, and a warm start is only
+        an initial guess).
         """
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
-        if (device_result or warm is not None) and jax.process_count() > 1:
-            raise ValueError(
-                "device_result/warm chaining is single-process only (the "
-                "multi-host fetch is collective and must stay on the main "
-                "thread)."
-            )
         if warm is not None and f0 is not None:
             raise ValueError("Pass either warm= (device) or f0= (host), not both.")
         G = self._check_frames(measurements, local)
@@ -670,8 +726,14 @@ class DistributedSARTSolver:
                     f"warm result has shape {tuple(warm.solution_norm.shape)}, "
                     f"expected {(B, self.padded_nvoxel)}."
                 )
-            scale = (warm.norms / norms).astype(np.float32)
-            f0_dev = self._rescale_fn(warm.solution_norm, jnp.asarray(scale))
+            # fp64 norm ratio cast to the compute dtype on device — the
+            # same rounding the chain path applies (solve_chain_normalized
+            # rescale), so per-frame warm dispatch and chained dispatch
+            # produce bit-identical warm starts
+            scale = warm.norms / norms
+            f0_dev = self._rescale_fn(
+                warm.solution_norm, jnp.asarray(scale, dtype)
+            )
         else:
             f0_np = np.zeros((B, self.padded_nvoxel), dtype)
             if not use_guess:
@@ -682,12 +744,13 @@ class DistributedSARTSolver:
             self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev
         )
         if device_result:
+            sol_fetch = self._fetch_handle(res.solution)
             packed = np.asarray(self._pack_fn(res.status, res.iterations,
                                               res.convergence))  # ONE fetch
             return DeviceSolveResult(
                 self, res.solution, norms,
                 packed[0].astype(np.int32), packed[1].astype(np.int32),
-                packed[2],
+                packed[2], solution_fetch=sol_fetch,
             )
         solution = _fetch(res.solution).astype(np.float64)[:, : self.nvoxel] * norms[:, None]
         return SolveResult(

@@ -238,6 +238,69 @@ def test_two_process_int8_voxel_major(tmp_path, monkeypatch):
             assert rel < 0.01, (i, rel)
 
 
+def test_two_process_chained_matches_serial(world, tmp_path):
+    """The device-chained warm-start frame loop across two REAL processes
+    (VERDICT r3 next #1): `--chain_frames 2` (two chains of two frames,
+    with a device-side chain-to-chain handoff) must bit-match
+    `--chain_frames 1` (per-frame dispatch) — same solutions, statuses,
+    and iteration counts in the written file. This is the reference's
+    core workload (the serial warm-started loop, main.cpp:131-140) at
+    rank count 2 with the one-round-trip-per-K-frames dispatch."""
+    paths, H, f_true, times, scales = world
+
+    serial_out = str(tmp_path / "mp_serial.h5")
+    _run_pair(paths, serial_out, _free_port(), "--chain_frames", "1")
+
+    chain_out = str(tmp_path / "mp_chain.h5")
+    outs = _run_pair(paths, chain_out, _free_port(), "--chain_frames", "2")
+    # chain flushes print one line per real frame, process 0 only
+    assert outs[0].count("Processed in:") == len(times)
+    assert outs[1].count("Processed in:") == 0
+    assert "average over chain" in outs[0]
+
+    with h5py.File(serial_out, "r") as fs, h5py.File(chain_out, "r") as fc:
+        np.testing.assert_array_equal(
+            fc["solution/value"][:], fs["solution/value"][:]
+        )
+        np.testing.assert_array_equal(
+            fc["solution/status"][:], fs["solution/status"][:]
+        )
+        np.testing.assert_array_equal(
+            fc["solution/iterations"][:], fs["solution/iterations"][:]
+        )
+        assert "voxel_map" in fc
+
+
+def test_two_process_batched_matches_per_frame(world, tmp_path):
+    """The batched --no_guess path across two REAL processes with device
+    results (replicated lazy fetch): `--batch_frames 2` (two groups of
+    two independent frames, tail untouched here since 4 % 2 == 0) must
+    bit-match per-frame dispatch with the same flags."""
+    paths, H, f_true, times, scales = world
+
+    one_out = str(tmp_path / "mp_b1.h5")
+    _run_pair(paths, one_out, _free_port(), "--no_guess")
+
+    bat_out = str(tmp_path / "mp_b2.h5")
+    outs = _run_pair(paths, bat_out, _free_port(),
+                     "--no_guess", "--batch_frames", "2")
+    assert outs[0].count("Processed in:") == len(times)
+    assert "average over batch" in outs[0]
+
+    with h5py.File(one_out, "r") as fo, h5py.File(bat_out, "r") as fb:
+        # gemv (B=1) vs gemm (B=2) may legally reorder the contraction;
+        # the single-process suite's CPU bound is rtol=1e-9 (test_batched)
+        np.testing.assert_allclose(
+            fb["solution/value"][:], fo["solution/value"][:], rtol=1e-9
+        )
+        np.testing.assert_array_equal(
+            fb["solution/status"][:], fo["solution/status"][:]
+        )
+        np.testing.assert_array_equal(
+            fb["solution/iterations"][:], fo["solution/iterations"][:]
+        )
+
+
 def test_two_process_resume(world, tmp_path):
     paths, H, f_true, times, scales = world
     mp_out = str(tmp_path / "mp_resume.h5")
