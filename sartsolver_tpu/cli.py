@@ -631,6 +631,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         _mark("write voxel map")
         if args.timing and primary:
             print(timer.summary())
+            # provenance: which sweep path the solver actually compiled
+            # (VERDICT r3 next #4 — a silent degrade to the two-matmul
+            # path must be visible in the artifact, not only on stderr)
+            from sartsolver_tpu.models.sart import FUSED_ENGAGEMENT
+
+            print(f"fused sweep: requested={args.fused_sweep} "
+                  f"resolved={opts.fused_sweep} "
+                  f"engaged={FUSED_ENGAGEMENT['last'] or 'not traced'}")
     except KeyError as err:
         # h5py raises KeyError for missing datasets/attributes in otherwise
         # openable files; surface it as the fail-fast message + exit 1 the

@@ -276,6 +276,10 @@ def test_timing_flag_prints_summary(world, capsys):
                   "solve chain",  # the default device-chained frame loop
                   "write voxel map"):
         assert phase in out
+    # sweep-path provenance in the artifact (VERDICT r3 next #4); on the
+    # CPU test backend 'auto' resolves to the two-matmul path
+    assert "fused sweep: requested=auto" in out
+    assert "engaged=off" in out
 
 
 def test_internal_error_propagates(world, monkeypatch):
