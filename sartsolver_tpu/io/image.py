@@ -46,11 +46,16 @@ class CompositeImage:
         generalizes it for processes whose device row blocks are not
         contiguous: emitted frames are the concatenation of the runs, and
         nothing outside them is read or cached."""
+        explicit_runs = pixel_runs is not None
         if pixel_runs is None:
             pixel_runs = [(offset_pixel, npixel)]
         self.runs = [(int(o), int(c)) for o, c in pixel_runs if c > 0]
         if not self.runs:
-            raise ValueError("Argument npixel must be positive.")
+            raise ValueError(
+                "Argument pixel_runs must contain at least one positive-"
+                "count run." if explicit_runs
+                else "Argument npixel must be positive."
+            )
         self.files = dict(image_files)
         self.rtm_frame_masks = {k: np.asarray(v).ravel() for k, v in rtm_frame_masks.items()}
         self.npix = sum(c for _, c in self.runs)

@@ -54,6 +54,11 @@ def _sparse_budget_bytes() -> int:
         return 1024 << 20
 
 
+# Reserved sparse_cache key holding the running cached-bytes total (segment
+# keys are tuples, so a str can never collide).
+_CACHE_BYTES_KEY = "__cached_bytes__"
+
+
 def _load_sparse_segment(
     group, filename: str, start_pixel: int, start_voxel: int, nvoxel: int,
     dtype,
@@ -103,12 +108,12 @@ def _sparse_segment_window(
         if cache_cols is not None:
             sel = (vox >= cache_cols[0]) & (vox < cache_cols[1])
             pix, vox, val = pix[sel], vox[sel], val[sel]
-        used = sum(
-            arr.nbytes
-            for entry in sparse_cache.values() if entry is not None
-            for arr in entry[:3]  # only the triplet arrays carry bytes
-        )
-        if pix.nbytes + vox.nbytes + val.nbytes + used > _sparse_budget_bytes():
+        # running byte total under a reserved key — a per-miss rescan of
+        # every entry is O(n_segments^2) across an ingest, and nothing
+        # ever frees budget (entries are never evicted)
+        used = sparse_cache.get(_CACHE_BYTES_KEY, 0)
+        nbytes = pix.nbytes + vox.nbytes + val.nbytes
+        if nbytes + used > _sparse_budget_bytes():
             sparse_cache[key] = None  # over budget: re-read per chunk
             # ...but THIS call already has the (filtered) triplets — use
             # them instead of an immediate duplicate HDF5 read; the
@@ -119,6 +124,7 @@ def _sparse_segment_window(
         sparse_cache[key] = (
             pix[order], vox[order], val[order], cache_rows, cache_cols
         )
+        sparse_cache[_CACHE_BYTES_KEY] = used + nbytes
     entry = sparse_cache[key]
     if entry is not None:
         pix, vox, val, rows_win, cols_win = entry

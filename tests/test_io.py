@@ -215,7 +215,14 @@ class TestRTMBlockReader:
             for off in range(0, npix, 2)
         ]
         np.testing.assert_allclose(np.concatenate(blocks), H, rtol=1e-6)
-        assert len(cache) == 2  # both segments cached independently
+        from sartsolver_tpu.io.raytransfer import _CACHE_BYTES_KEY
+
+        # both segments cached independently (+ the running byte total)
+        segs = {k: v for k, v in cache.items() if k != _CACHE_BYTES_KEY}
+        assert len(segs) == 2
+        assert cache[_CACHE_BYTES_KEY] == sum(
+            arr.nbytes for entry in segs.values() for arr in entry[:3]
+        )
 
     def test_sparse_cache_budget_fallback(self, world, monkeypatch):
         """A zero byte budget disables caching (entry None) but keeps
