@@ -502,13 +502,17 @@ def _run_worker_items(items: list, spec_base: dict, t_start: float):
         deadline = time.monotonic() + spawn_timeout
         clean_exit = False  # only a "done" message counts as clean
         worker_died = False  # EOF without "done": crash, not completion
+        progressed = False  # any protocol message received from this worker
         while True:
+            # the deadline is checked every iteration — stray non-protocol
+            # stdout chatter (sitecustomize hooks) must not keep resetting
+            # the hang detector by dodging the queue.Empty branch
+            if time.monotonic() > deadline:
+                break  # hang
             try:  # short slices so the parent keeps ticking the watchdog
                 line = lines.get(timeout=15)
             except queue.Empty:
                 _tick()
-                if time.monotonic() > deadline:
-                    break  # hang
                 continue
             if line is None:
                 worker_died = True
@@ -521,6 +525,7 @@ def _run_worker_items(items: list, spec_base: dict, t_start: float):
             except ValueError:
                 continue
             _tick()
+            progressed = True
             if msg["type"] == "start":
                 inflight = msg["id"]
                 deadline = time.monotonic() + by_id[inflight]["timeout"]
@@ -556,7 +561,10 @@ def _run_worker_items(items: list, spec_base: dict, t_start: float):
             except subprocess.TimeoutExpired:
                 _log("worker did not reap within 60s; abandoning it")
 
-        if clean_exit:
+        if clean_exit or (worker_died and not remaining):
+            # done — or crashed during teardown AFTER finishing every item
+            # (plausible with the tunneled plugin); either way nothing to
+            # report as hung
             _wait(proc)
             break
         # hang or crash: fail only the in-flight item, keep the rest
@@ -578,6 +586,10 @@ def _run_worker_items(items: list, spec_base: dict, t_start: float):
             remaining = [x for x in remaining if x["id"] != inflight]
             _log(f"  {inflight}: {why}")
             _refresh_partials(results, items)
+        elif progressed:
+            # died/stalled between items: nothing in flight to blame, the
+            # restart resumes the remaining items
+            _log(f"worker stopped between items: {why}")
         else:
             _log(f"worker failed before starting any item: {why}")
             hung.append(f"(spawn: {why})")
