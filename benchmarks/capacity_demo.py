@@ -65,6 +65,14 @@ def _quantize_host(P, V, seed=0):
 
 
 def run_case(dtype: str, P: int, V: int, iters: int = 50) -> None:
+    """Build, stage, solve, measure, and tear down one capacity case.
+
+    The teardown (immediate delete of every device array, the way
+    DistributedSARTSolver.close() releases memory) runs in a ``finally``
+    so a failing case cannot leave a poisoned allocator for the next one
+    in same-process mode — which would silently reproduce the 20x
+    fragmentation slowdown this mode exists to measure the absence of.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -82,6 +90,27 @@ def run_case(dtype: str, P: int, V: int, iters: int = 50) -> None:
     )
     from sartsolver_tpu.ops.fused_sweep import pick_block_voxels
 
+    live: list = []  # device arrays to delete on the way out
+
+    def track(x):
+        live.append(x)
+        return x
+
+    try:
+        _run_case_body(dtype, P, V, iters, jax, jnp, SolverOptions,
+                       SARTProblem, compute_ray_stats,
+                       compute_ray_stats_int8, solve_normalized_batch,
+                       pick_block_voxels, track)
+    finally:
+        for arr in live:
+            for leaf in jax.tree_util.tree_leaves(arr):
+                if isinstance(leaf, jax.Array) and not leaf.is_deleted():
+                    leaf.delete()
+
+
+def _run_case_body(dtype, P, V, iters, jax, jnp, SolverOptions,
+                   SARTProblem, compute_ray_stats, compute_ray_stats_int8,
+                   solve_normalized_batch, pick_block_voxels, track) -> None:
     itemsize = jnp.dtype(dtype).itemsize
     gb = P * V * itemsize / 1e9
     print(f"--- {dtype} {P}x{V} = {gb:.1f} GB device", file=sys.stderr,
@@ -91,31 +120,31 @@ def run_case(dtype: str, P: int, V: int, iters: int = 50) -> None:
         codes_np, scale_np = _quantize_host(P, V)
         t_host = time.perf_counter() - t0
         t0 = time.perf_counter()
-        codes = jnp.asarray(codes_np)
+        codes = track(jnp.asarray(codes_np))
         del codes_np
-        scale = jnp.asarray(scale_np)
+        scale = track(jnp.asarray(scale_np))
         jax.block_until_ready(codes)
         t_stage = time.perf_counter() - t0
         dens, length = compute_ray_stats_int8(codes, scale,
                                               dtype=jnp.float32)
-        problem = SARTProblem(codes, dens, length, None, scale)
+        problem = track(SARTProblem(codes, dens, length, None, scale))
         H_for_g = None
     else:
         H_np = _make_host_matrix(P, V, dtype)
         t_host = time.perf_counter() - t0
         t0 = time.perf_counter()
-        rtm = jnp.asarray(H_np)
+        rtm = track(jnp.asarray(H_np))
         del H_np
         jax.block_until_ready(rtm)
         t_stage = time.perf_counter() - t0
         dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
-        problem = SARTProblem(rtm, dens, length, None)
+        problem = track(SARTProblem(rtm, dens, length, None))
         H_for_g = rtm
 
     # synthetic measurement: g = H @ f_true computed ON DEVICE (a host
     # matmul at these sizes would take minutes on one core)
     rng = np.random.default_rng(1)
-    f_true = jnp.asarray(rng.random(V, dtype=np.float32) * 1.5 + 0.5)
+    f_true = track(jnp.asarray(rng.random(V, dtype=np.float32) * 1.5 + 0.5))
     if dtype == "int8":
         g = jax.jit(
             lambda c, s, f: (c.astype(jnp.bfloat16)
@@ -132,22 +161,22 @@ def run_case(dtype: str, P: int, V: int, iters: int = 50) -> None:
 
     opts = SolverOptions(max_iterations=iters, conv_tolerance=0.0,
                          fused_sweep="auto", rtm_dtype=dtype)
-    g_dev = jnp.asarray((g / norm)[None, :], jnp.float32)
-    msq_dev = jnp.asarray([msq], jnp.float32)
-    f0 = jnp.zeros((1, V), jnp.float32)
+    g_dev = track(jnp.asarray((g / norm)[None, :], jnp.float32))
+    msq_dev = track(jnp.asarray([msq], jnp.float32))
+    f0 = track(jnp.zeros((1, V), jnp.float32))
 
     def run():
         return solve_normalized_batch(
             problem, g_dev, msq_dev, f0,
             opts=opts, axis_name=None, voxel_axis=None, use_guess=True)
 
-    res = run()
+    res = track(run())
     np.asarray(res.solution)
     n_done = max(int(res.iterations[0]), 1)
     best = float("inf")
     for _ in range(2):
         t0 = time.perf_counter()
-        res = run()
+        res = track(run())
         np.asarray(res.solution)
         best = min(best, time.perf_counter() - t0)
     rate = n_done / best
@@ -171,10 +200,36 @@ def main() -> None:
         # int8 mid-size reference point (BASELINE.md capacity table row 3)
         ("int8", 65536, 65536),
     ]
-    # One subprocess per case: running a second near-HBM-limit case in the
-    # same process measured 20x slower (3.5 vs 70.2 iter/s for the 8.6 GB
-    # int8 case, 2026-07-30) — residual allocations/fragmentation from the
-    # previous case's buffers poison the follow-on run.
+    if os.environ.get("SART_CAPACITY_CASES"):
+        # "dtype:P:V,dtype:P:V" override (small-shape smoke tests)
+        cases = [
+            (d, int(p), int(v))
+            for d, p, v in (c.split(":") for c in
+                            os.environ["SART_CAPACITY_CASES"].split(","))
+        ]
+    if os.environ.get("SART_CAPACITY_SAME_PROCESS", "") not in ("", "0"):
+        # close()-and-reload measurement (VERDICT r3 next #5): every case
+        # in ONE process, each releasing its device arrays before the next
+        # (run_case's teardown mirrors DistributedSARTSolver.close()).
+        # Compare against the subprocess-isolated rates: round-3's
+        # no-teardown sequence ran the follow-on case 20x slow (3.5 vs
+        # 70.2 iter/s); with explicit deletes the allocator should start
+        # clean.
+        print("--- same-process mode (close() + reload between cases)",
+              file=sys.stderr, flush=True)
+        for dtype, P, V in cases:
+            try:
+                run_case(dtype, P, V)
+            except Exception as err:
+                print(f"    FAILED {dtype} {P}x{V}: "
+                      f"{type(err).__name__}: {err}",
+                      file=sys.stderr, flush=True)
+        return
+    # One subprocess per case (the default, fully isolated): running a
+    # second near-HBM-limit case in the same process WITHOUT teardown
+    # measured 20x slower (3.5 vs 70.2 iter/s for the 8.6 GB int8 case,
+    # 2026-07-30) — residual allocations/fragmentation from the previous
+    # case's buffers poison the follow-on run.
     for dtype, P, V in cases:
         try:
             r = subprocess.run(

@@ -394,6 +394,36 @@ class DistributedSARTSolver:
 
         return fetch(solution)  # collective now, on the main thread
 
+    def close(self) -> None:
+        """Release the solver's device memory (VERDICT r3 next #5).
+
+        Deletes the staged RTM/stats/Laplacian/scale arrays immediately
+        (instead of waiting for GC of a possibly reference-cycled Python
+        object) and drops the cached compiled functions. A long-lived
+        operator process can then load a second near-HBM-limit matrix into
+        the same process; ``benchmarks/capacity_demo.py`` measures how
+        close a close()+reload cycle gets to fresh-process throughput.
+        Idempotent. The solver is unusable afterwards; results already
+        fetched to host stay valid, but any un-fetched
+        :class:`DeviceSolveResult` solutions die with the device buffers.
+        """
+        if self.problem is None:
+            return
+        for leaf in jax.tree_util.tree_leaves(self.problem):
+            if isinstance(leaf, jax.Array):
+                try:
+                    leaf.delete()
+                except RuntimeError:
+                    pass  # already deleted elsewhere
+        self.problem = None
+        self._solve_fns.clear()
+
+    def __enter__(self) -> "DistributedSARTSolver":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     def _problem_spec(self) -> SARTProblem:
         has_lap = self.problem.laplacian is not None
         lap_spec = ShardedLaplacian(
@@ -573,6 +603,11 @@ class DistributedSARTSolver:
 
         Shared by :meth:`solve_batch` and :meth:`solve_chain`.
         """
+        if self.problem is None:
+            raise ValueError(
+                "This solver has been closed (close() released its device "
+                "memory); build a new DistributedSARTSolver."
+            )
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
         B = G.shape[0]

@@ -493,3 +493,30 @@ def test_local_staging_multi_run_equals_full():
     assert int(got.iterations[0]) == int(ref.iterations[0])
     np.testing.assert_allclose(got.fetch_solutions()[0],
                                ref.fetch_solutions()[0], rtol=1e-7)
+
+
+def test_close_releases_device_memory():
+    """close() deletes the staged device arrays immediately, is
+    idempotent, works as a context manager, and a closed solver refuses
+    further solves with a clear error (VERDICT r3 next #5: a long-lived
+    operator process must be able to load a second near-HBM-limit matrix
+    into the same process)."""
+    H, g, _ = make_case(seed=17, P=48, V=32)
+    opts = SolverOptions.cpu_parity(max_iterations=10, conv_tolerance=1e-12)
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8))
+    res = solver.solve(g)  # fetched to host before close
+    arrays = [leaf for leaf in jax.tree_util.tree_leaves(solver.problem)
+              if isinstance(leaf, jax.Array)]
+    assert arrays
+    solver.close()
+    assert all(a.is_deleted() for a in arrays)
+    solver.close()  # idempotent
+    with pytest.raises(ValueError, match="closed"):
+        solver.solve(g)
+    assert np.isfinite(res.solution).all()  # host result survives
+
+    # context-manager form, and a reload into the same process works
+    with DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8)) as s2:
+        res2 = s2.solve(g)
+    np.testing.assert_allclose(res2.solution, res.solution, rtol=1e-12)
+    assert s2.problem is None
