@@ -1,0 +1,187 @@
+"""int8 B=32: persistent per-panel dequant scratch probe (VERDICT r3 #7).
+
+The one Mosaic-lowering structure the round-3 probe matrix did not cover:
+dequantize the int8 panel ONCE per grid step into an explicit bf16 VMEM
+scratch, then reuse that scratch across BATCH SUB-TILES of the two MXU
+contractions — instead of the production kernel's single whole-batch pair
+of dots over an `astype` value (whose materialization strategy is
+Mosaic's choice). If Mosaic re-materializes the dequantized panel per MXU
+pass at large B, the scratch variant should pull int8 B=32 above the
+~500 loop-iter/s floor (round-3 record: hbm_frac 0.33 at B=32 vs 0.61 at
+B=1); if it measures equal-or-slower, the floor is confirmed as the
+lowering itself and the question closes (BASELINE.md).
+
+Variants (all compute the identical quantized-SART linear iteration):
+  whole      — explicit bf16 scratch, whole-batch dots (isolates the
+               scratch itself)
+  sub8/sub16 — explicit scratch + batch sub-tiles of 8/16 rows
+  nodequant  — production-structure reference point (astype value,
+               whole batch) through the same harness
+Run on TPU:  python benchmarks/int8_scratch_probe.py [B] [variant...]
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from sartsolver_tpu.utils.cache import configure_compilation_cache
+
+configure_compilation_cache(warn=lambda m: None)
+
+import sartsolver_tpu.ops.fused_sweep as fs
+
+P = int(os.environ.get("SART_PROBE_NPIXEL", 8192))
+V = int(os.environ.get("SART_PROBE_NVOXEL", 65536))
+ITERS = int(os.environ.get("SART_PROBE_ITERS", 200))
+# CPU smoke: SART_PROBE_INTERPRET=1 runs the kernels in the Pallas
+# interpreter (slow; correctness/structure check only)
+INTERPRET = os.environ.get("SART_PROBE_INTERPRET", "") == "1"
+
+
+def make_sweep(B: int, bs: int, bt: int, use_scratch: bool):
+    """Linear int8 SART sweep: returns (f_new, fitted) like fs.fused_sweep
+    with update = max(f + invd * (bp * scale), 0), fwd scaled by `scale`."""
+    grid = (V // bs,)
+    nt = B // bt
+    assert B % bt == 0
+
+    def kernel(rtm_ref, scale_ref, invd_ref, w_ref, f_ref,
+               f_new_ref, fitted_ref, *scratch):
+        if use_scratch:
+            scratch[0][...] = rtm_ref[...].astype(jnp.bfloat16)
+            panel = scratch[0][...]
+        else:
+            panel = rtm_ref[...].astype(jnp.bfloat16)
+        s = scale_ref[...]  # [1, bs]
+        invd = invd_ref[...]  # [1, bs]
+        for t in range(nt):
+            sl = slice(t * bt, (t + 1) * bt)
+            bp = lax.dot_general(
+                w_ref[sl, :], panel,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            f_new = jnp.maximum(f_ref[sl, :] + invd * (bp * s), 0.0)
+            f_new_ref[sl, :] = f_new
+            contrib = lax.dot_general(
+                f_new * s, panel,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+            @pl.when(pl.program_id(0) == 0)
+            def _(sl=sl, contrib=contrib):
+                fitted_ref[sl, :] = contrib
+
+            @pl.when(pl.program_id(0) > 0)
+            def _(sl=sl, contrib=contrib):
+                fitted_ref[sl, :] += contrib
+
+    voxel_panel = lambda b: pl.BlockSpec((b, bs), lambda j: (0, j))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((P, bs), lambda j: (0, j)),  # int8 RTM panel
+            voxel_panel(1),  # scale
+            voxel_panel(1),  # inv_density
+            pl.BlockSpec((B, P), lambda j: (0, 0)),  # w resident
+            voxel_panel(B),  # f
+        ],
+        out_specs=(voxel_panel(B), pl.BlockSpec((B, P), lambda j: (0, 0))),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, V), jnp.float32),
+            jax.ShapeDtypeStruct((B, P), jnp.float32),
+        ),
+        scratch_shapes=(
+            [pltpu.VMEM((P, bs), jnp.bfloat16)] if use_scratch else []
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * B * P * V,
+            bytes_accessed=P * V + 2 * B * (P + V) * 4,
+            transcendentals=0,
+        ),
+        interpret=INTERPRET,
+    )
+
+
+def main() -> None:
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 32
+    variants = sys.argv[2:] or ["nodequant", "whole", "sub8", "sub16"]
+    rng = np.random.default_rng(0)
+    H32 = rng.random((P, V), dtype=np.float32) * 0.9 + 0.1
+    from sartsolver_tpu.models.sart import quantize_rtm
+
+    codes, scale = jax.jit(quantize_rtm)(jnp.asarray(H32))
+    dens = (scale * jnp.sum(codes, axis=0, dtype=jnp.int32)).astype(jnp.float32)
+    length = np.asarray(
+        jax.jit(lambda c, s: lax.dot_general(
+            c, s, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32))(codes, scale))
+    invd = jnp.asarray((1.0 / np.asarray(dens))[None, :], jnp.float32)
+    invl = jnp.asarray((1.0 / length)[None, :], jnp.float32)
+    G = rng.random((B, P)).astype(np.float64)
+    g = jnp.asarray((G / G.max(axis=1)[:, None]).astype(np.float32))
+    f0 = jnp.zeros((B, V), jnp.float32)
+    bs = fs.pick_block_voxels(P, V, 1, B)
+    print(f"B={B} bs={bs}", file=sys.stderr, flush=True)
+    opts = jax.jit  # alias to quiet linters
+
+    for name in variants:
+        bt = {"sub8": 8, "sub16": 16}.get(name, B)
+        if bt > B:
+            continue
+        sweep = make_sweep(B, bs, bt, use_scratch=name != "nodequant")
+
+        @functools.partial(
+            jax.jit, compiler_options=fs.raised_vmem_options()
+            if jax.default_backend() == "tpu" else None)
+        def loop(codes, g, f0, sweep=sweep):
+            fitted0 = jnp.zeros((B, P), jnp.float32)
+
+            def body(_, carry):
+                f, fitted = carry
+                w = (g - fitted) * invl
+                return sweep(codes, scale[None, :], invd, w, f)
+
+            return lax.fori_loop(0, ITERS, body, (f0, fitted0))
+
+        try:
+            f, fitted = loop(codes, g, f0)
+            f_host = np.asarray(f)
+            if "ref" not in locals():
+                ref = f_host
+            elif not np.allclose(f_host, ref, rtol=1e-5, atol=1e-6):
+                print(f"variant={name}: MISMATCH vs first variant "
+                      f"(max |d|={np.abs(f_host - ref).max():.3e})",
+                      flush=True)
+            best = float("inf")
+            for _ in range(3):
+                t0 = time.perf_counter()
+                f, fitted = loop(codes, g, f0)
+                np.asarray(f)
+                best = min(best, time.perf_counter() - t0)
+            li = ITERS / best
+            print(f"variant={name:10s} B={B}: {li:.1f} loop-iter/s, "
+                  f"{li * B:.0f} frame-iter/s, "
+                  f"hbm_frac={li * P * V / 819e9:.3f}", flush=True)
+        except Exception as err:
+            print(f"variant={name:10s} B={B}: FAILED "
+                  f"{type(err).__name__}: {str(err)[:300]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
