@@ -87,12 +87,15 @@ class DeviceSolveResult:
     host<->device round trip costs ~68 ms, and the host-side
     :class:`SolveResult` path pays ~6 per frame (f0 staging, four result
     fetches) — dwarfing a warm-started solve's ~9 ms of device work. Here
-    the per-frame synchronous cost is ONE round trip (the packed scalar
-    fetch); the solution transfer happens lazily via
+    dispatch is fully asynchronous: the status/iterations/convergence
+    scalars live in ONE packed device array materialized lazily on first
+    access (so a caller can dispatch the NEXT chain — which only needs
+    the device-resident ``solution_norm`` and host-side ``norms`` — before
+    paying this chain's fetch, overlapping the D2H with the next chain's
+    compute), and the solution transfer happens lazily via
     :meth:`solution_fetcher` (intended for the async writer's worker
-    thread), and the normalized device solution doubles as the next
-    frame's warm start without ever visiting the host
-    (``solve_batch(warm=...)``).
+    thread). The normalized device solution doubles as the next frame's
+    warm start without ever visiting the host (``solve_batch(warm=...)``).
 
     Multi-host runs work the same way: the packed scalars are fully
     replicated, so each process reads them from its own devices (a local
@@ -104,8 +107,8 @@ class DeviceSolveResult:
     single-process).
     """
 
-    def __init__(self, solver, solution_norm, norms, status, iterations,
-                 convergence, solution_fetch=None):
+    def __init__(self, solver, solution_norm, norms, packed,
+                 solution_fetch=None):
         self._solver = solver
         self.solution_norm = solution_norm  # [B, padded_nvoxel] fp32, device
         # replicated copy for cross-process-safe fetching (multi-host);
@@ -114,10 +117,36 @@ class DeviceSolveResult:
             solution_fetch if solution_fetch is not None else solution_norm
         )
         self.norms = np.asarray(norms, np.float64)  # [B]
-        self.status = np.asarray(status)  # host
-        self.iterations = np.asarray(iterations)
-        self.convergence = np.asarray(convergence, np.float64)
+        # [3, B] fp32 device array (replicated in multi-host runs, so its
+        # materialization is a local D2H on any process); fetched once
+        self._packed = packed
+        self._scalars: Optional[tuple] = None
         self._host: Optional[np.ndarray] = None
+
+    def _fetch_scalars(self) -> tuple:
+        """Blocks until the solve completed; one D2H, cached. Scalars pack
+        as fp32 exactly: status (0/-1) and iterations (<= 2000) are small
+        integers; convergence was computed in the device dtype."""
+        if self._scalars is None:
+            packed = np.asarray(self._packed)
+            self._scalars = (
+                packed[0].astype(np.int32),
+                packed[1].astype(np.int32),
+                packed[2].astype(np.float64),
+            )
+        return self._scalars
+
+    @property
+    def status(self) -> np.ndarray:
+        return self._fetch_scalars()[0]
+
+    @property
+    def iterations(self) -> np.ndarray:
+        return self._fetch_scalars()[1]
+
+    @property
+    def convergence(self) -> np.ndarray:
+        return self._fetch_scalars()[2]
 
     def fetch_solutions(self) -> np.ndarray:
         """[B, nvoxel] fp64 physical-units solutions; one device fetch,
@@ -708,12 +737,10 @@ class DistributedSARTSolver:
             jnp.asarray(rescale, dtype),
         )
         sol_fetch = self._fetch_handle(res.solution)
-        packed = np.asarray(self._pack_fn(res.status, res.iterations,
-                                          res.convergence))  # ONE fetch
         return DeviceSolveResult(
             self, res.solution, norms,
-            packed[0].astype(np.int32), packed[1].astype(np.int32),
-            packed[2], solution_fetch=sol_fetch,
+            self._pack_fn(res.status, res.iterations, res.convergence),
+            solution_fetch=sol_fetch,
         )
 
     def solve_batch(
@@ -780,12 +807,10 @@ class DistributedSARTSolver:
         )
         if device_result:
             sol_fetch = self._fetch_handle(res.solution)
-            packed = np.asarray(self._pack_fn(res.status, res.iterations,
-                                              res.convergence))  # ONE fetch
             return DeviceSolveResult(
                 self, res.solution, norms,
-                packed[0].astype(np.int32), packed[1].astype(np.int32),
-                packed[2], solution_fetch=sol_fetch,
+                self._pack_fn(res.status, res.iterations, res.convergence),
+                solution_fetch=sol_fetch,
             )
         solution = _fetch(res.solution).astype(np.float64)[:, : self.nvoxel] * norms[:, None]
         return SolveResult(

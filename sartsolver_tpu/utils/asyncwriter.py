@@ -104,18 +104,27 @@ class AsyncSolutionWriter:
 
     def __exit__(self, *exc) -> None:
         if exc and exc[0] is not None:
-            # consumer failed: drop queued frames, let the in-flight write
-            # finish (the worker must be done before any other thread may
-            # touch the HDF5 file), close without masking the original
-            # exception
             self._closed = True
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
-            # sole producer + queue just drained => cannot block
-            self._queue.put(None)
+            if issubclass(exc[0], KeyboardInterrupt):
+                # user wants OUT: drop queued frames instead of running
+                # their lazy device fetches against a possibly wedged
+                # backend (--resume recomputes them); only the in-flight
+                # write finishes (the worker must be done before any
+                # other thread may touch the HDF5 file)
+                try:
+                    while True:
+                        self._queue.get_nowait()
+                except queue.Empty:
+                    pass
+                # sole producer + queue just drained => cannot block
+            # Other consumer failures: finish writing every already-queued
+            # frame — they are complete, ordered, contiguous results, so
+            # keeping them only saves --resume recompute time (the
+            # pipelined frame loop drains its in-flight group here on
+            # error paths) — then close, never masking the original
+            # exception with a writer error (a writer that itself failed
+            # has latched and writes nothing regardless).
+            self._queue.put(None)  # worker is alive and consuming
             self._thread.join()
             try:
                 self._writer.close()

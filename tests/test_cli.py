@@ -353,3 +353,32 @@ def test_int8_flag_combinations(world, tmp_path, capsys):
         fit = H @ v[i]
         ref = H @ (f_true * scales[i])
         assert np.abs(fit - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_pipelined_chain_drains_inflight_group_on_error(world, monkeypatch):
+    """A frame-read failure mid-run must not discard the already-solved
+    in-flight group: the pipelined loop (round 4) defers group k's write
+    until group k+1 dispatches, so the error path has to drain it. Here
+    the prefetcher yields the first 2 frames (= one full chain of 2) and
+    then dies; the run exits 1, but those 2 frames are in the file."""
+    import sartsolver_tpu.cli as cli_mod
+    from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+    paths, H, f_true, times, scales = world
+    orig_iter = FramePrefetcher.__iter__
+
+    def broken_iter(self):
+        it = orig_iter(self)
+        count = 0
+        for item in it:
+            if count >= 2:
+                raise OSError("simulated frame-read failure")
+            count += 1
+            yield item
+
+    monkeypatch.setattr(FramePrefetcher, "__iter__", broken_iter)
+    rc = run_cli(paths, "--chain_frames", "2")
+    assert rc == 1  # OSError -> polite input-error exit
+    with h5py.File(paths["output"], "r") as f:
+        assert f["solution/value"].shape[0] == 2
+        assert (f["solution/status"][:] == 0).all()
