@@ -597,3 +597,64 @@ class TestAsyncSolutionWriter:
             buf[:] = -99.0  # mutate after submission
         with h5py.File(out) as f:
             np.testing.assert_array_equal(f["solution/value"][0], np.ones(fx.NVOXEL))
+
+
+class TestAsyncWriterErrorExit:
+    """Round-4 exception-exit semantics: a consumer failure finishes
+    writing every already-queued frame (complete, ordered, contiguous —
+    dropping them only costs --resume recompute), while KeyboardInterrupt
+    drops the queue so no further blocking work runs on a possibly wedged
+    backend."""
+
+    def _writer_with_gate(self):
+        import threading
+
+        gate = threading.Event()
+        entered = threading.Event()
+
+        class GatedWriter:
+            def __init__(self):
+                self.added = []
+                self.closed = False
+
+            def add(self, *a):
+                entered.set()  # worker is now parked inside frame 0
+                gate.wait(10)
+                self.added.append(a)
+
+            def close(self):
+                self.closed = True
+
+        return GatedWriter(), gate, entered
+
+    def _run(self, exc_type):
+        import threading
+
+        import numpy as np
+
+        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+
+        inner, gate, entered = self._writer_with_gate()
+        w = AsyncSolutionWriter(inner)
+        for t in range(3):
+            w.add(np.zeros(4), 0, float(t), [float(t)])
+        # handshake: wait until the worker is parked INSIDE frame 0's add
+        # (frames 1-2 are definitely still queued), then let __exit__ make
+        # its keep-or-drop decision — its drain runs in microseconds, so
+        # a 2 s timer opening the gate cannot race it
+        assert entered.wait(10)
+        threading.Timer(2.0, gate.set).start()
+        w.__exit__(exc_type, exc_type(), None)
+        return inner
+
+    def test_generic_error_writes_queued_frames(self):
+        inner = self._run(OSError)
+        assert len(inner.added) == 3  # every queued frame written
+        assert [a[2] for a in inner.added] == [0.0, 1.0, 2.0]  # in order
+        assert inner.closed
+
+    def test_keyboard_interrupt_drops_queued_frames(self):
+        inner = self._run(KeyboardInterrupt)
+        # only the in-flight frame finishes; queued ones are dropped
+        assert len(inner.added) <= 1
+        assert inner.closed
