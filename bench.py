@@ -730,6 +730,11 @@ def main() -> int:
 
     n_planned = sum(1 for it in items if it["kind"] == "sweep")
     degraded = []
+    if not on_accel:
+        # a CPU fallback's vs_baseline is computed against a CPU-bandwidth
+        # roofline and is NOT comparable to the TPU records — without this
+        # marker a tunnel outage at round end could read as a better score
+        degraded.append("cpu fallback (no TPU backend reachable)")
     if len(ok) < n_planned:
         degraded.append(f"partial sweep ({len(ok)}/{n_planned} configs)")
     if fused_possible and head["fused"] == "off":

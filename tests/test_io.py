@@ -630,8 +630,6 @@ class TestAsyncWriterErrorExit:
     def _run(self, exc_type):
         import threading
 
-        import numpy as np
-
         from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
 
         inner, gate, entered = self._writer_with_gate()
