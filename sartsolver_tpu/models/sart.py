@@ -393,7 +393,8 @@ def solve_normalized(
 
 
 _SOLVER_STATIC_ARGS = (
-    "opts", "axis_name", "voxel_axis", "use_guess", "_vmem_raised"
+    "opts", "axis_name", "voxel_axis", "use_guess", "return_fitted",
+    "_vmem_raised",
 )
 
 
@@ -422,8 +423,10 @@ def solve_normalized_batch(
     axis_name=None,
     voxel_axis=None,
     use_guess: bool,
+    fitted0: Optional[Array] = None,
+    return_fitted: bool = False,
     _vmem_raised: bool = False,
-) -> SolveResult:
+) -> "SolveResult | Tuple[SolveResult, Array]":
     """Batched solver core: B independent frames in one while_loop.
 
     The reference solves frames strictly one at a time (main.cpp:131-140),
@@ -437,14 +440,23 @@ def solve_normalized_batch(
     freezes (its update is masked out) while the rest continue, so results
     match frame-by-frame solves exactly. Intended for ``--no_guess``
     workloads, where frames carry no warm-start dependency.
+
+    ``fitted0`` (valid only with ``use_guess=False``): the caller already
+    knows ``H @ f0`` — e.g. a warm start carried from a previous solve,
+    whose loop exited with exactly this product — so the pre-loop setup
+    forward projection (one full HBM read of the RTM, the reference's
+    per-frame ``cublasSgemv`` setup, sartsolver_cuda.cpp:185-189) is
+    skipped. ``return_fitted=True`` additionally returns the loop-exit
+    ``fitted == H @ solution`` as ``(SolveResult, fitted [B, P_local])``
+    for the caller to carry forward.
     """
     kwargs = dict(
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
-        use_guess=use_guess,
+        use_guess=use_guess, fitted0=fitted0, return_fitted=return_fitted,
     )
     if any(
         isinstance(leaf, jax.core.Tracer)
-        for leaf in jax.tree_util.tree_leaves((problem, g, msq, f0))
+        for leaf in jax.tree_util.tree_leaves((problem, g, msq, f0, fitted0))
     ):
         # Some input is being traced by an outer jit/shard_map: inline the
         # core; compiler options belong on the outermost jit there. Only a
@@ -488,8 +500,9 @@ def solve_chain_normalized(
     axis_name=None,
     voxel_axis=None,
     use_guess_first: bool,
+    fitted0: Optional[Array] = None,
     _vmem_raised: bool = False,
-) -> SolveResult:
+) -> Tuple[SolveResult, Array]:
     """K warm-chained frames in ONE device program.
 
     The reference's core workload is the serial warm-started frame loop
@@ -503,47 +516,71 @@ def solve_chain_normalized(
     semantics identical to K separate solves by construction, one packed
     scalar fetch for the whole chain.
 
+    The scan also carries each frame's loop-exit ``fitted == H @ f_final``
+    into the next frame's setup (rescaled alongside the solution), so a
+    warm frame's iteration loop streams the RTM exactly once per iteration
+    with NO per-frame setup sweep — the reference pays a full ``Sgemv``
+    setup per frame (sartsolver_cuda.cpp:185-189). ``fitted0`` seeds frame
+    0's product when the caller chains from a previous result (same
+    contract as ``_solve_normalized_batch_impl``).
+
     ``rescale[k]`` converts the carry between per-frame normalizations
     (``norm_{k-1}/norm_k``; ``rescale[0]`` rescales the incoming seed).
-    Returns a ``SolveResult`` with a leading K axis; ``solution[-1]`` is
-    the device-resident warm start for a following chain.
+    Returns ``(SolveResult with a leading K axis, fitted [1, P_local] of
+    the last frame)``; ``solution[-1]`` + the returned fitted are the
+    device-resident warm start for a following chain.
     """
     impl = functools.partial(
         _solve_normalized_batch_impl,
         problem,
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
-        _vmem_raised=_vmem_raised,
+        return_fitted=True, _vmem_raised=_vmem_raised,
     )
     K = g.shape[0]
+    if use_guess_first and fitted0 is not None:
+        # mirror _solve_normalized_batch_impl's guard: a stale carried
+        # product alongside a fresh Eq. 4 guess is a caller bug, not
+        # something to drop silently
+        raise ValueError(
+            "fitted0 carries a warm start's forward projection; it cannot "
+            "be combined with use_guess_first=True."
+        )
     if use_guess_first:
-        res0 = impl(g[0][None], msq[0:1], jnp.zeros_like(f0), use_guess=True)
+        res0, fit0 = impl(
+            g[0][None], msq[0:1], jnp.zeros_like(f0), use_guess=True
+        )
     else:
-        res0 = impl(
+        res0, fit0 = impl(
             g[0][None], msq[0:1], f0 * rescale[0].astype(f0.dtype),
             use_guess=False,
+            fitted0=(None if fitted0 is None
+                     else fitted0 * rescale[0].astype(fitted0.dtype)),
         )
     if K == 1:
-        return res0
+        return res0, fit0
 
     def step(carry, xs):
+        sol_c, fit_c = carry
         g_k, msq_k, r_k = xs
-        res = impl(
-            g_k[None], msq_k[None], carry * r_k.astype(carry.dtype),
-            use_guess=False,
+        res, fit = impl(
+            g_k[None], msq_k[None], sol_c * r_k.astype(sol_c.dtype),
+            use_guess=False, fitted0=fit_c * r_k.astype(fit_c.dtype),
         )
         out = SolveResult(
             res.solution[0], res.status[0], res.iterations[0],
             res.convergence[0],
         )
-        return res.solution, out
+        return (res.solution, fit), out
 
-    _, rest = lax.scan(step, res0.solution, (g[1:], msq[1:], rescale[1:]))
+    (_, fit_last), rest = lax.scan(
+        step, (res0.solution, fit0), (g[1:], msq[1:], rescale[1:])
+    )
     return SolveResult(
         jnp.concatenate([res0.solution, rest.solution], axis=0),
         jnp.concatenate([res0.status, rest.status]),
         jnp.concatenate([res0.iterations, rest.iterations]),
         jnp.concatenate([res0.convergence, rest.convergence]),
-    )
+    ), fit_last
 
 
 def _solve_normalized_batch_impl(
@@ -556,8 +593,10 @@ def _solve_normalized_batch_impl(
     axis_name=None,
     voxel_axis=None,
     use_guess: bool,
+    fitted0: Optional[Array] = None,
+    return_fitted: bool = False,
     _vmem_raised: bool = False,
-) -> SolveResult:
+) -> "SolveResult | Tuple[SolveResult, Array]":
     dtype = jnp.dtype(opts.dtype)
     rtm = problem.rtm
     B = g.shape[0]
@@ -611,6 +650,12 @@ def _solve_normalized_batch_impl(
             return int8_forward_project(rtm, scale, f_, accum_dtype=dtype)
         return forward_project(rtm, f_, accum_dtype=dtype)
 
+    if fitted0 is not None and use_guess:
+        raise ValueError(
+            "fitted0 carries a warm start's forward projection; it cannot "
+            "be combined with use_guess=True (the Eq. 4 guess is computed "
+            "here, so its projection must be too)."
+        )
     if use_guess:
         # f0 = H^T g / rho on unmasked voxels (Eq. 4; sartsolver.cpp:144-159);
         # the device path excludes negative measurements (sart_kernels.cu:34),
@@ -618,19 +663,45 @@ def _solve_normalized_batch_impl(
         g_guess = jnp.where(g > 0, g, 0) if opts.mask_negative_guess else g
         accum = _psum(bp_any(g_guess), axis_name)
         f0 = jnp.where(vmask[None, :], accum / safe_dens[None, :], 0)
-    if opts.guess_floor > 0:
-        # CUDA path floors *any* starting solution at 1e-7 for both variants
-        # (sartsolver_cuda.cpp:180); CPU log path floors at 1e-100
-        # (sartsolver.cpp:263); CPU linear path does not floor.
-        f0 = jnp.maximum(f0, _tiny(opts.guess_floor, dtype))
-    if opts.logarithmic:
-        # The log path must floor unconditionally (both reference backends
-        # do): a zero voxel would give log(0) = -inf in the penalty and can
-        # never recover under the multiplicative update.
-        f0 = jnp.maximum(f0, _tiny(max(opts.guess_floor, opts.log_epsilon), dtype))
+    if fitted0 is None or opts.logarithmic:
+        # Linear carried warm starts (fitted0 supplied) skip this floor:
+        # the floor guards arbitrary user seeds, while a carried start is
+        # this solver's own loop-exit solution, and flooring it would break
+        # the exact ``fitted0 == H @ f0`` consistency of the carried pair
+        # (shifting near-stall stop iterations for nothing) — the linear
+        # update handles exact zeros fine (additive, clamped at 0). The
+        # LOG variant keeps the full floor even for carried starts: its
+        # multiplicative update can drive a voxel toward fp32 underflow,
+        # and entering at 1e-38 instead of 1e-7 would put ``log(0) = -inf``
+        # a few shrinking iterations away; the resulting (f0, fitted0)
+        # inconsistency is bounded by ``floor * ||H||_col`` on iteration
+        # 1's residual only (the loop recomputes fitted every iteration).
+        if opts.guess_floor > 0:
+            # CUDA path floors *any* starting solution at 1e-7 for both
+            # variants (sartsolver_cuda.cpp:180); CPU log path floors at
+            # 1e-100 (sartsolver.cpp:263); CPU linear path does not floor.
+            f0 = jnp.maximum(f0, _tiny(opts.guess_floor, dtype))
+        if opts.logarithmic:
+            # The log path must floor unconditionally (both reference
+            # backends do): a zero voxel would give log(0) = -inf in the
+            # penalty and can never recover under the multiplicative update.
+            f0 = jnp.maximum(
+                f0, _tiny(max(opts.guess_floor, opts.log_epsilon), dtype)
+            )
     f0 = f0.astype(dtype)
 
-    fitted0 = _psum(fp_any(f0), voxel_axis)
+    if fitted0 is None:
+        fitted0 = _psum(fp_any(f0), voxel_axis)
+    else:
+        # Warm-start carry: the previous solve's loop exited with exactly
+        # ``fitted == H @ f_final``, and a warm start is a scalar rescale of
+        # ``f_final``, so the caller rescales that product instead of paying
+        # this frame's setup sweep — one fewer full HBM read of the RTM per
+        # warm frame. Linear: the skipped guess floor above keeps the
+        # (f0, fitted0) pair exactly consistent (rescale reassociation
+        # aside, ~1 ulp). Log: the kept floor bounds the inconsistency at
+        # ``floor * ||H||_col`` on iteration 1's residual (see above).
+        fitted0 = fitted0.astype(dtype)
 
     beta = jnp.asarray(opts.beta_laplace, dtype)
     tol = jnp.asarray(opts.conv_tolerance, dtype)
@@ -788,9 +859,10 @@ def _solve_normalized_batch_impl(
         f0, fitted0, jnp.zeros(B, dtype), jnp.asarray(0, jnp.int32),
         jnp.zeros(B, bool), jnp.full(B, opts.max_iterations, jnp.int32),
     )
-    f, _, conv, it, done, iters = lax.while_loop(cond, body, init)
+    f, fitted_fin, conv, it, done, iters = lax.while_loop(cond, body, init)
     status = jnp.where(done, SUCCESS, MAX_ITERATIONS_EXCEEDED).astype(jnp.int32)
-    return SolveResult(f, status, iters, conv)
+    res = SolveResult(f, status, iters, conv)
+    return (res, fitted_fin) if return_fitted else res
 
 
 def prepare_measurement(measurement, opts: SolverOptions):

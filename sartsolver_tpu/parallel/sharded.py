@@ -108,9 +108,13 @@ class DeviceSolveResult:
     """
 
     def __init__(self, solver, solution_norm, norms, packed,
-                 solution_fetch=None):
+                 solution_fetch=None, fitted_norm=None):
         self._solver = solver
         self.solution_norm = solution_norm  # [B, padded_nvoxel] fp32, device
+        # loop-exit ``H @ solution_norm`` ([B or 1, padded_npixel], device,
+        # P('pixels')-sharded): carried into the next warm-started solve so
+        # it skips its setup forward projection (models/sart fitted0)
+        self.fitted_norm = fitted_norm
         # replicated copy for cross-process-safe fetching (multi-host);
         # same array as solution_norm on a single process
         self._solution_fetch = (
@@ -490,37 +494,13 @@ class DistributedSARTSolver:
             laplacian=ShardedLaplacian(*(a[0] for a in lap))
         )
 
-    def _batch_fn(self, use_guess: bool):
-        """Compiled batched solve over the mesh (one program per use_guess;
-        XLA re-specializes per batch size on call)."""
-        if use_guess not in self._solve_fns:
-            opts = self.opts
-            pixel_axis = self._pixel_axis
-            voxel_axis = self._voxel_axis
-            options = self._compiler_options()
-            vmem_raised = options is not None
-
-            def run(problem, g, msq, f0):
-                return solve_normalized_batch(
-                    self._drop_lap_shard_dim(problem), g, msq, f0,
-                    opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
-                    use_guess=use_guess, _vmem_raised=vmem_raised,
-                )
-
-            fn = jax.shard_map(
-                run,
-                mesh=self.mesh,
-                in_specs=(self._problem_spec(), P(None, PIXEL_AXIS), P(), P(None, VOXEL_AXIS)),
-                out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
-                check_vma=False,
-            )
-            self._solve_fns[use_guess] = jax.jit(fn, compiler_options=options)
-        return self._solve_fns[use_guess]
-
-    def _chain_fn(self, use_guess_first: bool):
-        """Compiled K-frame warm chain over the mesh (lax.scan over frames
-        with the while_loop inside; models/sart.solve_chain_normalized)."""
-        key = ("chain", use_guess_first)
+    def _batch_fn(self, use_guess: bool, with_fitted0: bool = False):
+        """Compiled batched solve over the mesh (one program per
+        (use_guess, with_fitted0); XLA re-specializes per batch size on
+        call). Every variant returns ``(SolveResult, fitted)`` so the
+        loop-exit forward projection is available to chain into the next
+        warm-started solve."""
+        key = (use_guess, with_fitted0)
         if key not in self._solve_fns:
             opts = self.opts
             pixel_axis = self._pixel_axis
@@ -528,11 +508,52 @@ class DistributedSARTSolver:
             options = self._compiler_options()
             vmem_raised = options is not None
 
-            def run(problem, g, msq, f0, rescale):
+            def run(problem, g, msq, f0, *fitted0):
+                return solve_normalized_batch(
+                    self._drop_lap_shard_dim(problem), g, msq, f0,
+                    opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
+                    use_guess=use_guess,
+                    fitted0=fitted0[0] if with_fitted0 else None,
+                    return_fitted=True, _vmem_raised=vmem_raised,
+                )
+
+            fn = jax.shard_map(
+                run,
+                mesh=self.mesh,
+                in_specs=(
+                    self._problem_spec(), P(None, PIXEL_AXIS), P(),
+                    P(None, VOXEL_AXIS),
+                    *((P(None, PIXEL_AXIS),) if with_fitted0 else ()),
+                ),
+                out_specs=(
+                    SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
+                    P(None, PIXEL_AXIS),
+                ),
+                check_vma=False,
+            )
+            self._solve_fns[key] = jax.jit(fn, compiler_options=options)
+        return self._solve_fns[key]
+
+    def _chain_fn(self, use_guess_first: bool, with_fitted0: bool = False):
+        """Compiled K-frame warm chain over the mesh (lax.scan over frames
+        with the while_loop inside; models/sart.solve_chain_normalized).
+        Returns ``(SolveResult, last frame's fitted)`` — the fitted rides
+        the scan carry, so warm frames skip their setup sweep."""
+        key = ("chain", use_guess_first, with_fitted0)
+        if key not in self._solve_fns:
+            opts = self.opts
+            pixel_axis = self._pixel_axis
+            voxel_axis = self._voxel_axis
+            options = self._compiler_options()
+            vmem_raised = options is not None
+
+            def run(problem, g, msq, f0, rescale, *fitted0):
                 return solve_chain_normalized(
                     self._drop_lap_shard_dim(problem), g, msq, f0, rescale,
                     opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
-                    use_guess_first=use_guess_first, _vmem_raised=vmem_raised,
+                    use_guess_first=use_guess_first,
+                    fitted0=fitted0[0] if with_fitted0 else None,
+                    _vmem_raised=vmem_raised,
                 )
 
             fn = jax.shard_map(
@@ -541,8 +562,12 @@ class DistributedSARTSolver:
                 in_specs=(
                     self._problem_spec(), P(None, PIXEL_AXIS), P(),
                     P(None, VOXEL_AXIS), P(),
+                    *((P(None, PIXEL_AXIS),) if with_fitted0 else ()),
                 ),
-                out_specs=SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
+                out_specs=(
+                    SolveResult(P(None, VOXEL_AXIS), P(), P(), P()),
+                    P(None, PIXEL_AXIS),
+                ),
                 check_vma=False,
             )
             self._solve_fns[key] = jax.jit(fn, compiler_options=options)
@@ -706,6 +731,12 @@ class DistributedSARTSolver:
         frame carries over, staying on device), else from host ``f0``,
         else from the Eq. 4 initial guess. Returns a
         :class:`DeviceSolveResult` over the K frames.
+
+        Warm-started frames also inherit the previous frame's loop-exit
+        ``fitted == H @ f`` (rescaled alongside the solution, both inside
+        the chain's scan and across ``warm=`` handoffs), skipping the
+        per-frame setup forward projection — one full RTM read saved per
+        warm frame (models/sart fitted0 docs).
         """
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
@@ -724,23 +755,33 @@ class DistributedSARTSolver:
         rescale = np.ones(K)
         rescale[1:] = norms[:-1] / norms[1:]
         use_guess_first = f0 is None and warm is None
+        fitted0_dev = None
         if warm is not None:
             rescale[0] = warm.norms[-1] / norms[0]
             f0_dev = self._last_row_fn(warm.solution_norm)
+            if (warm.fitted_norm is not None
+                    and warm.fitted_norm.shape[-1] == self.padded_npixel):
+                # pixel-geometry mismatch (a warm result from a solver with
+                # the same voxel layout but different measurement extent)
+                # falls back to recomputing the setup sweep, like solve_batch
+                fitted0_dev = self._last_row_fn(warm.fitted_norm)
         else:
             f0_np = np.zeros((1, self.padded_nvoxel), dtype)
             if f0 is not None:
                 f0_np[0, : self.nvoxel] = np.asarray(f0, np.float64) / norms[0]
             f0_dev = _stage(f0_np, self.mesh, P(None, VOXEL_AXIS))
-        res = self._chain_fn(use_guess_first)(
+        res, fitted_fin = self._chain_fn(
+            use_guess_first, with_fitted0=fitted0_dev is not None
+        )(
             self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev,
             jnp.asarray(rescale, dtype),
+            *(() if fitted0_dev is None else (fitted0_dev,)),
         )
         sol_fetch = self._fetch_handle(res.solution)
         return DeviceSolveResult(
             self, res.solution, norms,
             self._pack_fn(res.status, res.iterations, res.convergence),
-            solution_fetch=sol_fetch,
+            solution_fetch=sol_fetch, fitted_norm=fitted_fin,
         )
 
     def solve_batch(
@@ -782,6 +823,7 @@ class DistributedSARTSolver:
         B = G.shape[0]
         g_dev, norms, msqs = self._stage_frames(G, local)
         use_guess = f0 is None and warm is None
+        fitted0_dev = None
         if warm is not None:
             if warm.solution_norm.shape != (B, self.padded_nvoxel):
                 raise ValueError(
@@ -796,21 +838,33 @@ class DistributedSARTSolver:
             f0_dev = self._rescale_fn(
                 warm.solution_norm, jnp.asarray(scale, dtype)
             )
+            if (warm.fitted_norm is not None
+                    and warm.fitted_norm.shape
+                    == (B, self.padded_npixel)):
+                # carried loop-exit H @ f — skips this solve's setup sweep;
+                # a shape mismatch (e.g. a chain result, which keeps only
+                # its last frame's fitted) falls back to recomputing
+                fitted0_dev = self._rescale_fn(
+                    warm.fitted_norm, jnp.asarray(scale, dtype)
+                )
         else:
             f0_np = np.zeros((B, self.padded_nvoxel), dtype)
             if not use_guess:
                 f0_np[:, : self.nvoxel] = np.asarray(f0, np.float64) / norms[:, None]
             f0_dev = _stage(f0_np, self.mesh, P(None, VOXEL_AXIS))
 
-        res = self._batch_fn(use_guess)(
-            self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev
+        res, fitted_fin = self._batch_fn(
+            use_guess, with_fitted0=fitted0_dev is not None
+        )(
+            self.problem, g_dev, jnp.asarray(msqs, dtype), f0_dev,
+            *(() if fitted0_dev is None else (fitted0_dev,)),
         )
         if device_result:
             sol_fetch = self._fetch_handle(res.solution)
             return DeviceSolveResult(
                 self, res.solution, norms,
                 self._pack_fn(res.status, res.iterations, res.convergence),
-                solution_fetch=sol_fetch,
+                solution_fetch=sol_fetch, fitted_norm=fitted_fin,
             )
         solution = _fetch(res.solution).astype(np.float64)[:, : self.nvoxel] * norms[:, None]
         return SolveResult(

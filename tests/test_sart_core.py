@@ -407,3 +407,132 @@ class TestRelaxationSchedule:
             SolverOptions(relaxation_decay=0.0)
         with pytest.raises(ValueError, match="relaxation_decay"):
             SolverOptions(relaxation_decay=1.5)
+
+
+class TestFittedCarry:
+    """The warm-start fitted carry (models/sart fitted0/return_fitted):
+    warm frames skip their setup forward projection by reusing the
+    previous loop's exit product ``fitted == H @ f_final``."""
+
+    def _run(self, fitted0=None, return_fitted=False, use_guess=False,
+             logarithmic=False, seed=30):
+        import jax.numpy as jnp
+        from sartsolver_tpu.models.sart import solve_normalized_batch
+
+        H, g, _ = make_case(seed=seed)
+        opts = SolverOptions(
+            max_iterations=12, conv_tolerance=1e-10, logarithmic=logarithmic
+        )
+        problem = make_problem(H.astype(np.float32), opts=opts)
+        gn = np.where(g > 0, g, -1.0)
+        norm = gn.max()
+        msq = np.sum(np.where(gn > 0, gn, 0.0) ** 2) / norm**2
+        g_dev = jnp.asarray((gn / norm)[None, :], jnp.float32)
+        f0 = jnp.full((1, H.shape[1]), 0.4, jnp.float32)
+        return problem, dict(
+            g=g_dev, msq=jnp.asarray([msq], jnp.float32), f0=f0,
+            opts=opts, axis_name=None, voxel_axis=None,
+            use_guess=use_guess, fitted0=fitted0,
+            return_fitted=return_fitted,
+        )
+
+    @pytest.mark.parametrize("logarithmic", [False, True])
+    def test_exit_fitted_is_forward_projection(self, logarithmic):
+        from sartsolver_tpu.models.sart import solve_normalized_batch
+
+        problem, kw = self._run(return_fitted=True, logarithmic=logarithmic)
+        res, fitted = solve_normalized_batch(problem, kw.pop("g"),
+                                             kw.pop("msq"), kw.pop("f0"), **kw)
+        H32 = np.asarray(problem.rtm, np.float32)
+        np.testing.assert_allclose(
+            np.asarray(fitted)[0], H32 @ np.asarray(res.solution)[0],
+            rtol=2e-5, atol=1e-6,
+        )
+
+    @pytest.mark.parametrize("logarithmic", [False, True])
+    def test_supplied_fitted0_reproduces_default_bitwise(self, logarithmic):
+        """Passing the exact product the impl would compute must give a
+        bit-identical solve — the carry changes WHERE the setup product
+        comes from, never the loop's math."""
+        from sartsolver_tpu.models.sart import solve_normalized_batch
+        from sartsolver_tpu.ops.projection import forward_project
+
+        problem, kw = self._run(logarithmic=logarithmic)
+        g, msq, f0 = kw.pop("g"), kw.pop("msq"), kw.pop("f0")
+        base = solve_normalized_batch(problem, g, msq, f0, **kw)
+        # f0 = 0.4 everywhere sits above every floor, so the base path's
+        # guess floor is a no-op and the carried path (which skips floors
+        # by contract) starts from the identical f0 — the two runs must
+        # then be bit-identical, pinning that fitted0 only changes WHERE
+        # the setup product comes from, never the loop's math
+        kw["fitted0"] = forward_project(
+            problem.rtm, f0, accum_dtype=np.float32
+        )
+        carried = solve_normalized_batch(problem, g, msq, f0, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(carried.solution), np.asarray(base.solution))
+        assert int(carried.iterations[0]) == int(base.iterations[0])
+        assert int(carried.status[0]) == int(base.status[0])
+
+    def test_carried_start_skips_guess_floor(self):
+        """A carried warm start enters unfloored (exact zeros preserved),
+        bit-matching a guess_floor=0 recompute run — the floor guards
+        arbitrary user seeds, not the solver's own loop-exit solutions."""
+        import dataclasses
+        import jax.numpy as jnp
+        from sartsolver_tpu.models.sart import solve_normalized_batch
+        from sartsolver_tpu.ops.projection import forward_project
+
+        problem, kw = self._run()
+        g, msq, _ = kw.pop("g"), kw.pop("msq"), kw.pop("f0")
+        f0 = jnp.full((1, np.asarray(problem.rtm).shape[1]), 0.4, jnp.float32)
+        f0 = f0.at[0, :5].set(0.0)  # clamp-produced exact zeros
+        assert kw["opts"].guess_floor > 0  # the default path WOULD floor
+        kw["fitted0"] = forward_project(problem.rtm, f0,
+                                        accum_dtype=jnp.float32)
+        carried = solve_normalized_batch(problem, g, msq, f0, **kw)
+
+        kw_nf = dict(kw, fitted0=None,
+                     opts=dataclasses.replace(kw["opts"], guess_floor=0.0))
+        base = solve_normalized_batch(problem, g, msq, f0, **kw_nf)
+        np.testing.assert_array_equal(
+            np.asarray(carried.solution), np.asarray(base.solution))
+        assert int(carried.iterations[0]) == int(base.iterations[0])
+
+    def test_fitted0_with_use_guess_rejected(self):
+        import jax.numpy as jnp
+        from sartsolver_tpu.models.sart import solve_normalized_batch
+
+        problem, kw = self._run(use_guess=True)
+        kw["fitted0"] = jnp.zeros((1, np.asarray(problem.rtm).shape[0]),
+                                  jnp.float32)
+        with pytest.raises(ValueError, match="use_guess"):
+            solve_normalized_batch(problem, kw.pop("g"), kw.pop("msq"),
+                                   kw.pop("f0"), **kw)
+
+    def test_carry_skips_setup_sweep_in_hlo(self):
+        """The carried variant's lowered HLO must contain exactly one fewer
+        dot_general than the recomputed variant (the setup forward
+        projection) — pins that the carry actually removes the RTM read."""
+        import jax
+        from sartsolver_tpu.models.sart import _solve_normalized_batch_impl
+
+        problem, kw = self._run()
+        g, msq, f0 = kw.pop("g"), kw.pop("msq"), kw.pop("f0")
+        kw.pop("fitted0"), kw.pop("return_fitted")
+
+        def count(fitted0):
+            args = (problem, g, msq, f0) + (
+                () if fitted0 is None else (fitted0,))
+
+            def fn(problem, g, msq, f0, *rest):
+                return _solve_normalized_batch_impl(
+                    problem, g, msq, f0,
+                    fitted0=rest[0] if rest else None, **kw)
+
+            return jax.jit(fn).lower(*args).as_text().count("dot_general")
+
+        import jax.numpy as jnp
+        fitted0 = jnp.ones((1, np.asarray(problem.rtm).shape[0]), jnp.float32)
+        n_recompute, n_carried = count(None), count(fitted0)
+        assert n_carried == n_recompute - 1, (n_recompute, n_carried)
