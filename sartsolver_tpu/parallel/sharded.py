@@ -759,11 +759,12 @@ class DistributedSARTSolver:
         if warm is not None:
             rescale[0] = warm.norms[-1] / norms[0]
             f0_dev = self._last_row_fn(warm.solution_norm)
-            if (warm.fitted_norm is not None
-                    and warm.fitted_norm.shape[-1] == self.padded_npixel):
-                # pixel-geometry mismatch (a warm result from a solver with
-                # the same voxel layout but different measurement extent)
-                # falls back to recomputing the setup sweep, like solve_batch
+            if warm._solver is self and warm.fitted_norm is not None:
+                # the carried product is H @ f for THIS solver's matrix —
+                # a warm result from a different solver (legitimate as a
+                # solution seed: any f0 is just an initial guess) must
+                # recompute its setup sweep instead of injecting a stale
+                # H_other @ f
                 fitted0_dev = self._last_row_fn(warm.fitted_norm)
         else:
             f0_np = np.zeros((1, self.padded_nvoxel), dtype)
@@ -838,12 +839,15 @@ class DistributedSARTSolver:
             f0_dev = self._rescale_fn(
                 warm.solution_norm, jnp.asarray(scale, dtype)
             )
-            if (warm.fitted_norm is not None
+            if (warm._solver is self
+                    and warm.fitted_norm is not None
                     and warm.fitted_norm.shape
                     == (B, self.padded_npixel)):
-                # carried loop-exit H @ f — skips this solve's setup sweep;
-                # a shape mismatch (e.g. a chain result, which keeps only
-                # its last frame's fitted) falls back to recomputing
+                # carried loop-exit H @ f — skips this solve's setup sweep.
+                # Only valid for THIS solver's matrix (a foreign warm result
+                # is a legitimate solution seed but its fitted belongs to a
+                # different H); a shape mismatch (e.g. a chain result, which
+                # keeps only its last frame's fitted) also recomputes
                 fitted0_dev = self._rescale_fn(
                     warm.fitted_norm, jnp.asarray(scale, dtype)
                 )

@@ -524,3 +524,32 @@ def test_close_releases_device_memory():
         res2 = s2.solve(g)
     np.testing.assert_allclose(res2.solution, res.solution, rtol=1e-12)
     assert s2.problem is None
+
+
+def test_foreign_warm_result_recomputes_fitted():
+    """A warm result from a DIFFERENT solver (same shapes, different RTM)
+    is a legitimate solution seed, but its carried fitted belongs to the
+    other matrix — the receiving solver must recompute its setup sweep
+    (guarded by `warm._solver is self`), matching the host-f0 path."""
+    H_a, g, _ = make_case(seed=40, P=48, V=32)
+    H_b = H_a * 1.7 + 0.05  # different matrix, same shape
+    opts = SolverOptions(max_iterations=10, conv_tolerance=1e-12)
+    solver_a = DistributedSARTSolver(H_a, opts=opts, mesh=make_mesh(8))
+    solver_b = DistributedSARTSolver(H_b, opts=opts, mesh=make_mesh(8))
+
+    res_a = solver_a.solve_batch(g[None], device_result=True)
+    assert res_a.fitted_norm is not None
+    cross = solver_b.solve_batch(g[None] * 1.1, device_result=True,
+                                 warm=res_a)
+    ref = solver_b.solve_batch(g[None] * 1.1,
+                               f0=res_a.fetch_solutions(),
+                               device_result=True)
+    assert int(cross.status[0]) == int(ref.status[0])
+    # both recompute fitted from their (floored) f0 — a foreign warm has
+    # fitted0=None, so no floor is skipped; the only difference is the
+    # fp64 host round trip vs the device rescale of the seed — solutions
+    # agree to fp32 tolerance
+    np.testing.assert_allclose(
+        cross.fetch_solutions()[0], ref.fetch_solutions()[0],
+        rtol=2e-4, atol=1e-5,
+    )
