@@ -192,3 +192,38 @@ def test_two_pass_ingest_matches_device_quantization(tmp_path):
     ra, rb = pre.solve(g), dev.solve(g)
     np.testing.assert_allclose(np.asarray(ra.solution),
                                np.asarray(rb.solution), rtol=1e-5, atol=1e-7)
+
+
+def test_int8_chain_matches_per_frame():
+    """solve_chain on int8 storage (interpret kernel) must reproduce the
+    per-frame warm dispatch exactly — same statuses, iterations and
+    solutions — including the carried fitted (which for int8 is the
+    fused kernel's exact-dequant product, NOT the integer-projection
+    approximation the recompute path would use; both chain and per-frame
+    paths carry, so they stay identical)."""
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    H, g = _case()
+    opts = SolverOptions(
+        max_iterations=12, conv_tolerance=1e-10,
+        rtm_dtype="int8", fused_sweep="interpret",
+    )
+    solver = DistributedSARTSolver(H, None, opts=opts, mesh=make_mesh(1, 1))
+    frames = np.stack([g, g * 1.15, g * 0.85])
+
+    refs = []
+    warm = None
+    for k in range(frames.shape[0]):
+        warm = solver.solve_batch(frames[k][None], device_result=True,
+                                  warm=warm)
+        refs.append(warm)
+
+    chained = solver.solve_chain(frames)
+    for k, ref in enumerate(refs):
+        assert int(chained.status[k]) == int(ref.status[0]), k
+        assert int(chained.iterations[k]) == int(ref.iterations[0]), k
+        np.testing.assert_allclose(
+            chained.fetch_solutions()[k], ref.fetch_solutions()[0],
+            rtol=2e-6, atol=1e-8, err_msg=f"frame {k}",
+        )
