@@ -382,3 +382,58 @@ def test_pipelined_chain_drains_inflight_group_on_error(world, monkeypatch):
     with h5py.File(paths["output"], "r") as f:
         assert f["solution/value"].shape[0] == 2
         assert (f["solution/status"][:] == 0).all()
+
+
+def test_minimal_cache_sizes_match_default(world):
+    """--max_cached_frames 1 --max_cached_solutions 1 forces the image
+    block cache to evict every frame and the writer to flush per frame
+    (solution.cpp:55 cadence at its minimum) — outputs must be identical
+    to the default cache sizes."""
+    paths, H, f_true, times, scales = world
+    assert run_cli(paths) == 0
+    with h5py.File(paths["output"], "r") as f:
+        ref_value = f["solution/value"][:]
+        ref_status = f["solution/status"][:]
+
+    assert run_cli(paths, "--max_cached_frames", "1",
+                   "--max_cached_solutions", "1") == 0
+    with h5py.File(paths["output"], "r") as f:
+        np.testing.assert_array_equal(f["solution/value"][:], ref_value)
+        np.testing.assert_array_equal(f["solution/status"][:], ref_status)
+
+
+def test_debug_nans_clean_run(world):
+    """--debug_nans on a healthy solve completes normally (the flag turns
+    on jax_debug_nans; a clean pipeline must not trip it)."""
+    import jax
+
+    paths, *_ = world
+    try:
+        assert run_cli(paths, "--debug_nans", "-m", "20") == 0
+    finally:
+        jax.config.update("jax_debug_nans", False)  # don't leak to other tests
+
+
+def test_profile_dir_writes_trace(world, tmp_path):
+    """--profile_dir wraps the frame loop in jax.profiler.trace and leaves
+    a trace artifact behind."""
+    import os
+
+    paths, *_ = world
+    prof = str(tmp_path / "prof")
+    assert run_cli(paths, "--profile_dir", prof, "-m", "10") == 0
+    found = []
+    for root, _dirs, files in os.walk(prof):
+        found += files
+    assert found, "profiler trace directory is empty"
+
+
+def test_timing_summary_printed(world, capsys):
+    paths, *_ = world
+    assert run_cli(paths, "--timing", "-m", "10") == 0
+    out = capsys.readouterr().out
+    assert "timing summary" in out
+    assert "ingest RTM + upload" in out
+    # fused-path provenance line (VERDICT r3 next #4); the fp64 CPU
+    # profile never fuses, so 'off'/'not traced' variants are acceptable
+    assert "fused sweep: requested=" in out

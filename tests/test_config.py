@@ -107,3 +107,23 @@ def test_conv_tolerance_zero_disables_early_stop():
     res = solve(make_problem(H, None, opts=opts), g, opts=opts)
     assert int(res.iterations) == 7
     assert int(res.status) == MAX_ITERATIONS_EXCEEDED
+
+
+class TestPhaseTimer:
+    def test_accumulates_and_formats(self):
+        from sartsolver_tpu.utils.timing import PhaseTimer
+
+        t = PhaseTimer()
+        t.add("ingest", 1.5)
+        t.add("solve", 0.25)
+        t.add("solve", 0.35)
+        out = t.summary()
+        assert out.startswith("timing summary")
+        assert "ingest" in out and "1500.0 ms" in out
+        # multi-hit phases report the total and the per-hit average
+        assert "600.0 ms" in out and "300.0 ms avg over 2" in out
+
+    def test_empty(self):
+        from sartsolver_tpu.utils.timing import PhaseTimer
+
+        assert "no phases" in PhaseTimer().summary()

@@ -467,3 +467,25 @@ def test_two_process_resume(world, tmp_path):
     assert outs[0].count("Processed in:") == len(times) - n_first
     with h5py.File(mp_out, "r") as f:
         assert f["solution/value"].shape[0] == len(times)
+
+
+def test_two_process_parallel_read_matches_serialized(world, tmp_path):
+    """--parallel_read (all hosts read their stripes at once, the
+    reference's arguments.cpp:164-167) must produce the same output as
+    the default barrier-serialized round-robin ingest (main.cpp:78-86) —
+    ingest order cannot influence the solve."""
+    paths, H, f_true, times, scales = world
+
+    ser_out = str(tmp_path / "mp_ser.h5")
+    _run_pair(paths, ser_out, _free_port())
+
+    par_out = str(tmp_path / "mp_par.h5")
+    _run_pair(paths, par_out, _free_port(), "--parallel_read")
+
+    with h5py.File(ser_out, "r") as fs, h5py.File(par_out, "r") as fp:
+        np.testing.assert_array_equal(
+            fp["solution/value"][:], fs["solution/value"][:]
+        )
+        np.testing.assert_array_equal(
+            fp["solution/status"][:], fs["solution/status"][:]
+        )
