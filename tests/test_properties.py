@@ -1,0 +1,147 @@
+"""Property-based tests (hypothesis) for host-side invariants.
+
+The table-driven tests pin the reference's exact semantics on chosen
+cases; these sweep randomized inputs for the invariants that must hold
+for EVERY input — parser round-trips, quantization error bounds, mask
+monotonicity — catching edge cases no table anticipates. Deterministic:
+hypothesis derandomized with bounded examples so suite wall-time stays
+flat.
+"""
+
+import math
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from sartsolver_tpu.config import SartInputError, parse_time_intervals
+
+SET = settings(max_examples=120, deadline=None, derandomize=True)
+# each example of the jit-backed properties compiles a fresh XLA program
+# (distinct shapes / static thresholds) — keep their counts small so the
+# suite wall-time stays flat
+SET_JIT = settings(max_examples=25, deadline=None, derandomize=True)
+
+
+def _fmt(x: float) -> str:
+    return np.format_float_positional(x, trim="-")
+
+
+@SET
+@given(
+    st.lists(
+        st.tuples(
+            st.floats(0.0, 1e6, allow_nan=False),  # start
+            st.floats(1e-6, 1e6, allow_nan=False),  # stop - start
+            st.floats(0.0, 1.0, allow_nan=False),  # step as frac of span
+            st.floats(0.0, 1.0, allow_nan=False),  # threshold as frac of step
+        ),
+        min_size=1,
+        max_size=4,
+    ),
+    st.booleans(),  # trailing comma
+)
+def test_parse_time_intervals_roundtrip(raw, trailing):
+    """Any VALID interval list formats to a string that parses back to the
+    same values (the parser accepts everything its grammar can express)."""
+    intervals = []
+    parts = []
+    for start, span, step_f, thr_f in raw:
+        stop = start + span
+        # derive from the REPRESENTABLE span: fl(start+span)-start can be
+        # below span, and step must satisfy step <= stop-start as floats
+        span_repr = stop - start
+        if span_repr <= 0:  # fully absorbed by rounding at huge start
+            continue
+        step = span_repr * step_f
+        thr = step * thr_f
+        intervals.append((start, stop, step, thr))
+        parts.append(":".join(_fmt(v) for v in (start, stop, step, thr)))
+    if not intervals:
+        return
+    s = ",".join(parts) + ("," if trailing else "")
+    parsed = parse_time_intervals(s)
+    assert len(parsed) == len(intervals)
+    for got, want in zip(parsed, intervals):
+        assert got == want  # exact: identical float64 literals round-trip
+
+
+@SET
+@given(st.floats(allow_nan=True), st.floats(allow_nan=True))
+def test_parse_time_intervals_never_accepts_inverted(start, stop):
+    """No numeric pair with stop <= start (or start < 0) ever parses —
+    the validation cannot be dodged by weird float spellings."""
+    if not (math.isfinite(start) and math.isfinite(stop)):
+        return
+    if stop > start >= 0:
+        return
+    with pytest.raises(SartInputError):
+        parse_time_intervals(f"{_fmt(start)}:{_fmt(stop)}")
+
+
+@SET
+@given(st.text(alphabet="0123456789:,.- e", max_size=24))
+def test_parse_time_intervals_total(s):
+    """The parser either returns valid tuples or raises SartInputError —
+    never any other exception, and every returned interval satisfies the
+    documented invariants."""
+    try:
+        out = parse_time_intervals(s)
+    except SartInputError:
+        return
+    assert out  # non-empty by contract
+    for start, stop, step, thr in out:
+        assert start >= 0 and stop > start
+        assert step <= stop - start and thr <= step
+
+
+@SET_JIT
+@given(
+    st.integers(2, 40),  # P
+    st.integers(2, 60),  # V
+    st.integers(0, 2**32 - 1),
+)
+def test_quantize_error_bound_any_matrix(P, V, seed):
+    """Per-voxel symmetric int8 quantization: |Hq - H| <= colmax/254 for
+    every column of every random non-negative matrix, zero columns get
+    scale 1 and exact-zero codes (models/sart._quantize_sym contract)."""
+    from sartsolver_tpu.models.sart import quantize_rtm
+
+    rng = np.random.default_rng(seed)
+    H = (rng.random((P, V), dtype=np.float32)
+         * rng.choice([0.0, 1e-3, 1.0, 1e3], size=(1, V)).astype(np.float32))
+    codes, scale = quantize_rtm(H)
+    Hq = np.asarray(codes, np.float32) * np.asarray(scale)[None, :]
+    colmax = np.abs(H).max(axis=0)
+    err = np.abs(Hq - H).max(axis=0)
+    assert (err <= colmax / 254.0 + 1e-12).all()
+    zero = colmax == 0
+    assert (np.asarray(scale)[zero] == 1.0).all()
+    assert (Hq[:, zero] == 0.0).all()
+
+
+@SET_JIT
+@given(st.integers(0, 2**32 - 1), st.integers(1, 6))
+def test_masking_monotone_in_threshold(seed, k):
+    """Raising the ray-density threshold can only REMOVE voxels from the
+    solve (masked voxels are exactly those the update zeroes) — Eq. 6
+    monotonicity through the real solver."""
+    import jax.numpy as jnp
+
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import make_problem, solve
+
+    rng = np.random.default_rng(seed)
+    P, V = 16, 24
+    H = rng.random((P, V)).astype(np.float32)
+    H[:, rng.choice(V, 4, replace=False)] *= 1e-4  # weakly-coupled voxels
+    g = H.astype(np.float64) @ rng.uniform(0.5, 1.5, V)
+    thresholds = sorted(np.quantile(H.sum(axis=0), [0.1 * k, 0.1 * k + 0.3]))
+    supports = []
+    for d in thresholds:
+        opts = SolverOptions(max_iterations=3, conv_tolerance=1e-12,
+                             ray_density_threshold=float(d))
+        res = solve(make_problem(H, opts=opts), g, opts=opts)
+        supports.append(np.asarray(res.solution) > 0)
+    # support at the higher threshold is a subset of the lower one's
+    assert not np.any(supports[1] & ~supports[0])
