@@ -136,26 +136,49 @@ class CompositeImage:
         min_time -= step
         max_time += step
 
-        max_num_frames = int(round((max_time - min_time) / step)) + 1
+        ratio = (max_time - min_time) / step
+        if not np.isfinite(ratio):
+            # a denormal-scale step over a finite range: the tick indices
+            # themselves overflow float arithmetic — an input error, not a
+            # crash (the sparse grid below otherwise handles any finite
+            # tiny step in O(frames))
+            raise SartInputError(
+                f"Time step {step} is too small for the time interval; "
+                "specify a larger step in the time range."
+            )
+        max_num_frames = int(round(ratio)) + 1
         num_cam = len(timepairs)
 
-        # flattened composite grid of (delta, frame_index)
-        grid_delta = np.full(max_num_frames * num_cam, 1.01 * threshold)
-        grid_index = np.zeros(max_num_frames * num_cam, dtype=np.int64)
+        # SPARSE composite grid: slots exist only where a frame actually
+        # bid (each frame bids on its nearest tick and both neighbors, so
+        # slots are O(total frames), never O(time range / step)). The
+        # reference allocates the DENSE grid (image.cpp:143-145), which
+        # (a) explodes for a tiny step over a wide range (a user typo like
+        # step=1e-9 would attempt a multi-TiB allocation here) and (b)
+        # initializes unbid slots to the sentinel 1.01*threshold, which for
+        # thresholds below ~100*TIME_EPSILON passes the completeness check
+        # and emits bogus frame-0 indices — with absent-means-incomplete
+        # slots both defects vanish while every bid/tie/dedup rule below
+        # stays byte-for-byte the reference's (the table-driven tie-break
+        # tests pin this).
+        slots: Dict[Tuple[int, int], Tuple[float, int]] = {}
 
         for icam, tp in enumerate(timepairs):
             for t, frame_idx in tp:
                 iframe = int(round((t - min_time) / step))
                 for i in (-1, 0, 1):  # bid on previous/this/next tick
-                    index = num_cam * (iframe + i) + icam
+                    key = (iframe + i, icam)
                     delta = t - min_time - (iframe + i) * step
+                    cur = slots.get(key)
                     # TIME_EPSILON prefers the earlier frame on exact ties
-                    if abs(delta) + TIME_EPSILON < abs(grid_delta[index]):
-                        grid_delta[index] = delta
-                        grid_index[index] = frame_idx
+                    if cur is None or abs(delta) + TIME_EPSILON < abs(cur[0]):
+                        slots[key] = (delta, frame_idx)
 
+        candidates = sorted({f for f, _ in slots})
         last_time_delta = 0.0
-        for iframe in range(1, max_num_frames - 1):
+        for iframe in candidates:
+            if not (1 <= iframe <= max_num_frames - 2):
+                continue  # widened border ticks (image.cpp:141-142)
             iframe_indices: List[int] = []
             icamera_time: List[float] = []
             ftime = min_time + iframe * step
@@ -163,12 +186,12 @@ class CompositeImage:
 
             complete = True
             for icam in range(num_cam):
-                index = num_cam * iframe + icam
-                delta = grid_delta[index]
-                if abs(delta) > threshold + TIME_EPSILON:
+                slot = slots.get((iframe, icam))
+                if slot is None or abs(slot[0]) > threshold + TIME_EPSILON:
                     complete = False
                     break
-                iframe_indices.append(int(grid_index[index]))
+                delta, frame_idx = slot
+                iframe_indices.append(int(frame_idx))
                 icamera_time.append(ftime + delta)
                 time_delta += abs(delta)
 

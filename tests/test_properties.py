@@ -114,7 +114,10 @@ def test_quantize_error_bound_any_matrix(P, V, seed):
     Hq = np.asarray(codes, np.float32) * np.asarray(scale)[None, :]
     colmax = np.abs(H).max(axis=0)
     err = np.abs(Hq - H).max(axis=0)
-    assert (err <= colmax / 254.0 + 1e-12).all()
+    # slack scales with the column magnitude: the fp32 scale division and
+    # dequant multiply each contribute ~eps(colmax)-level rounding, which
+    # an absolute 1e-12 cannot cover for colmax ~ 1e3 columns
+    assert (err <= colmax / 254.0 + colmax * 1e-6 + 1e-12).all()
     zero = colmax == 0
     assert (np.asarray(scale)[zero] == 1.0).all()
     assert (Hq[:, zero] == 0.0).all()
@@ -145,3 +148,69 @@ def test_masking_monotone_in_threshold(seed, k):
         supports.append(np.asarray(res.solution) > 0)
     # support at the higher threshold is a subset of the lower one's
     assert not np.any(supports[1] & ~supports[0])
+
+
+def _align(timelines, step, threshold):
+    """Run the alignment core on bare timelines; returns the populated
+    skeleton (no HDF5 involved)."""
+    from sartsolver_tpu.io.image import CompositeImage
+
+    ci = CompositeImage.__new__(CompositeImage)
+    ci.frame_indices, ci.camera_time, ci.time = [], [], []
+    timepairs = [[(float(t), i) for i, t in enumerate(tl)] for tl in timelines]
+    ci._frame_indices_from_timepairs(timepairs, step, threshold)
+    return ci
+
+
+@SET
+@given(
+    st.integers(1, 3),  # cameras
+    st.integers(0, 2**32 - 1),
+    st.floats(0.0, 2.0),  # step factor (0 = auto-derive)
+    st.floats(0.0, 1.0),  # threshold as fraction of step (0 = step)
+)
+def test_alignment_invariants(ncam, seed, step_f, thr_f):
+    """Composite time alignment (image.cpp:110-196 port) on random
+    asynchronous timelines: every emitted frame is complete and within
+    the sync threshold, camera times are real timestamps of the chosen
+    indices, each choice is the nearest frame of its camera to the tick,
+    ticks strictly increase, and no consecutive duplicate tuples
+    survive dedup."""
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.io.image import TIME_EPSILON
+
+    rng = np.random.default_rng(seed)
+    timelines = []
+    for _ in range(ncam):
+        n = int(rng.integers(1, 16))
+        tl = np.sort(rng.uniform(0.0, 10.0, n))
+        timelines.append(tl)
+    base = max(np.diff(tl).min() if len(tl) > 1 else 1.0 for tl in timelines)
+    step = float(base * step_f)  # 0.0 => auto-derive
+    threshold = float(step * thr_f)  # 0.0 => use the step
+
+    try:
+        ci = _align(timelines, step, threshold)
+    except SartInputError:
+        return  # degenerate/empty outcomes are legal rejections
+
+    eff_thr = threshold if threshold > 0 else (step if step > 0 else None)
+    assert len(ci.time) == len(ci.frame_indices) == len(ci.camera_time)
+    assert all(t1 > t0 for t0, t1 in zip(ci.time, ci.time[1:]))
+    for k, (tick, idxs, ctimes) in enumerate(
+        zip(ci.time, ci.frame_indices, ci.camera_time)
+    ):
+        assert len(idxs) == ncam
+        for c in range(ncam):
+            tl = timelines[c]
+            assert 0 <= idxs[c] < len(tl)
+            # the reported camera time IS the chosen frame's timestamp
+            assert ctimes[c] == pytest.approx(tl[idxs[c]], abs=1e-8)
+            delta = abs(tl[idxs[c]] - tick)
+            if eff_thr is not None:
+                # complete-frame rule: within the sync threshold
+                assert delta <= eff_thr + 2 * TIME_EPSILON
+            # nearest-frame rule (ties may go either way within epsilon)
+            assert delta <= np.abs(tl - tick).min() + 2 * TIME_EPSILON
+        if k > 0:
+            assert idxs != ci.frame_indices[k - 1]  # dedup held
