@@ -174,11 +174,23 @@ class CylindricalVoxelGrid(BaseVoxelGrid):
             return -1
         period = self.ymax - self.ymin
         phi = 180.0 / math.pi * math.atan2(y, x)
+        # Wrap into the grid's own sector [ymin, ymin + period): the
+        # reference wraps into [0, period) and then subtracts ymin
+        # (voxelgrid.cpp:311-317), which for a sector grid with ymin > 0
+        # makes angles below ymin produce a NEGATIVE angular index —
+        # out-of-bounds UB in its C++, a silently wrong (wrapped-around)
+        # cell here. Wrapping relative to ymin is identical for the
+        # common ymin == 0 grids and correct for every sector.
+        phi = math.fmod(phi - self.ymin, period)
         if phi < 0:
-            phi += 360.0
-        phi = math.fmod(phi, period)
+            phi += period
+        if phi >= period:
+            # a tiny negative fmod result plus period can round to exactly
+            # period (half-ulp), which would index one past the last
+            # angular cell — the angle is equivalent to the sector origin
+            phi -= period
         i = int((r - self.xmin) / self.dx)
-        j = int((phi - self.ymin) / self.dy)
+        j = int(phi / self.dy)
         k = int((z - self.zmin) / self.dz)
         return int(self.voxmap[i * self.ny * self.nz + j * self.nz + k])
 

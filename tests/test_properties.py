@@ -214,3 +214,142 @@ def test_alignment_invariants(ncam, seed, step_f, thr_f):
             assert delta <= np.abs(tl - tick).min() + 2 * TIME_EPSILON
         if k > 0:
             assert idxs != ci.frame_indices[k - 1]  # dedup held
+
+
+def _bare_grid(cls, nx, ny, nz, bounds, voxmap):
+    g = cls.__new__(cls)
+    g.nx, g.ny, g.nz = nx, ny, nz
+    (g.xmin, g.xmax), (g.ymin, g.ymax), (g.zmin, g.zmax) = bounds
+    g.dx = (g.xmax - g.xmin) / nx
+    g.dy = (g.ymax - g.ymin) / ny
+    g.dz = (g.zmax - g.zmin) / nz
+    g.voxmap = voxmap
+    g.nvox = int(voxmap.max()) + 1
+    return g
+
+
+@SET
+@given(
+    st.integers(1, 5), st.integers(1, 5), st.integers(1, 4),
+    st.integers(0, 2**32 - 1),
+)
+def test_cartesian_lookup_cell_centers(nx, ny, nz, seed):
+    """voxel_index at every cell CENTER returns that cell's map value;
+    points outside the bounds return -1 (voxelgrid.cpp:236-250)."""
+    from sartsolver_tpu.io.voxelgrid import CartesianVoxelGrid
+
+    rng = np.random.default_rng(seed)
+    lo = rng.uniform(-5, 5, 3)
+    span = rng.uniform(0.5, 10, 3)
+    bounds = [(float(lo[d]), float(lo[d] + span[d])) for d in range(3)]
+    voxmap = np.full(nx * ny * nz, -1, np.int64)
+    occupied = rng.random(voxmap.size) < 0.7
+    voxmap[occupied] = np.arange(int(occupied.sum()))
+    g = _bare_grid(CartesianVoxelGrid, nx, ny, nz, bounds, voxmap)
+
+    for flat in range(voxmap.size):
+        i, rem = divmod(flat, ny * nz)
+        j, k = divmod(rem, nz)
+        x = g.xmin + (i + 0.5) * g.dx
+        y = g.ymin + (j + 0.5) * g.dy
+        z = g.zmin + (k + 0.5) * g.dz
+        assert g.voxel_index(x, y, z) == voxmap[flat]
+    assert g.voxel_index(g.xmax + 1.0, g.ymin, g.zmin) == -1
+    assert g.voxel_index(g.xmin - 1e-9 * max(1, abs(g.xmin)),
+                         g.ymin, g.zmin) == -1
+
+
+@SET
+@given(
+    st.integers(1, 4),  # radial cells
+    st.integers(1, 5),  # angular cells
+    st.sampled_from([360.0, 180.0, 90.0, 60.0, 45.0]),  # sector period
+    st.floats(0.0, 300.0),  # sector start (ymin)
+    st.integers(-2, 2),  # extra whole periods on the probe angle
+    st.integers(0, 2**32 - 1),
+)
+def test_cylindrical_lookup_cell_centers_periodic(nr, nphi, period, ymin,
+                                                  wraps, seed):
+    """Cylindrical voxel_index at every (r, phi, z) cell center — probed
+    at phi + any whole number of periods — returns that cell's value:
+    periodicity and sector grids with ymin > 0 (where the reference's
+    wrap produced negative angular indices, C++ UB) both hold."""
+    import math
+
+    from sartsolver_tpu.io.voxelgrid import CylindricalVoxelGrid
+
+    rng = np.random.default_rng(seed)
+    r0 = rng.uniform(0.1, 2.0)
+    bounds = [(r0, r0 + rng.uniform(0.5, 3.0)),
+              (ymin, ymin + period), (-1.0, 1.0)]
+    voxmap = np.arange(nr * nphi * 1, dtype=np.int64)
+    g = _bare_grid(CylindricalVoxelGrid, nr, nphi, 1, bounds, voxmap)
+
+    for flat in range(voxmap.size):
+        i, j = divmod(flat, nphi)
+        r = g.xmin + (i + 0.5) * g.dx
+        phi = math.radians(g.ymin + (j + 0.5) * g.dy + wraps * period)
+        x, y = r * math.cos(phi), r * math.sin(phi)
+        assert g.voxel_index(x, y, 0.0) == voxmap[flat], (i, j)
+    # boundary angles (cell edges +- ~1 ulp, incl. the sector origin from
+    # below, where fmod(-eps)+period can round to exactly period) must
+    # never index past the angular axis
+    r_mid = g.xmin + 0.5 * g.dx
+    for j in range(nphi + 1):
+        for eps in (-1e-13, 0.0, 1e-13):
+            ang = math.radians(g.ymin + j * g.dy + eps + wraps * period)
+            out = g.voxel_index(r_mid * math.cos(ang),
+                                r_mid * math.sin(ang), 0.0)
+            assert 0 <= out < g.nvox
+    # radius out of range -> -1
+    assert g.voxel_index(g.xmax + 1.0, 0.0, 0.0) == -1
+
+
+@SET
+@given(st.integers(2, 5), st.integers(1, 4), st.integers(0, 2**32 - 1))
+def test_voxelmap_stitching_any_split(n_cells_per_seg, n_segs, seed):
+    """Stitching voxel-map segments with re-offsetting (voxelgrid.cpp:
+    91-97): for ANY split of a grid's occupied cells into segment files
+    (each segment's values locally 0-based), the stitched map equals the
+    single-file map of the union with globally increasing values."""
+    import h5py
+
+    from sartsolver_tpu.io.voxelgrid import CartesianVoxelGrid
+
+    rng = np.random.default_rng(seed)
+    nx = ny = 4
+    nz = 2
+    total = n_cells_per_seg * n_segs
+    if total > nx * ny * nz:
+        return
+    flats = rng.choice(nx * ny * nz, total, replace=False)
+    import tempfile, os
+
+    with tempfile.TemporaryDirectory() as td:
+        names = []
+        for s in range(n_segs):
+            seg = np.sort(flats[s * n_cells_per_seg:(s + 1) * n_cells_per_seg])
+            name = os.path.join(td, f"seg{s}.h5")
+            names.append(name)
+            with h5py.File(name, "w") as f:
+                grp = f.create_group("rtm/voxel_map")
+                for a, v in (("nx", nx), ("ny", ny), ("nz", nz)):
+                    grp.attrs[a] = v
+                i, rem = np.divmod(seg, ny * nz)
+                j, k = np.divmod(rem, nz)
+                grp.create_dataset("i", data=i)
+                grp.create_dataset("j", data=j)
+                grp.create_dataset("k", data=k)
+                grp.create_dataset("value", data=np.arange(len(seg)))
+        g = CartesianVoxelGrid()
+        g.read_hdf5(names, "rtm/voxel_map")
+
+    want = np.full(nx * ny * nz, -1, np.int64)
+    v = 0
+    for s in range(n_segs):
+        seg = np.sort(flats[s * n_cells_per_seg:(s + 1) * n_cells_per_seg])
+        for fl in seg:
+            want[fl] = v
+            v += 1
+    np.testing.assert_array_equal(g.voxmap, want)
+    assert g.nvox == total
