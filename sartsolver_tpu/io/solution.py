@@ -44,9 +44,13 @@ def read_resume_state(
 
     Crash consistency: ``_update`` writes the per-frame datasets one at a
     time, so after a mid-flush kill their lengths can disagree. A frame
-    counts as completed only if EVERY dataset has it; the shortest dataset
-    is the authority and the writer truncates any torn tail before
-    appending.
+    counts as completed only if EVERY dataset has it AND the ``completed``
+    counter — updated as the flush's FINAL operation — covers it: the
+    counter closes the one state dataset lengths cannot distinguish (a
+    kill after every dataset was resized but before its rows were written
+    leaves all lengths equal with fill-value garbage in the tail). Files
+    from before the counter fall back to shortest-dataset authority. The
+    writer truncates any torn tail before appending.
     """
     if not os.path.exists(filename):
         return None
@@ -76,6 +80,8 @@ def read_resume_state(
             *(d.shape[0] for d in per_frame),
             *(group[k].shape[0] for k in expected),
         )
+        if "completed" in group.attrs:
+            completed = min(completed, int(group.attrs["completed"]))
         times = group["time"][:completed]
         last = value[completed - 1, :] if completed else None
         return ResumeState(times, last)
@@ -175,6 +181,7 @@ class SolutionWriter:
                         dset.resize((completed, dset.shape[1]))
                     else:
                         dset.resize((completed,))
+            group.attrs["completed"] = completed
 
     def _create(self) -> None:
         """First flush: new file with extendible datasets (solution.cpp:60-112).
@@ -215,6 +222,12 @@ class SolutionWriter:
                 "status", data=np.asarray(self._status, np.int32),
                 maxshape=(None,), chunks=(n,), dtype=np.int32, fillvalue=0,
             )
+            # commit point: flush data to disk BEFORE the counter (HDF5
+            # gives no on-disk ordering between its metadata and chunk
+            # caches, so API-call order alone would not guarantee the
+            # counter never lands without the rows it vouches for)
+            f.flush()
+            group.attrs["completed"] = n
 
     def _update(self) -> None:
         """Later flushes: extend + append (solution.cpp:114-165)."""
@@ -244,3 +257,9 @@ class SolutionWriter:
             dset = f["solution/value"]
             dset.resize((new_size, self.nvox))
             dset[offset:] = np.stack(self._solutions)
+
+            # commit point: data flushed to disk, THEN the counter (see
+            # read_resume_state crash notes and the ordering comment in
+            # _create)
+            f.flush()
+            f["solution"].attrs["completed"] = new_size

@@ -138,15 +138,23 @@ class CartesianVoxelGrid(BaseVoxelGrid):
         super().read_hdf5(filenames, group_name)
 
     def voxel_index(self, x: float, y: float, z: float) -> int:
-        """Point -> voxel (voxelgrid.cpp:236-250)."""
+        """Point -> voxel (voxelgrid.cpp:236-250).
+
+        Indices are clamped to the last cell: when a cell width rounds
+        below the exact span/n quotient, a coordinate just inside the
+        upper bound can quotient to n — one past the axis, out-of-bounds
+        UB in the reference's C++. The bounds check above already
+        guarantees the point is inside the grid, so the clamp only
+        corrects that half-ulp spill.
+        """
         if self.voxmap is None:
             raise RuntimeError("Voxel map is not initialized.")
         if not (self.xmin <= x < self.xmax and self.ymin <= y < self.ymax
                 and self.zmin <= z < self.zmax):
             return -1
-        i = int((x - self.xmin) / self.dx)
-        j = int((y - self.ymin) / self.dy)
-        k = int((z - self.zmin) / self.dz)
+        i = min(int((x - self.xmin) / self.dx), self.nx - 1)
+        j = min(int((y - self.ymin) / self.dy), self.ny - 1)
+        k = min(int((z - self.zmin) / self.dz), self.nz - 1)
         return int(self.voxmap[i * self.ny * self.nz + j * self.nz + k])
 
 
@@ -189,9 +197,12 @@ class CylindricalVoxelGrid(BaseVoxelGrid):
             # period (half-ulp), which would index one past the last
             # angular cell — the angle is equivalent to the sector origin
             phi -= period
-        i = int((r - self.xmin) / self.dx)
-        j = int(phi / self.dy)
-        k = int((z - self.zmin) / self.dz)
+        # clamp: same half-ulp quotient spill as the Cartesian lookup
+        # (e.g. ny=19, dy=fl(360/19) < 360/19 exactly, so phi just below
+        # the period quotients to ny), plus the radial/z axes
+        i = min(int((r - self.xmin) / self.dx), self.nx - 1)
+        j = min(int(phi / self.dy), self.ny - 1)
+        k = min(int((z - self.zmin) / self.dz), self.nz - 1)
         return int(self.voxmap[i * self.ny * self.nz + j * self.nz + k])
 
 

@@ -262,7 +262,11 @@ def test_cartesian_lookup_cell_centers(nx, ny, nz, seed):
 @SET
 @given(
     st.integers(1, 4),  # radial cells
-    st.integers(1, 5),  # angular cells
+    # include counts whose cell width is NOT binary-exact (e.g. 360/19
+    # rounds below the true quotient, so ny*dy < period and angles just
+    # below the period can quotient to ny — the half-ulp spill the
+    # lookup clamps)
+    st.sampled_from([1, 2, 3, 4, 5, 7, 13, 19]),  # angular cells
     st.sampled_from([360.0, 180.0, 90.0, 60.0, 45.0]),  # sector period
     st.floats(0.0, 300.0),  # sector start (ymin)
     st.integers(-2, 2),  # extra whole periods on the probe angle
@@ -353,3 +357,75 @@ def test_voxelmap_stitching_any_split(n_cells_per_seg, n_segs, seed):
             v += 1
     np.testing.assert_array_equal(g.voxmap, want)
     assert g.nvox == total
+
+
+@SET
+@given(
+    st.integers(1, 6),  # completed frames before the "crash"
+    st.integers(1, 3),  # frames still to write after resume
+    st.sets(st.sampled_from(
+        ["value", "time", "status", "iterations", "time_camA", "time_camB"]
+    )),  # datasets the mid-flush crash managed to extend with garbage
+    st.integers(1, 3),  # torn rows
+    st.integers(0, 2**32 - 1),
+)
+def test_resume_crash_consistency_any_torn_state(n_done, n_rest, torn,
+                                                 extra, seed):
+    """Crash consistency of the resume path for ANY torn file state: a
+    mid-flush kill leaves an arbitrary subset of per-frame datasets
+    extended with partial rows; resuming must (a) report exactly the
+    frames every dataset completed, (b) truncate the torn tail, and (c)
+    after appending the remaining frames, equal the uninterrupted run
+    byte-for-byte."""
+    import os
+    import tempfile
+
+    import h5py
+
+    from sartsolver_tpu.io.solution import SolutionWriter, read_resume_state
+
+    rng = np.random.default_rng(seed)
+    V = 7
+    cams = ["camA", "camB"]
+    total = n_done + n_rest
+    sols = rng.random((total, V))
+    times = np.arange(total, dtype=np.float64) * 0.5
+
+    def write(writer, lo, hi):
+        for i in range(lo, hi):
+            writer.add(sols[i], 0, times[i], [times[i], times[i] + 0.01],
+                       iterations=i)
+
+    with tempfile.TemporaryDirectory() as td:
+        ref = os.path.join(td, "ref.h5")
+        with SolutionWriter(ref, cams, V, max_cache_size=2) as w:
+            write(w, 0, total)
+
+        out = os.path.join(td, "out.h5")
+        with SolutionWriter(out, cams, V, max_cache_size=2) as w:
+            write(w, 0, n_done)
+        # simulate the mid-flush kill: extend a subset with garbage rows
+        with h5py.File(out, "r+") as f:
+            for key in sorted(torn):
+                d = f["solution"][key]
+                if key == "value":
+                    d.resize((n_done + extra, V))
+                else:
+                    d.resize((n_done + extra,))
+
+        state = read_resume_state(out, cams, V)
+        assert state is not None
+        assert len(state.times) == n_done  # only fully-written frames count
+        np.testing.assert_array_equal(state.times, times[:n_done])
+        np.testing.assert_array_equal(state.last_solution, sols[n_done - 1])
+
+        with SolutionWriter(out, cams, V, max_cache_size=2,
+                            resume=state) as w:
+            write(w, n_done, total)
+
+        with h5py.File(ref, "r") as fr, h5py.File(out, "r") as fo:
+            for key in fr["solution"]:
+                np.testing.assert_array_equal(
+                    fo["solution"][key][:], fr["solution"][key][:],
+                    err_msg=key,
+                )
