@@ -158,10 +158,14 @@ class CompositeImage:
         # initializes unbid slots to the sentinel 1.01*threshold, which for
         # thresholds below ~100*TIME_EPSILON passes the completeness check
         # and emits bogus frame-0 indices — with absent-means-incomplete
-        # slots both defects vanish while every bid/tie/dedup rule below
-        # stays byte-for-byte the reference's (the table-driven tie-break
-        # tests pin this).
+        # slots both defects vanish. Every bid/tie/dedup rule below is the
+        # reference's exactly: an absent slot competes as the sentinel
+        # value (so an over-threshold first bid is rejected, never
+        # retained to shadow a later closer bid), and TIME_EPSILON
+        # prefers the earlier frame on exact ties (the table-driven
+        # tie-break tests pin this).
         slots: Dict[Tuple[int, int], Tuple[float, int]] = {}
+        sentinel = 1.01 * threshold  # image.cpp:145 initial slot value
 
         for icam, tp in enumerate(timepairs):
             for t, frame_idx in tp:
@@ -170,8 +174,8 @@ class CompositeImage:
                     key = (iframe + i, icam)
                     delta = t - min_time - (iframe + i) * step
                     cur = slots.get(key)
-                    # TIME_EPSILON prefers the earlier frame on exact ties
-                    if cur is None or abs(delta) + TIME_EPSILON < abs(cur[0]):
+                    base = sentinel if cur is None else abs(cur[0])
+                    if abs(delta) + TIME_EPSILON < base:
                         slots[key] = (delta, frame_idx)
 
         candidates = sorted({f for f, _ in slots})

@@ -23,6 +23,28 @@ import numpy as np
 from sartsolver_tpu.config import SartInputError
 
 
+def _fsync_file(f: h5py.File) -> None:
+    """Durability barrier between the per-frame data and the ``completed``
+    counter. ``f.flush()`` only moves HDF5 library buffers into the OS page
+    cache — sufficient for the process-kill crash model, but after a power
+    loss or kernel crash the counter could reach disk before the rows it
+    vouches for. fsync the underlying descriptor (SEC2/core drivers expose
+    it; anything exotic falls back to a path-open fsync) so the commit
+    ordering holds under full-system crashes too."""
+    try:
+        fd = f.id.get_vfd_handle()
+    except Exception:
+        fd = None
+    if fd is not None and fd >= 0:
+        os.fsync(fd)
+        return
+    fd = os.open(f.filename, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class ResumeState(NamedTuple):
     """What a previous (possibly interrupted) run already produced."""
 
@@ -227,6 +249,7 @@ class SolutionWriter:
             # caches, so API-call order alone would not guarantee the
             # counter never lands without the rows it vouches for)
             f.flush()
+            _fsync_file(f)
             group.attrs["completed"] = n
 
     def _update(self) -> None:
@@ -262,4 +285,5 @@ class SolutionWriter:
             # read_resume_state crash notes and the ordering comment in
             # _create)
             f.flush()
+            _fsync_file(f)
             f["solution"].attrs["completed"] = new_size

@@ -437,8 +437,10 @@ class DistributedSARTSolver:
         the same process; ``benchmarks/capacity_demo.py`` measures how
         close a close()+reload cycle gets to fresh-process throughput.
         Idempotent. The solver is unusable afterwards; results already
-        fetched to host stay valid, but any un-fetched
-        :class:`DeviceSolveResult` solutions die with the device buffers.
+        produced stay valid (a :class:`DeviceSolveResult`'s buffers are
+        independent arrays, not views of the problem arrays, so they
+        survive close() and remain fetchable — and usable as ``warm=``
+        seeds for another same-layout solver).
         """
         if self.problem is None:
             return
@@ -701,6 +703,29 @@ class DistributedSARTSolver:
             g_dev = _stage(g_stage, self.mesh, P(None, PIXEL_AXIS))
         return g_dev, norms, msqs
 
+    def _check_warm_alive(self, warm: DeviceSolveResult) -> None:
+        """A ``warm=`` seed whose device buffers have been deleted (an
+        explicit ``.delete()``, or any teardown that released them) would
+        otherwise surface as an opaque XLA runtime error deep inside
+        dispatch — fail here with an actionable message instead. Note a
+        CLOSED producing solver is fine by itself: close() releases the
+        solver's staged problem arrays, not its results' buffers, so a
+        still-alive result remains a legitimate seed (the foreign-warm
+        pattern)."""
+        dead = [
+            name for name, arr in (
+                ("solution", warm.solution_norm),
+                ("fitted", warm.fitted_norm),
+            )
+            if arr is not None and getattr(arr, "is_deleted", lambda: False)()
+        ]
+        if dead:
+            raise ValueError(
+                f"warm= result's device {'/'.join(dead)} buffers have been "
+                "deleted; fetch the result to host (fetch_solutions()) "
+                "while it is alive and pass it as f0= instead."
+            )
+
     def solve_chain(
         self,
         measurements,
@@ -742,6 +767,8 @@ class DistributedSARTSolver:
         dtype = jnp.dtype(opts.dtype)
         if warm is not None and f0 is not None:
             raise ValueError("Pass either warm= (device) or f0= (host), not both.")
+        if warm is not None:
+            self._check_warm_alive(warm)
         if warm is not None and warm.solution_norm.shape[-1] != self.padded_nvoxel:
             raise ValueError(
                 f"warm result has {warm.solution_norm.shape[-1]} padded "
@@ -820,6 +847,8 @@ class DistributedSARTSolver:
         dtype = jnp.dtype(opts.dtype)
         if warm is not None and f0 is not None:
             raise ValueError("Pass either warm= (device) or f0= (host), not both.")
+        if warm is not None:
+            self._check_warm_alive(warm)
         G = self._check_frames(measurements, local)
         B = G.shape[0]
         g_dev, norms, msqs = self._stage_frames(G, local)

@@ -282,6 +282,23 @@ def test_timing_flag_prints_summary(world, capsys):
     assert "engaged=off" in out
 
 
+def test_provenance_line_printed(world, capsys):
+    """Every run prints one startup provenance line with the chosen
+    mesh/layout/dtype/fused decision (VERDICT r4 next #6) — no --timing
+    needed."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    out = capsys.readouterr().out
+    line = next(l for l in out.splitlines() if l.startswith("solver: "))
+    # the conftest forces 8 virtual CPU devices; the fp64 profile's auto
+    # mesh is the reference-style pixel-major row-block layout over all 8
+    assert "mesh=8x1" in line
+    assert "pixel-major" in line
+    assert "compute=float64" in line  # --use_cpu parity profile
+    assert "fused_sweep=auto->" in line
+    assert "processes=1" in line
+
+
 def test_internal_error_propagates(world, monkeypatch):
     """VERDICT r1 #7: the polite exit-1 funnel is for input errors only —
     an internal bug (e.g. a shape error in the solver) must traceback."""

@@ -526,6 +526,36 @@ def test_close_releases_device_memory():
     assert s2.problem is None
 
 
+def test_dead_warm_buffers_rejected_closed_producer_ok():
+    """A warm= seed with DELETED device buffers fails with an actionable
+    ValueError, not an opaque dead-buffer XLA error deep inside dispatch
+    (VERDICT r4 next #6). A merely CLOSED producer is not an error:
+    close() releases the solver's staged problem arrays, not its results'
+    buffers, so the still-alive result stays a legitimate foreign-warm
+    seed."""
+    H, g, _ = make_case(seed=18, P=48, V=32)
+    opts = SolverOptions.cpu_parity(max_iterations=5, conv_tolerance=1e-12)
+    producer = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8))
+    warm = producer.solve_chain(g[None])
+    host_seed = warm.fetch_solutions()
+    consumer = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(8))
+    producer.close()
+    # closed producer, alive buffers: works, and matches the host-f0 path
+    res = consumer.solve_chain(g[None] * 1.1, warm=warm)
+    ref = consumer.solve_chain(g[None] * 1.1, f0=host_seed[-1])
+    np.testing.assert_allclose(
+        res.fetch_solutions(), ref.fetch_solutions(), rtol=1e-9, atol=1e-12)
+    # deleted device buffers: caught with a clear error on both paths
+    warm2 = consumer.solve_chain(g[None])
+    _ = warm2.fetch_solutions()  # materialize before deleting the source
+    warm2.solution_norm.delete()
+    with pytest.raises(ValueError, match="buffers have been deleted"):
+        consumer.solve_chain(g[None], warm=warm2)
+    with pytest.raises(ValueError, match="buffers have been deleted"):
+        consumer.solve_batch(g[None], warm=warm2, device_result=True)
+    consumer.close()
+
+
 def test_foreign_warm_result_recomputes_fitted():
     """A warm result from a DIFFERENT solver (same shapes, different RTM)
     is a legitimate solution seed, but its carried fitted belongs to the
