@@ -23,6 +23,25 @@ import numpy as np
 from sartsolver_tpu.config import SartInputError
 
 
+def _crash_window(point: str) -> None:
+    """Test-only hook: when ``SART_TEST_FLUSH_DELAY`` is set, announce the
+    named commit point on stderr and sleep that many seconds inside it.
+    The end-to-end kill drill (tests/test_killdrill.py) uses the marker to
+    SIGKILL the real ``sartsolve`` process deterministically INSIDE a
+    flush — windows that are microseconds wide in production ("torn":
+    after the first per-frame dataset was extended but before the others;
+    "pre-counter": data flushed+fsynced but the completed counter not yet
+    written). Zero work when the variable is unset."""
+    delay = os.environ.get("SART_TEST_FLUSH_DELAY")
+    if delay:
+        import sys
+        import time
+
+        sys.stderr.write(f"SART_FLUSH_POINT {point}\n")
+        sys.stderr.flush()
+        time.sleep(float(delay))
+
+
 def _fsync_file(f: h5py.File) -> None:
     """Durability barrier between the per-frame data and the ``completed``
     counter. ``f.flush()`` only moves HDF5 library buffers into the OS page
@@ -263,6 +282,8 @@ class SolutionWriter:
             dset.resize((new_size,))
             dset[offset:] = np.asarray(self._time)
 
+            _crash_window("torn")  # time extended, everything else not yet
+
             dset = f["solution/status"]
             dset.resize((new_size,))
             dset[offset:] = np.asarray(self._status, np.int32)
@@ -286,4 +307,5 @@ class SolutionWriter:
             # _create)
             f.flush()
             _fsync_file(f)
+            _crash_window("pre-counter")  # data durable, counter stale
             f["solution"].attrs["completed"] = new_size

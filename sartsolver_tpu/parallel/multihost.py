@@ -487,13 +487,24 @@ def broadcast_resume_state(state, nvoxel: int, error: Optional[str] = None):
         raise SartInputError(bytes(buf.tobytes()).decode().rstrip())
     if meta[0] == 0:
         return None
+    def bcast_f64_exact(arr):
+        # broadcast_one_to_all stages through device arrays, and with x64
+        # disabled (the default; --use_cpu enables it only later) a float64
+        # input is SILENTLY downcast to float32 — the resumed warm start
+        # came back ~5e-8 off its on-disk value and the written times lost
+        # their last 29 bits (caught by tests/test_killdrill.py's
+        # 2-process drill). Reinterpreting the bytes as uint32 makes the
+        # broadcast bit-exact under any x64 setting.
+        bits = np.ascontiguousarray(arr, np.float64).view(np.uint32)
+        return np.asarray(mhu.broadcast_one_to_all(bits)).view(np.float64)
+
     ntimes, has_last = int(meta[1]), bool(meta[2])
     times = state.times if primary else np.zeros(ntimes, np.float64)
-    times = np.asarray(mhu.broadcast_one_to_all(np.asarray(times, np.float64)))
+    times = bcast_f64_exact(times)
     last = None
     if has_last:
         last = state.last_solution if primary else np.zeros(nvoxel, np.float64)
-        last = np.asarray(mhu.broadcast_one_to_all(np.asarray(last, np.float64)))
+        last = bcast_f64_exact(last)
     return ResumeState(times, last)
 
 

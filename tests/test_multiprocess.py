@@ -454,6 +454,52 @@ def test_four_process_resume(world, tmp_path):
         assert f["solution/value"].shape[0] == len(times)
 
 
+def test_resume_broadcast_bit_exact():
+    """broadcast_resume_state must return the EXACT float64 state process
+    0 read from the file, with x64 at its default (disabled) setting —
+    the CLI broadcasts before --use_cpu enables x64, and a naive fp64
+    broadcast silently downcasts to fp32 there (times lose 29 bits, the
+    warm seed drifts ~5e-8; found by tests/test_killdrill.py's 2-process
+    drill). Runs a real 2-process exchange."""
+    worker = r"""
+import os, sys
+rank = int(sys.argv[1]); port = sys.argv[2]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+from sartsolver_tpu.parallel import multihost as mh
+mh.initialize(f"127.0.0.1:{port}", 2, rank)
+import numpy as np
+from sartsolver_tpu.io.solution import ResumeState
+rng = np.random.default_rng(7)
+times = rng.uniform(0, 10, 5)          # generic fp64, not fp32-exact
+last = rng.uniform(0.0, 2.0, 16)
+state = ResumeState(times, last) if rank == 0 else None
+out = mh.broadcast_resume_state(state, 16)
+assert out.times.dtype == np.float64 and out.last_solution.dtype == np.float64
+np.testing.assert_array_equal(out.times, times)
+np.testing.assert_array_equal(out.last_solution, last)
+print("BCAST_OK", flush=True)
+"""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen([sys.executable, "-c", worker, str(rank), str(port)],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+        for rank in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:  # never leak live workers on a timeout
+            if p.poll() is None:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), "\n".join(o[-2000:] for o in outs)
+    assert all("BCAST_OK" in o for o in outs)
+
+
 def test_two_process_resume(world, tmp_path):
     paths, H, f_true, times, scales = world
     mp_out = str(tmp_path / "mp_resume.h5")
