@@ -426,13 +426,35 @@ def _worker_main() -> int:
             "status": int(res.status[0]),
         }
 
-    def run_chain() -> dict:
-        """Steady-state warm frame loop: one K-frame device chain
-        (lax.scan carrying solution AND fitted, models/sart
-        solve_chain_normalized) re-solved from a converged warm seed —
-        the reference's core workload (main.cpp:131-140) in its
-        one-fetch-per-K-frames form. Reported as artifact detail, not the
-        headline (the headline stays the fixed-iteration B=1 rate)."""
+    def run_probe() -> dict:
+        """~2 s fixed-shape bandwidth probe (VERDICT r4 next #5): a bare
+        fp32 matvec over the staged matrix — one full HBM read, nothing
+        else. Run at sweep start AND end, it anchors the headline against
+        the tunnel/session weather (the ±20% session variance BASELINE.md
+        records): headline/probe is comparable across sessions where raw
+        iter/s is not."""
+        problem = get_problem("float32")
+        x = jnp.ones((V, 1), jnp.float32)
+        mv = jax.jit(lambda r, v: r @ v)
+        np.asarray(mv(problem.rtm, x))  # compile + warm
+        best = float("inf")
+        for _ in range(5):
+            t_rep = time.perf_counter()
+            np.asarray(mv(problem.rtm, x))
+            best = min(best, time.perf_counter() - t_rep)
+        gbs = P * V * 4 / best / 1e9
+        return {"seconds": round(best, 5), "gbs": round(gbs, 1)}
+
+    def run_chain(rtm_dtype: str) -> dict:
+        """Steady-state warm frame loop in the SHIPPING configuration
+        (VERDICT r4 next #4): K=8-frame device chains (lax.scan carrying
+        solution AND fitted, models/sart solve_chain_normalized) from a
+        converged warm seed, PIPELINED one deep exactly like cli.py's
+        default frame loop — chain k+1 is dispatched before chain k's
+        solution fetch, so the fetch rides under the next chain's compute.
+        The reference's core workload (main.cpp:131-140). Reported as
+        artifact detail per rtm_dtype, not the headline (the headline
+        stays the fixed-iteration B=1 rate)."""
         from sartsolver_tpu.models.sart import (
             _resolve_fused, solve_chain_normalized,
         )
@@ -440,8 +462,8 @@ def _worker_main() -> int:
 
         K = 8
         opts = SolverOptions(max_iterations=2000, conv_tolerance=1e-5,
-                             fused_sweep="auto", rtm_dtype="bfloat16")
-        problem = get_problem("bfloat16")
+                             fused_sweep="auto", rtm_dtype=rtm_dtype)
+        problem = get_problem(rtm_dtype)
         # mirror the solve_normalized_batch dispatcher: attach whatever
         # scoped-VMEM limit the shape needs so env-overridden shapes fuse
         # here exactly as the sweep configs do (the default 8192x65536 bf16
@@ -471,25 +493,35 @@ def _worker_main() -> int:
         r_warm[0] = norms[K - 1] / norms[0]
         r_dev = jnp.asarray(r_warm, jnp.float32)
 
-        def run_w():
-            res, _fit = warmfn(problem, g, msq, sol, r_dev, fitted0=fit0)
-            np.asarray(res.solution)
-            return res
+        def dispatch(sol_c, fit_c):
+            """One warm chain dispatched asynchronously: only device
+            arrays in, only device arrays out — no host sync."""
+            res, fitn = warmfn(problem, g, msq, sol_c, r_dev, fitted0=fit_c)
+            return res.solution[-1:], fitn, res
 
-        res = run_w()  # compile the warm-variant program
-        best = float("inf")
-        for _ in range(3):
-            t_rep = time.perf_counter()
-            res = run_w()
-            best = min(best, time.perf_counter() - t_rep)
-        status = np.asarray(res.status)
+        # compile + converge the carry, then measure the pipelined steady
+        # state: chain i+1 dispatched before chain i's solution fetch
+        sol_c, fit_c, res = dispatch(sol, fit0)
+        np.asarray(res.solution)
+        n_chains = 6
+        t_rep = time.perf_counter()
+        sol_c, fit_c, pending = dispatch(sol_c, fit_c)
+        for _ in range(n_chains - 1):
+            sol_c, fit_c, nxt = dispatch(sol_c, fit_c)
+            np.asarray(pending.solution)  # fetch under the next chain
+            pending = nxt
+        np.asarray(pending.solution)
+        steady = time.perf_counter() - t_rep
+        status = np.asarray(pending.status)
         return {
             "frames_per_chain": K,
-            "ms_per_frame": round(best * 1e3 / K, 2),
-            "iters_per_frame": round(int(np.asarray(res.iterations).sum()) / K, 2),
+            "pipelined_chains": n_chains,
+            "ms_per_frame": round(steady * 1e3 / (K * n_chains), 2),
+            "iters_per_frame": round(
+                int(np.asarray(pending.iterations).sum()) / K, 2),
             "all_success": bool((status == 0).all()),
             "fused": fused_sel or "off",
-            "rtm_dtype": "bfloat16",
+            "rtm_dtype": rtm_dtype,
         }
 
     for item in spec["items"]:
@@ -509,7 +541,9 @@ def _worker_main() -> int:
                                   item["B"], item["reps"])
                 have_ok = True
             elif item["kind"] == "chain":
-                data = run_chain()
+                data = run_chain(item["rtm_dtype"])
+            elif item["kind"] == "probe":
+                data = run_probe()
             else:
                 data = run_converge(item["log"])
         except Exception as err:  # recorded per config, sweep continues
@@ -775,14 +809,24 @@ def main() -> int:
                    "deadline": budget_s + 240, "timeout": conv_timeout}
                   for name in ("linear", "log")]
     if on_accel and not quick and fused_possible:
-        # steady-state warm frame loop (the reference's core workload);
+        # steady-state PIPELINED warm frame loop, bf16 + int8 (the
+        # shipping CLI default over the reference's core workload);
         # detail-only, after converge, before the least-informative tail.
-        # conv_timeout: it cold-compiles TWO scan-over-while_loop chain
+        # conv_timeout: each cold-compiles TWO scan-over-while_loop chain
         # programs and runs convergence solves, like the converge items
-        items += [{"kind": "chain", "id": "chain:warm_loop",
-                   "deadline": budget_s + 240, "timeout": conv_timeout}]
+        items += [{"kind": "chain", "id": f"chain:warm_loop:{dt}",
+                   "rtm_dtype": dt, "deadline": budget_s + 240,
+                   "timeout": conv_timeout}
+                  for dt in ("bfloat16", "int8")]
         items += [sweep_item("off", dt, 1, 2, budget_s)
                   for dt in ("bfloat16", "float32")]
+    # session-variance anchor (VERDICT r4 next #5): a bare-matvec
+    # bandwidth probe brackets the sweep — never deadline-skipped, so
+    # every artifact carries both ends even on a cut budget
+    items.insert(0, {"kind": "probe", "id": "probe:start",
+                     "deadline": None, "timeout": cfg_timeout})
+    items.append({"kind": "probe", "id": "probe:end",
+                  "deadline": None, "timeout": cfg_timeout})
 
     spec_base = {"P": P, "V": V, "iters": iters, "our_bw": our_bw}
     results, hung = _run_worker_items(items, spec_base, t_start)
@@ -828,9 +872,23 @@ def main() -> int:
         "sweep": sweep,
         "time_to_converge": converge,
     }
-    chain = results.get("chain:warm_loop")
-    if chain is not None:
-        detail["warm_frame_loop"] = chain
+    chains = {dt: results[f"chain:warm_loop:{dt}"]
+              for dt in ("bfloat16", "int8")
+              if f"chain:warm_loop:{dt}" in results}
+    if chains:
+        detail["warm_frame_loop"] = chains
+    probes = {end: results[f"probe:{end}"] for end in ("start", "end")
+              if f"probe:{end}" in results}
+    if probes:
+        detail["bw_probe"] = probes
+        gbs = [p["gbs"] for p in probes.values()
+               if isinstance(p, dict) and "gbs" in p]
+        if gbs:
+            # the session-normalized headline: iter/s per probe-GB/s. A
+            # real regression moves this ratio; tunnel weather moves both
+            # numerator and denominator together.
+            detail["headline_per_probe_gbs"] = round(
+                head["loop_iter_s"] / (sum(gbs) / len(gbs)), 4)
     if degraded:
         detail["degraded"] = "; ".join(degraded)
     if hung:
