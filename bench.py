@@ -427,22 +427,41 @@ def _worker_main() -> int:
         }
 
     def run_probe() -> dict:
-        """~2 s fixed-shape bandwidth probe (VERDICT r4 next #5): a bare
-        fp32 matvec over the staged matrix — one full HBM read, nothing
-        else. Run at sweep start AND end, it anchors the headline against
-        the tunnel/session weather (the ±20% session variance BASELINE.md
-        records): headline/probe is comparable across sessions where raw
-        iter/s is not."""
+        """~0.35 s fixed-shape bandwidth probe (VERDICT r4 next #5): a
+        50-step power iteration over the staged fp32 matrix using the
+        solver's own forward/back projections — 100 full HBM streams per
+        fetch, nothing else. Run at sweep start AND end, it anchors the
+        headline against the tunnel/session weather (the ±20% session
+        variance BASELINE.md records): headline/probe is comparable
+        across sessions where raw iter/s is not."""
+        from jax import lax
+
+        from sartsolver_tpu.ops.projection import back_project, forward_project
+
         problem = get_problem("float32")
-        x = jnp.ones((V, 1), jnp.float32)
-        mv = jax.jit(lambda r, v: r @ v)
-        np.asarray(mv(problem.rtm, x))  # compile + warm
+        x = jnp.ones((1, V), jnp.float32)
+        N = 50  # 2N matrix streams per fetch: the ~68 ms tunnel round
+        # trip that dominated a single-stream probe amortizes to <10%
+
+        # power iteration over H^T H with the solver's own transpose-free
+        # projections — the exact dot_general lowerings the headline
+        # depends on (a naive `r @ x` gemv lowers pathologically on TPU),
+        # normalized each step so the loop has a genuine data dependence
+        # (nothing to hoist) and stays in fp32 range
+        def body(_, f, r):
+            w = forward_project(r, f, accum_dtype=jnp.float32)
+            bp = back_project(r, w, accum_dtype=jnp.float32)
+            return bp / jnp.sqrt(jnp.sum(bp * bp) + 1e-30)
+
+        probe_fn = jax.jit(lambda r, f0: lax.fori_loop(
+            0, N, lambda i, f: body(i, f, r), f0))
+        np.asarray(probe_fn(problem.rtm, x))  # compile + warm
         best = float("inf")
-        for _ in range(5):
+        for _ in range(3):
             t_rep = time.perf_counter()
-            np.asarray(mv(problem.rtm, x))
+            np.asarray(probe_fn(problem.rtm, x))
             best = min(best, time.perf_counter() - t_rep)
-        gbs = P * V * 4 / best / 1e9
+        gbs = 2 * N * P * V * 4 / best / 1e9
         return {"seconds": round(best, 5), "gbs": round(gbs, 1)}
 
     def run_chain(rtm_dtype: str) -> dict:
@@ -465,10 +484,12 @@ def _worker_main() -> int:
                              fused_sweep="auto", rtm_dtype=rtm_dtype)
         problem = get_problem(rtm_dtype)
         # mirror the solve_normalized_batch dispatcher: attach whatever
-        # scoped-VMEM limit the shape needs so env-overridden shapes fuse
-        # here exactly as the sweep configs do (the default 8192x65536 bf16
-        # B=1 needs none)
-        options = (fused_compile_options(P, V, 2, 1)
+        # scoped-VMEM limit THIS dtype's shape needs so the chain fuses
+        # exactly as the sweep configs do (bf16 B=1 at the default shape
+        # needs none; int8's fatter 12 MiB panels need the raise — a
+        # hardcoded bf16 itemsize here made the int8 chain resolve unfused
+        # and fail, caught by the r5 hardware run)
+        options = (fused_compile_options(P, V, problem.rtm.dtype.itemsize, 1)
                    if jax.default_backend() == "tpu" else None)
         fused_sel = _resolve_fused(opts, None, problem.rtm, 1,
                                    vmem_raised=options is not None)
@@ -500,26 +521,34 @@ def _worker_main() -> int:
             return res.solution[-1:], fitn, res
 
         # compile + converge the carry, then measure the pipelined steady
-        # state: chain i+1 dispatched before chain i's solution fetch
+        # state: chain i+1 dispatched before chain i's solution fetch.
+        # Every timed chain's result object is kept and its status/
+        # iterations fetched AFTER the timer (a per-chain scalar fetch
+        # inside the loop would serialize the pipeline) — a mid-run chain
+        # failure or iteration blow-up must show in the artifact, not
+        # silently inflate ms_per_frame.
         sol_c, fit_c, res = dispatch(sol, fit0)
         np.asarray(res.solution)
         n_chains = 6
+        timed = []
         t_rep = time.perf_counter()
         sol_c, fit_c, pending = dispatch(sol_c, fit_c)
+        timed.append(pending)
         for _ in range(n_chains - 1):
             sol_c, fit_c, nxt = dispatch(sol_c, fit_c)
             np.asarray(pending.solution)  # fetch under the next chain
             pending = nxt
+            timed.append(pending)
         np.asarray(pending.solution)
         steady = time.perf_counter() - t_rep
-        status = np.asarray(pending.status)
+        statuses = np.concatenate([np.asarray(r.status) for r in timed])
+        total_iters = sum(int(np.asarray(r.iterations).sum()) for r in timed)
         return {
             "frames_per_chain": K,
             "pipelined_chains": n_chains,
             "ms_per_frame": round(steady * 1e3 / (K * n_chains), 2),
-            "iters_per_frame": round(
-                int(np.asarray(pending.iterations).sum()) / K, 2),
-            "all_success": bool((status == 0).all()),
+            "iters_per_frame": round(total_iters / (K * n_chains), 2),
+            "all_success": bool((statuses == 0).all()),
             "fused": fused_sel or "off",
             "rtm_dtype": rtm_dtype,
         }
@@ -820,7 +849,7 @@ def main() -> int:
                   for dt in ("bfloat16", "int8")]
         items += [sweep_item("off", dt, 1, 2, budget_s)
                   for dt in ("bfloat16", "float32")]
-    # session-variance anchor (VERDICT r4 next #5): a bare-matvec
+    # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
     items.insert(0, {"kind": "probe", "id": "probe:start",
