@@ -179,3 +179,26 @@ def write_world(
         write_laplacian_file(paths["laplacian"])
 
     return paths, H, f_true, times_a, np.asarray(scales)
+
+
+class FakeDev:
+    """Device stub carrying the process_index a pod would assign."""
+
+    def __init__(self, process_index):
+        self.process_index = int(process_index)
+
+
+class FakeMesh:
+    """Duck-typed jax.sharding.Mesh stand-in exposing exactly the surface
+    multihost's partition helpers read (devices grid, axis_names, shape).
+    Accepts a 1-D list of per-pixel-block process indices (single voxel
+    shard) or a 2-D [pixel, voxel] object grid of FakeDev."""
+
+    axis_names = ("pixels", "voxels")
+
+    def __init__(self, procs):
+        arr = np.asarray(procs, dtype=object)
+        if arr.ndim == 1:
+            arr = np.array([[FakeDev(p)] for p in procs], dtype=object)
+        self.devices = arr
+        self.shape = {"pixels": arr.shape[0], "voxels": arr.shape[1]}

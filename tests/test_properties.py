@@ -361,6 +361,83 @@ def test_voxelmap_stitching_any_split(n_cells_per_seg, n_segs, seed):
 
 @SET
 @given(
+    st.integers(1, 8),   # pixel shards
+    st.integers(1, 3),   # voxel shards
+    st.integers(1, 200),  # npixel (unaligned with blocks on purpose)
+    st.integers(1, 4),   # process count
+    st.integers(0, 2**32 - 1),
+)
+def test_pixel_run_partition_any_layout(n_pix, n_vox, npixel, n_proc, seed):
+    """For ANY device->process assignment over ANY mesh shape, the
+    per-process pixel runs tile [0, npixel) exactly as the device grid
+    dictates: each logical row is covered once per distinct process
+    holding its pixel block (the measurement is sharded over 'pixels'
+    and replicated over 'voxels', so processes sharing a block via the
+    voxel axis each stage those rows), runs are disjoint increasing and
+    merged-contiguous per process, process_pixel_range agrees with the
+    runs exactly when the process's blocks are contiguous, and
+    all_processes_local_capable is True iff every process owns a logical
+    row (multihost.py:370-443 — the arithmetic that places measurement
+    rows across hosts, where a silent overlap/gap would mean wrong
+    physics, not a crash)."""
+    from unittest import mock
+
+    from sartsolver_tpu.parallel import multihost as mh
+
+    rng = np.random.default_rng(seed)
+    owners = rng.integers(0, n_proc, size=n_pix * n_vox)
+    owners[rng.integers(0, n_pix * n_vox)] = 0  # process 0 always exists
+    import fixtures as fx
+
+    grid = np.array([fx.FakeDev(int(p)) for p in owners],
+                    dtype=object).reshape(n_pix, n_vox)
+    mesh = fx.FakeMesh(grid)
+
+    covered = np.zeros(npixel, np.int32)
+    for proc in range(n_proc):
+        with mock.patch.object(mh.jax, "process_index", return_value=proc):
+            runs = mh.process_pixel_runs(mesh, npixel)
+            rng_or_none = mh.process_pixel_range(mesh, npixel)
+        last_end = -1
+        for off, cnt in runs:
+            assert cnt > 0 and off >= 0 and off + cnt <= npixel
+            assert off > last_end  # disjoint, increasing, merged
+            last_end = off + cnt
+            covered[off:off + cnt] += 1
+        total = sum(c for _, c in runs)
+        if proc in owners:
+            # range/runs consistency: a contiguous block set reports the
+            # merged single range; a non-contiguous one reports None
+            if rng_or_none is not None:
+                o, c = rng_or_none
+                assert c == total
+                if runs:
+                    assert (o, c) == (runs[0][0], total) and len(runs) == 1
+        else:
+            assert runs == [] and rng_or_none == (0, 0)
+    # coverage: each row exactly once per distinct process holding its
+    # pixel block (computed independently from the grid)
+    from sartsolver_tpu.parallel.mesh import ROW_ALIGN, padded_size
+
+    row_block = padded_size(npixel, n_pix * ROW_ALIGN) // n_pix
+    expect_cov = np.zeros(npixel, np.int32)
+    for r in range(npixel):
+        expect_cov[r] = len({d.process_index for d in grid[r // row_block]})
+    np.testing.assert_array_equal(covered, expect_cov)
+
+    # all_processes_local_capable: True iff every process WITH DEVICES
+    # owns at least one logical row
+    per_proc_rows = {}
+    for proc in {int(p) for p in owners}:
+        with mock.patch.object(mh.jax, "process_index", return_value=proc):
+            per_proc_rows[proc] = sum(
+                c for _, c in mh.process_pixel_runs(mesh, npixel))
+    expect = all(v > 0 for v in per_proc_rows.values())
+    assert mh.all_processes_local_capable(mesh, npixel) == expect
+
+
+@SET
+@given(
     st.integers(1, 6),  # completed frames before the "crash"
     st.integers(1, 3),  # frames still to write after resume
     st.sets(st.sampled_from(

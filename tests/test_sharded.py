@@ -399,18 +399,7 @@ def test_process_pixel_range_partition():
     process_index a pod would assign)."""
     from sartsolver_tpu.parallel.multihost import process_pixel_range
 
-    class Dev:
-        def __init__(self, p):
-            self.process_index = p
-
-    class FakeMesh:
-        axis_names = ("pixels", "voxels")
-
-        def __init__(self, procs):
-            self.devices = np.array(
-                [[Dev(p)] for p in procs], dtype=object
-            )
-            self.shape = {"pixels": len(procs), "voxels": 1}
+    from fixtures import FakeMesh
 
     # this test process is jax.process_index() == 0: it sees the range of
     # the blocks labeled 0
@@ -430,16 +419,7 @@ def test_process_pixel_runs_partition():
     #8): adjacent blocks merge, padding clips, gaps split runs."""
     from sartsolver_tpu.parallel.multihost import process_pixel_runs
 
-    class Dev:
-        def __init__(self, p):
-            self.process_index = p
-
-    class FakeMesh:
-        axis_names = ("pixels", "voxels")
-
-        def __init__(self, procs):
-            self.devices = np.array([[Dev(p)] for p in procs], dtype=object)
-            self.shape = {"pixels": len(procs), "voxels": 1}
+    from fixtures import FakeMesh
 
     npixel = 52  # padded to 4 shards * ROW_ALIGN 8 -> 64, row_block 16
     assert process_pixel_runs(FakeMesh([0, 0, 1, 1]), npixel) == [(0, 32)]
@@ -460,16 +440,7 @@ def test_all_processes_local_capable():
     (multi-run); only a padding-only process forces replicated staging."""
     from sartsolver_tpu.parallel.multihost import all_processes_local_capable
 
-    class Dev:
-        def __init__(self, p):
-            self.process_index = p
-
-    class FakeMesh:
-        axis_names = ("pixels", "voxels")
-
-        def __init__(self, procs):
-            self.devices = np.array([[Dev(p)] for p in procs], dtype=object)
-            self.shape = {"pixels": len(procs), "voxels": 1}
+    from fixtures import FakeMesh
 
     assert all_processes_local_capable(FakeMesh([0, 0, 1, 1]), 52)
     # non-contiguous ownership is fine now
