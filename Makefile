@@ -1,0 +1,56 @@
+# Developer/CI entry points for tpu-sartsolver.
+#
+#   make lint        - sartsolve lint --self (AST rules + compile audit)
+#   make test        - tier-1 test suite (CPU backend, ROADMAP.md contract)
+#   make verify      - lint, then tier-1 tests (the fail-fast CI path)
+#   make native-asan - rebuild the native helper with ASan+UBSan and run
+#                      its tests against it (skips cleanly with no g++)
+#   make goldens     - regenerate the compile-audit golden signatures for
+#                      this backend (commit the result)
+
+PYTHON ?= python
+BUILD_DIR ?= .build
+ASAN_SO := $(BUILD_DIR)/libsartrt_asan.so
+
+.PHONY: lint test verify native-asan goldens
+
+lint:
+	JAX_PLATFORMS=cpu $(PYTHON) -m sartsolver_tpu.cli lint --self
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# New static-analysis violations fail before the (much slower) test run.
+verify: lint test
+
+goldens:
+	JAX_PLATFORMS=cpu $(PYTHON) -m sartsolver_tpu.cli lint --audit-only \
+		--update-goldens
+
+# Sanitizer build of the native ingest helper (sartrt.cpp). The library is
+# a -shared object loaded via ctypes, so the sanitizer runtimes must be
+# preloaded into the python process; leak checking is disabled (the Python
+# interpreter's own allocations drown it in noise). Skips cleanly when no
+# compiler or sanitizer runtime is available.
+native-asan:
+	@command -v g++ >/dev/null 2>&1 || \
+		{ echo "native-asan: skipped (no g++)"; exit 0; }
+	@asan_rt=$$(g++ -print-file-name=libasan.so); \
+	ubsan_rt=$$(g++ -print-file-name=libubsan.so); \
+	if [ ! -e "$$asan_rt" ]; then \
+		echo "native-asan: skipped (no libasan runtime)"; exit 0; \
+	fi; \
+	mkdir -p $(BUILD_DIR); \
+	g++ -O1 -g -fno-omit-frame-pointer -fsanitize=address,undefined \
+		-shared -fPIC -std=c++17 \
+		sartsolver_tpu/native/sartrt.cpp -o $(ASAN_SO) || exit 1; \
+	echo "native-asan: built $(ASAN_SO); running tests/test_native.py"; \
+	preload="$$asan_rt"; \
+	[ -e "$$ubsan_rt" ] && preload="$$preload $$ubsan_rt"; \
+	env LD_PRELOAD="$$preload" \
+		ASAN_OPTIONS=detect_leaks=0:abort_on_error=1 \
+		UBSAN_OPTIONS=halt_on_error=1:print_stacktrace=1 \
+		SART_NATIVE_LIB=$(ASAN_SO) \
+		JAX_PLATFORMS=cpu \
+		$(PYTHON) -m pytest tests/test_native.py -q -p no:cacheprovider

@@ -1,0 +1,29 @@
+"""Static analysis for JAX hazards: AST lint rules + compile audit.
+
+Two cooperating passes, surfaced as the ``sartsolve lint`` CLI subcommand
+and the ``tests/test_analysis.py`` pytest integration:
+
+- :mod:`~sartsolver_tpu.analysis.rules` — AST lint of the package source
+  for tracer/dtype/host-sync/donation/except hazards (rule ids ``SL001``+,
+  inline-suppressible);
+- :mod:`~sartsolver_tpu.analysis.audit` — AOT compile audit of the
+  registered hot entry points (:mod:`~sartsolver_tpu.analysis.registry`)
+  against structural HLO invariants and checked-in golden op-histogram
+  signatures (``analysis/goldens/``);
+- :mod:`~sartsolver_tpu.analysis.hlo` — the shared compiled-HLO parsing
+  layer both the audit and the HLO regression tests drive.
+
+See ``docs/STATIC_ANALYSIS.md`` for the rule catalogue and workflows.
+"""
+
+from sartsolver_tpu.analysis.rules import (  # noqa: F401
+    ALL_RULES,
+    Finding,
+    lint_paths,
+    lint_source,
+)
+from sartsolver_tpu.analysis.registry import (  # noqa: F401
+    AUDIT_REGISTRY,
+    AuditEntry,
+    register_audit_entry,
+)

@@ -1,0 +1,275 @@
+"""Compile audit: structural invariants of the hot entry points' HLO.
+
+For every :class:`~sartsolver_tpu.analysis.registry.AuditEntry` the hot
+modules registered, this AOT-lowers the entry (abstract shapes — no device
+solve), compiles it, and checks:
+
+- **no f64** anywhere in the compiled module unless the entry opts in
+  (an accidental x64 promotion doubles sweep bandwidth);
+- **no matrix-sized transpose/copy inside the iteration body** (the
+  round-2 pathology: XLA re-streaming the tens-of-GB RTM every iteration);
+- **no matrix-sized ``convert`` inside the iteration body** (a dequantized
+  matrix copy erases the reduced-precision storage win; panel-sized
+  converts stay legal);
+- **per-iteration collective budget** (a collective that creeps into the
+  while body pays ICI latency every iteration);
+- **donation aliasing**: arguments the entry donates must carry
+  ``tf.aliasing_output`` markers in the lowering (donation that JAX/XLA
+  quietly drops is a silent memory regression);
+- **golden op-histogram signature**: the normalized opcode histogram of
+  the compiled module (full and loop-only) must match the checked-in
+  golden for this backend (``analysis/goldens/<entry>.<backend>.json``),
+  so ANY structural drift of a hot program — a new fusion barrier, a
+  vanished while loop, an extra transpose — shows up in review as a
+  golden diff instead of a benchmark regression three PRs later.
+  ``--update-goldens`` (or ``update_goldens=True``) rewrites them.
+
+The audit pins ``jax_enable_x64=False`` while lowering — the production
+fp32 device profile — and restores the caller's setting after, so running
+under the x64-enabled test harness audits the same programs the CLI ships.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence
+
+from sartsolver_tpu.analysis import hlo
+from sartsolver_tpu.analysis.registry import (
+    AUDIT_REGISTRY,
+    AuditEntry,
+    load_registered_entries,
+)
+
+GOLDENS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens")
+
+_ALIAS_MARKER_RE = re.compile(r"tf\.aliasing_output")
+
+
+@dataclasses.dataclass
+class EntryReport:
+    """Audit outcome for one registered entry."""
+
+    name: str
+    status: str  # ok | violation | golden-missing | golden-mismatch | updated | skipped | error
+    violations: List[str] = dataclasses.field(default_factory=list)
+    detail: str = ""
+
+    @property
+    def failed(self) -> bool:
+        return self.status in ("violation", "golden-missing",
+                               "golden-mismatch", "error")
+
+    def format(self) -> str:
+        lines = [f"[{self.status}] {self.name}" + (
+            f" — {self.detail}" if self.detail else "")]
+        lines += [f"    {v}" for v in self.violations]
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def _x64_disabled():
+    import jax
+
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+
+
+def check_invariants(
+    compiled_text: str,
+    entry: AuditEntry,
+    *,
+    lowered_text: Optional[str] = None,
+) -> List[str]:
+    """Invariant violations of one compiled module against its entry's
+    declarations (golden comparison handled separately). Reusable directly
+    by tests that build ad-hoc lowerings (tests/test_hlo_regressions.py)."""
+    out: List[str] = []
+    comps = hlo.computations(compiled_text)
+    bodies = hlo.while_body_names(compiled_text)
+    if entry.requires_while_loop and not bodies:
+        out.append(
+            "no while loop in compiled module — the iteration loop was "
+            "traced away (every loop invariant would pass vacuously)"
+        )
+    if not entry.allow_f64:
+        bad = hlo.f64_ops(compiled_text)
+        if bad:
+            out.append(
+                f"f64 ops in compiled module ({len(bad)}; x64 was not "
+                "requested — accidental promotion doubles sweep "
+                "bandwidth):\n      " + "\n      ".join(bad[:4])
+            )
+    if entry.loop_copy_threshold is not None and bodies:
+        bad = hlo.sized_loop_ops(
+            compiled_text, ("transpose", "copy"),
+            entry.loop_copy_threshold, comps=comps,
+        )
+        if bad:
+            out.append(
+                f"matrix-sized transpose/copy inside the iteration loop "
+                f"(>= {entry.loop_copy_threshold} elements; each one "
+                "re-streams the RTM every iteration):\n      "
+                + "\n      ".join(bad[:4])
+            )
+    if entry.loop_convert_threshold is not None and bodies:
+        bad = hlo.sized_loop_ops(
+            compiled_text, ("convert",),
+            entry.loop_convert_threshold, comps=comps,
+        )
+        if bad:
+            out.append(
+                f"matrix-sized convert inside the iteration loop "
+                f"(>= {entry.loop_convert_threshold} elements; erases the "
+                "reduced-precision storage win):\n      "
+                + "\n      ".join(bad[:4])
+            )
+    if entry.loop_collective_budget:
+        counts = hlo.loop_collective_counts(compiled_text, comps=comps)
+        for op, budget in entry.loop_collective_budget.items():
+            got = counts.get(op, 0)
+            if got > budget:
+                out.append(
+                    f"per-iteration `{op}` count {got} exceeds the "
+                    f"declared budget {budget}"
+                )
+    if entry.min_donated_args:
+        markers = 0
+        if lowered_text:
+            main = [l for l in lowered_text.splitlines()
+                    if "func.func public @main" in l]
+            markers = len(_ALIAS_MARKER_RE.findall(main[0])) if main else 0
+        # The compiled module's input_output_alias table is authoritative
+        # where the runtime keeps it (TPU); CPU runtimes drop it from the
+        # compiled text even for honored donations, so the lowering's
+        # tf.aliasing_output markers are accepted as the platform-
+        # independent record of the aliasing JAX established.
+        compiled_aliases = len(hlo.aliased_params(compiled_text))
+        if max(markers, compiled_aliases) < entry.min_donated_args:
+            out.append(
+                f"declared donation not reflected in input-output "
+                f"aliasing: {markers} `tf.aliasing_output` markers in the "
+                f"lowering, {compiled_aliases} aliased params in the "
+                f"compiled module, expected >= {entry.min_donated_args} "
+                "(JAX dropped the donation — e.g. shape/dtype mismatch "
+                "or an unsupported transform)"
+            )
+    return out
+
+
+def signature(compiled_text: str) -> Dict[str, Dict[str, int]]:
+    """The golden-file payload for one compiled module."""
+    return {
+        "histogram": hlo.op_histogram(compiled_text),
+        "loop_histogram": hlo.op_histogram(compiled_text, loop_only=True),
+    }
+
+
+def _golden_path(entry_name: str, backend: str, goldens_dir: str) -> str:
+    return os.path.join(goldens_dir, f"{entry_name}.{backend}.json")
+
+
+def run_entry(
+    entry: AuditEntry,
+    *,
+    update_goldens: bool = False,
+    goldens_dir: str = GOLDENS_DIR,
+    skip_goldens: bool = False,
+) -> EntryReport:
+    """Lower, compile and audit one registered entry."""
+    import jax
+
+    if len(jax.devices()) < entry.min_devices:
+        return EntryReport(
+            entry.name, "skipped",
+            detail=f"needs {entry.min_devices} devices, "
+                   f"{len(jax.devices())} visible",
+        )
+    try:
+        with _x64_disabled():
+            lowered = entry.build()
+            lowered_text = lowered.as_text()
+            compiled_text = lowered.compile().as_text()
+    except Exception as err:  # an unloweraable entry IS the finding
+        return EntryReport(
+            entry.name, "error",
+            detail=f"build/lower/compile failed: {type(err).__name__}: {err}",
+        )
+
+    violations = check_invariants(
+        compiled_text, entry, lowered_text=lowered_text
+    )
+    if violations:
+        return EntryReport(entry.name, "violation", violations)
+
+    if skip_goldens:
+        return EntryReport(entry.name, "ok", detail="goldens skipped")
+
+    backend = jax.default_backend()
+    sig = signature(compiled_text)
+    path = _golden_path(entry.name, backend, goldens_dir)
+    if update_goldens:
+        os.makedirs(goldens_dir, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(sig, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        return EntryReport(entry.name, "updated", detail=path)
+    if not os.path.exists(path):
+        return EntryReport(
+            entry.name, "golden-missing",
+            detail=f"{path} (run `sartsolve lint --self --update-goldens` "
+                   "on this backend and commit the result)",
+        )
+    with open(path, "r", encoding="utf-8") as fh:
+        golden = json.load(fh)
+    diffs: List[str] = []
+    for key in ("histogram", "loop_histogram"):
+        for d in hlo.diff_histograms(golden.get(key, {}), sig.get(key, {})):
+            diffs.append(f"{key}: {d}")
+    if diffs:
+        return EntryReport(
+            entry.name, "golden-mismatch", diffs,
+            detail=f"signature drifted from {path} (re-run with "
+                   "--update-goldens if the change is intended)",
+        )
+    return EntryReport(entry.name, "ok")
+
+
+def run_compile_audit(
+    *,
+    entries: Optional[Sequence[str]] = None,
+    update_goldens: bool = False,
+    goldens_dir: str = GOLDENS_DIR,
+    skip_goldens: bool = False,
+) -> List[EntryReport]:
+    """Audit all (or the named) registered entries; importing the hot
+    modules first so self-registrations run."""
+    registry = load_registered_entries()
+    names = list(entries) if entries is not None else sorted(registry)
+    reports: List[EntryReport] = []
+    for name in names:
+        if name not in registry:
+            reports.append(EntryReport(
+                name, "error",
+                detail=f"unknown entry; registered: {sorted(registry)}",
+            ))
+            continue
+        reports.append(run_entry(
+            registry[name], update_goldens=update_goldens,
+            goldens_dir=goldens_dir, skip_goldens=skip_goldens,
+        ))
+    return reports
+
+
+__all__ = [
+    "AUDIT_REGISTRY", "EntryReport", "GOLDENS_DIR", "check_invariants",
+    "run_compile_audit", "run_entry", "signature",
+]

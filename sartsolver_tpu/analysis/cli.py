@@ -1,0 +1,182 @@
+"""``sartsolve lint`` — CLI driver for the static-analysis subsystem.
+
+Dispatched by ``sartsolver_tpu.cli.main`` before the solver's own argument
+parser runs (the solver CLI keeps its flat reference-compatible flag set;
+``lint`` is the one subcommand). Two passes:
+
+- AST lint (analysis/rules.py) over explicit paths, or over the installed
+  package with ``--self``;
+- compile audit (analysis/audit.py) of the registered hot entry points,
+  run with ``--self`` (or ``--audit-only``) unless ``--no-audit``.
+
+Exit status: 1 when any error-severity lint finding or any audit failure
+(invariant violation, missing/mismatched golden, unbuildable entry)
+survives, else 0 — so CI/verify paths fail fast on new hazards.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def _force_cpu_device_count() -> None:
+    """The sharded audit entries need a multi-device mesh. On the CPU
+    backend XLA can fake one, but only if the flag lands before the first
+    backend initialization (importing jax is fine; instantiating a backend
+    latches XLA_FLAGS). The flag only affects the host (CPU) platform, so
+    setting it is harmless when the default backend turns out to be
+    TPU/GPU — hence no platform gate: a bare `sartsolve lint --self` on a
+    CPU-only machine still audits the sharded entries. Under pytest,
+    conftest.py already set this."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        return
+    if "jax" in sys.modules:
+        try:
+            from jax._src import xla_bridge
+
+            if xla_bridge._backends:
+                return  # a backend is live; the flag can no longer apply
+        except Exception:
+            # private-API probe failed (moved/renamed attribute): fall
+            # through and set the flag anyway — it is ignored when a
+            # backend is already live, while returning here would
+            # silently skip the sharded audit entries
+            pass
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def build_lint_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sartsolve lint",
+        description="Static analysis for JAX hazards: AST lint rules "
+                    "(SL001..) plus a compile audit of the registered hot "
+                    "entry points against golden HLO signatures.",
+    )
+    p.add_argument("paths", nargs="*",
+                   help="Files or directories to lint (recursively, *.py).")
+    p.add_argument("--self", dest="self_", action="store_true",
+                   help="Lint the installed sartsolver_tpu package and run "
+                        "the compile audit over its registered hot entry "
+                        "points.")
+    p.add_argument("--no-audit", action="store_true",
+                   help="Skip the compile audit (AST lint only).")
+    p.add_argument("--audit-only", action="store_true",
+                   help="Run only the compile audit (no AST lint).")
+    p.add_argument("--update-goldens", action="store_true",
+                   help="Rewrite the golden op-histogram signatures for the "
+                        "current backend (analysis/goldens/) instead of "
+                        "verifying them; commit the result.")
+    p.add_argument("--entries", default=None,
+                   help="Comma-separated audit entry names (default: all "
+                        "registered).")
+    p.add_argument("--severity", default="",
+                   help="Per-rule severity overrides, e.g. "
+                        "'SL004=error,SL003=off'.")
+    p.add_argument("--json", dest="json_", action="store_true",
+                   help="Machine-readable output (findings + audit reports).")
+    p.add_argument("--list-rules", action="store_true",
+                   help="Print the rule catalogue and exit.")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="Only print errors and the summary line.")
+    return p
+
+
+def lint_main(argv: Optional[List[str]] = None) -> int:
+    args = build_lint_parser().parse_args(argv)
+
+    from sartsolver_tpu.analysis.rules import ALL_RULES, lint_paths
+    from sartsolver_tpu.config import SartInputError, parse_severity_overrides
+
+    try:
+        overrides = parse_severity_overrides(args.severity)
+        known = {rule.id for rule in ALL_RULES}
+        unknown = sorted(set(overrides) - known)
+        if unknown:
+            raise SartInputError(
+                f"Unknown rule id(s) in --severity: {', '.join(unknown)}; "
+                f"known rules: {', '.join(sorted(known))}."
+            )
+    except SartInputError as err:
+        print(err, file=sys.stderr)
+        return 1
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id} [{rule.severity}] {rule.title}")
+            print(f"       fix: {rule.hint}")
+        return 0
+
+    if not (args.paths or args.self_ or args.audit_only):
+        print("sartsolve lint: pass paths to lint, or --self for the "
+              "installed package (see --help).", file=sys.stderr)
+        return 1
+
+    # ---- AST lint --------------------------------------------------------
+    findings = []
+    if not args.audit_only:
+        paths = list(args.paths)
+        if args.self_:
+            import sartsolver_tpu
+
+            paths.append(os.path.dirname(os.path.abspath(
+                sartsolver_tpu.__file__)))
+        if paths:
+            findings = lint_paths(paths, severity_overrides=overrides)
+
+    # ---- compile audit ---------------------------------------------------
+    reports = []
+    run_audit = (args.self_ or args.audit_only or args.update_goldens) \
+        and not args.no_audit
+    if run_audit:
+        _force_cpu_device_count()
+        from sartsolver_tpu.analysis.audit import run_compile_audit
+
+        entries = args.entries.split(",") if args.entries else None
+        reports = run_compile_audit(
+            entries=entries, update_goldens=args.update_goldens,
+        )
+
+    n_err = sum(1 for f in findings if f.severity == "error")
+    n_warn = sum(1 for f in findings if f.severity == "warning")
+    n_info = len(findings) - n_err - n_warn
+    failed_reports = [r for r in reports if r.failed]
+
+    if args.json_:
+        import dataclasses
+
+        print(json.dumps({
+            "findings": [dataclasses.asdict(f) for f in findings],
+            "audit": [dataclasses.asdict(r) for r in reports],
+            "errors": n_err,
+            "warnings": n_warn,
+        }, indent=1))
+    else:
+        for f in findings:
+            if args.quiet and f.severity != "error":
+                continue
+            print(f.format())
+            if f.hint and not args.quiet:
+                print(f"       fix: {f.hint}")
+        for r in reports:
+            if args.quiet and not r.failed:
+                continue
+            print(r.format())
+        summary = (
+            f"lint: {n_err} error(s), {n_warn} warning(s), "
+            f"{n_info} info finding(s)"
+        )
+        if reports:
+            summary += (
+                f"; audit: {sum(1 for r in reports if not r.failed)}/"
+                f"{len(reports)} entries ok"
+            )
+        print(summary)
+
+    return 1 if n_err or failed_reports else 0

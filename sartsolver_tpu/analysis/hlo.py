@@ -1,0 +1,238 @@
+"""Structural parsing of compiled HLO text.
+
+One home for the HLO-text spelunking that used to live ad hoc in
+``tests/test_hlo_regressions.py``: split a module into computations, find
+the computations a ``while`` op actually runs (body + condition, plus the
+fusions they call), and search those for ops by kind and operand size.
+Everything works on the output of ``lowered.compile().as_text()``; nothing
+here imports jax.
+
+HLO text anatomy this relies on (stable across the XLA versions this repo
+has seen):
+
+- a computation header looks like ``%name (params...) -> type {`` (the
+  entry computation is prefixed ``ENTRY``); its instructions follow until
+  the closing brace;
+- an instruction looks like ``%res = f32[8,128]{1,0} opcode(operands), ...``;
+- a ``while`` op names its computations via ``body=%name`` /
+  ``condition=%name``; fusions/calls via ``calls=%name`` / ``to_apply=%name``;
+- donation appears in the module header as
+  ``input_output_alias={ {out_idx}: (param, {param_idx}, may-alias) }``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+# dtype tokens that can carry solver data; pred/int4 etc. never matter here
+_SIZED_TYPE = r"(?:f64|f32|bf16|f16|s32|s8|u8|s64)"
+
+_HEADER_RE = re.compile(r"\s*(?:ENTRY\s+)?(%?[\w.\-]+)\s*\(.*->.*{")
+_CALLS_RE = re.compile(r"(?:calls=|to_apply=|body=|condition=)%?([\w.\-]+)")
+# The result-type prefix between `=` and the opcode can be a plain shape
+# (`f32[8,128]{1,0}`), a TUPLE shape (`(f32[512]{0}, s32[])` — e.g. a while
+# op or XLA's combined all-reduce), or carry TPU tiled-layout annotations
+# with nested parens (`{1,0:T(8,128)}`), so it cannot be matched with a
+# paren-free character class. The opcode is instead found as the first
+# lowercase identifier directly followed by `(` after the `=` — shape/
+# layout tokens never match (dtypes are followed by `[`, tile markers like
+# `T(8,128)`/`S(1)` are uppercase), verified against tuple-result and
+# tiled-layout lines in tests/test_analysis.py.
+_OPCODE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(?:[^=]*?\s)?([a-z][\w\-]*)\("
+)
+_SHAPE_RE = re.compile(_SIZED_TYPE + r"\[([0-9,]*)\]")
+_ALIAS_PAIR_RE = re.compile(r"\{[0-9,\s]*\}:\s*\(\s*(\d+)\s*,")
+
+
+def computations(txt: str) -> Dict[str, List[str]]:
+    """HLO text split into {computation_name: [instruction lines]}."""
+    comps: Dict[str, List[str]] = {}
+    current: Optional[str] = None
+    for line in txt.splitlines():
+        # header params can be TUPLE-typed (nested parens — e.g. a while
+        # body taking one tuple param), so don't try to match the params
+        # with [^)]*; name + open paren + '->' + '{' identifies a header
+        m = _HEADER_RE.match(line)
+        if m:
+            current = m.group(1).lstrip("%")
+            comps[current] = []
+        elif current is not None:
+            comps[current].append(line)
+    return comps
+
+
+def while_body_names(txt: str) -> Set[str]:
+    """Computation names referenced as a while op's body= attribute."""
+    names: Set[str] = set()
+    for m in re.finditer(r"while\([^)]*\).*?body=%?([\w.\-]+)", txt):
+        names.add(m.group(1))
+    return names
+
+
+def loop_reachable(
+    comps: Dict[str, List[str]], roots: Iterable[str]
+) -> Set[str]:
+    """Computations reachable from ``roots`` via calls/to_apply/body/
+    condition edges — i.e. everything that executes per loop iteration
+    when the roots are while bodies."""
+    reachable: Set[str] = set()
+    frontier = list(roots)
+    while frontier:
+        name = frontier.pop()
+        if name in reachable or name not in comps:
+            continue
+        reachable.add(name)
+        for line in comps[name]:
+            for m in _CALLS_RE.finditer(line):
+                frontier.append(m.group(1))
+    return reachable
+
+
+def _first_shape_elements(line: str) -> Optional[int]:
+    """Element count of the instruction's (first) result shape, or None
+    for scalars/token/tuple-only lines."""
+    m = _SHAPE_RE.search(line)
+    if not m:
+        return None
+    dims = m.group(1)
+    if not dims:
+        return 1
+    n = 1
+    for d in dims.split(","):
+        n *= int(d)
+    return n
+
+
+_COMMENT_RE = re.compile(r"/\*.*?\*/")
+
+
+def opcode_of(line: str) -> Optional[str]:
+    # wide tuple types carry /*index=N*/ comments whose '=' would stop the
+    # prefix match — strip them first
+    m = _OPCODE_RE.match(_COMMENT_RE.sub("", line))
+    return m.group(1) if m else None
+
+
+def sized_loop_ops(
+    txt: str,
+    opcodes: Iterable[str],
+    threshold: int,
+    *,
+    comps: Optional[Dict[str, List[str]]] = None,
+) -> List[str]:
+    """Instructions with opcode in ``opcodes`` and result size >= threshold
+    elements, inside while bodies (including fusions they call). Matches
+    ``op``, ``op-start`` and ``op-done`` forms so async collectives are
+    caught. Returns ``"computation: instruction"`` strings."""
+    comps = comps if comps is not None else computations(txt)
+    bodies = while_body_names(txt)
+    wanted = set(opcodes)
+    expanded = wanted | {f"{op}-start" for op in wanted} | {
+        f"{op}-done" for op in wanted
+    }
+    bad: List[str] = []
+    for name in sorted(loop_reachable(comps, bodies)):
+        for line in comps.get(name, []):
+            op = opcode_of(line)
+            if op not in expanded or op.endswith("-done"):
+                continue  # -done pairs with -start; count each op once
+            n = _first_shape_elements(line)
+            if n is not None and n >= threshold:
+                bad.append(f"{name}: {line.strip()}")
+    return bad
+
+
+def loop_collective_counts(
+    txt: str, *, comps: Optional[Dict[str, List[str]]] = None
+) -> Dict[str, int]:
+    """Per-iteration occurrence count of each collective op inside while
+    bodies. ``-start``/``-done`` async pairs count once (as the base op)."""
+    comps = comps if comps is not None else computations(txt)
+    bodies = while_body_names(txt)
+    counts: Dict[str, int] = {}
+    collectives = (
+        "all-reduce", "all-gather", "all-to-all", "collective-permute",
+        "reduce-scatter", "collective-broadcast",
+    )
+    for name in loop_reachable(comps, bodies):
+        for line in comps.get(name, []):
+            op = opcode_of(line)
+            if op is None or op.endswith("-done"):
+                continue
+            base = op[:-6] if op.endswith("-start") else op
+            if base in collectives:
+                counts[base] = counts.get(base, 0) + 1
+    return counts
+
+
+def f64_ops(txt: str) -> List[str]:
+    """Instructions producing or consuming an f64-typed operand anywhere in
+    the module (constants included — an f64 scalar constant is exactly how
+    an accidental Python-float promotion shows up)."""
+    bad = []
+    for name, lines in computations(txt).items():
+        for line in lines:
+            if "f64[" in line:
+                bad.append(f"{name}: {line.strip()}")
+    return bad
+
+
+def op_histogram(
+    txt: str, *, loop_only: bool = False
+) -> Dict[str, int]:
+    """Normalized opcode histogram of the module — the compiled program's
+    structural signature. Async ``-start``/``-done`` forms collapse onto
+    the base op so a scheduling change doesn't shift the signature;
+    ``loop_only`` restricts to computations reachable from while bodies
+    (the per-iteration signature, insensitive to setup/teardown changes)."""
+    comps = computations(txt)
+    if loop_only:
+        names = loop_reachable(comps, while_body_names(txt))
+    else:
+        names = set(comps)
+    hist: Dict[str, int] = {}
+    for name in names:
+        for line in comps.get(name, []):
+            op = opcode_of(line)
+            if op is None or op.endswith("-done"):
+                continue
+            if op.endswith("-start"):
+                op = op[:-6]
+            hist[op] = hist.get(op, 0) + 1
+    return dict(sorted(hist.items()))
+
+
+def aliased_params(txt: str) -> Set[int]:
+    """Parameter indices the module header's input_output_alias table maps
+    to an output — i.e. donations XLA actually honored. The table nests
+    braces (``{ {out}: (param, {index}, kind), ... }``), so its extent is
+    found by brace counting rather than a regex."""
+    key = "input_output_alias={"
+    i = txt.find(key)
+    if i < 0:
+        return set()
+    j = i + len(key)
+    depth = 1
+    start = j
+    while j < len(txt) and depth:
+        if txt[j] == "{":
+            depth += 1
+        elif txt[j] == "}":
+            depth -= 1
+        j += 1
+    body = txt[start:j - 1]
+    return {int(p.group(1)) for p in _ALIAS_PAIR_RE.finditer(body)}
+
+
+def diff_histograms(
+    golden: Dict[str, int], current: Dict[str, int]
+) -> List[str]:
+    """Human-readable op-histogram differences, empty when identical."""
+    out: List[str] = []
+    for op in sorted(set(golden) | set(current)):
+        g, c = golden.get(op, 0), current.get(op, 0)
+        if g != c:
+            out.append(f"{op}: golden {g} -> current {c}")
+    return out

@@ -1,0 +1,122 @@
+"""Compile-audit registry — hot entry points self-register here.
+
+The modules that own the solver's hot device programs (``models/sart.py``,
+``ops/fused_sweep.py``, ``parallel/sharded.py``) register a *builder* at
+import time: a zero-argument callable that constructs a representative
+fixture-shaped instance of the entry point and returns its
+``jax.stages.Lowered`` (AOT lowering on abstract or small concrete shapes —
+never a device solve). The auditor (``analysis/audit.py``) compiles each
+lowering and checks the structural invariants declared alongside it.
+
+This module is imported by the hot modules themselves, so it must stay
+dependency-free (no jax, no numpy): registration costs a dict insert, and
+all heavy work lives inside the builder, which only the auditor calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Mapping, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditEntry:
+    """One registered hot entry point plus its declared HLO invariants.
+
+    Invariant semantics (checked by ``analysis/audit.py:audit_hlo_text``):
+
+    - ``allow_f64``: when False, no ``f64``-typed op may appear anywhere in
+      the compiled module (an accidental x64 promotion doubles the HBM bill
+      of every sweep).
+    - ``loop_copy_threshold``: transpose/copy ops of at least this many
+      elements may not live inside ``while`` bodies (the round-2 pathology:
+      a matrix-sized copy re-streams the RTM every iteration). None skips.
+    - ``loop_convert_threshold``: same placement rule for ``convert`` ops —
+      a matrix-sized dtype conversion inside the iteration body erases the
+      reduced-precision storage win. Panel-sized converts (the int8 fused
+      sweep's in-VMEM dequantization) stay legal below the threshold.
+      None skips.
+    - ``loop_collective_budget``: per-iteration ceiling on collectives
+      inside while bodies, keyed by HLO op name (``all-reduce``,
+      ``all-gather``, ``all-to-all``, ``collective-permute``). Ops absent
+      from the mapping are unbudgeted. The count is per *occurrence* in the
+      body computations, i.e. per iteration of the solver loop.
+    - ``min_donated_args``: minimum number of lowered arguments that must
+      carry a ``tf.aliasing_output`` donation marker — i.e. donations JAX
+      actually established input-output aliasing for (a donation quietly
+      dropped by a transform or a shape/dtype mismatch is a silent memory
+      regression). Checked against the lowering, which records aliasing
+      platform-independently (CPU runtimes may drop it from the compiled
+      module).
+    - ``requires_while_loop``: the entry is an iterative solver core, so
+      the lowered module must contain a ``while`` op (guards against the
+      loop being traced away, which would make every loop invariant
+      vacuously pass).
+    - ``min_devices``: number of visible devices the builder needs (sharded
+      entries); the auditor reports the entry as skipped when fewer exist.
+    """
+
+    name: str
+    build: Callable[[], object]  # -> jax.stages.Lowered
+    description: str
+    allow_f64: bool = False
+    loop_copy_threshold: Optional[int] = None
+    loop_convert_threshold: Optional[int] = None
+    loop_collective_budget: Mapping[str, int] = dataclasses.field(
+        default_factory=dict
+    )
+    min_donated_args: int = 0
+    requires_while_loop: bool = True
+    min_devices: int = 1
+
+
+AUDIT_REGISTRY: Dict[str, AuditEntry] = {}
+
+# Shared fixture shape for the registered entries' AOT lowerings — small
+# but tile-aligned (pixels % 8, voxels % 128). Lives here (dependency-
+# free) so every registering module and its loop_copy/convert thresholds
+# derive from ONE definition: resizing the fixture cannot silently desync
+# a threshold from the matrix size.
+AUDIT_P, AUDIT_V = 128, 1024
+
+# Modules whose import triggers self-registration; the auditor imports
+# these before reading AUDIT_REGISTRY so "self-register at import" and
+# "auditor sees every entry" compose without a hard import cycle.
+ENTRY_MODULES = (
+    "sartsolver_tpu.models.sart",
+    "sartsolver_tpu.ops.fused_sweep",
+    "sartsolver_tpu.parallel.sharded",
+)
+
+
+def register_audit_entry(name: str, *, description: str, **invariants):
+    """Decorator: register ``builder`` as audit entry ``name``.
+
+    Usage (inside a hot module, at import time)::
+
+        @register_audit_entry("sweep", description="...", ...)
+        def _audit_sweep():
+            ...
+            return jitted.lower(*abstract_args)
+    """
+
+    def deco(builder: Callable[[], object]):
+        if name in AUDIT_REGISTRY:
+            raise ValueError(f"duplicate audit entry {name!r}")
+        AUDIT_REGISTRY[name] = AuditEntry(
+            name=name, build=builder, description=description, **invariants
+        )
+        return builder
+
+    return deco
+
+
+def load_registered_entries() -> Dict[str, AuditEntry]:
+    """Import the hot modules (running their registrations) and return the
+    registry. Import errors propagate — an unimportable hot module is
+    itself an audit failure, not something to skip past."""
+    import importlib
+
+    for mod in ENTRY_MODULES:
+        importlib.import_module(mod)
+    return dict(AUDIT_REGISTRY)

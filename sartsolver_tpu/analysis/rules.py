@@ -1,0 +1,883 @@
+"""AST lint rules for JAX hazards (``sartsolve lint``).
+
+Static analysis of the package source for the defect classes that cost the
+most on accelerators and fail the least loudly: tracer-dependent Python
+control flow, host synchronization inside solver loops, implicit dtype
+promotion, missed buffer donation, recompilation-forcing argument use, and
+exception handlers that swallow device errors. Each rule is a small class
+with a stable id (``SL001``...), a default severity, and a fix hint; the
+engine walks each file's AST once, building a shared :class:`ModuleModel`
+(import aliases, jit application sites, traced-function table,
+device-derived value tracking) that every rule reads.
+
+These are *heuristics* tuned for high precision over recall: a rule only
+fires when the hazard pattern is structurally explicit (e.g. a branch on an
+unannotated or ``Array``-annotated parameter of a jitted function), so a
+clean run is meaningful and a finding is actionable. Deliberate exceptions
+are annotated inline::
+
+    risky_line()  # sart-lint: disable=SL002
+
+(also accepted on the line above; ``disable=all`` silences every rule, and
+``# sart-lint: disable-file=SL003`` anywhere in the first ten lines
+silences a rule for the whole file). The package policy — enforced by
+``tests/test_analysis.py::test_package_self_lint_clean`` — is that every
+suppression carries a comment saying why, so they stay auditable by grep.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+_SUPPRESS_RE = re.compile(r"#\s*sart-lint:\s*disable=([\w,]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*sart-lint:\s*disable-file=([\w,]+)")
+
+# identifiers that mark an annotation as "array-valued" (traced under jit)
+_ARRAY_ANNOTATION_IDS = {
+    "Array", "ndarray", "ArrayLike", "DeviceArray", "jax", "jnp",
+}
+
+# jnp constructors that take an explicit dtype, with the positional index
+# dtype occupies (None = keyword-only for lint purposes: flag unless the
+# dtype= kwarg is present)
+_DTYPE_CTORS: Dict[str, Optional[int]] = {
+    "zeros": 1, "ones": 1, "empty": 1, "full": 2,
+    "arange": None, "linspace": None, "eye": None, "identity": None,
+}
+# value-preserving converters: only literal arguments promote (a Python
+# float becomes f64 under x64), so only literal arguments are flagged
+_VALUE_CTORS = ("array", "asarray")
+
+_STATE_NAME_RE = re.compile(r"(update|step|rescale|advance|sweep_state)",
+                            re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning" | "info"
+    path: str
+    line: int
+    col: int
+    message: str
+    hint: str
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.rule} {self.severity}: {self.message}")
+
+
+# --------------------------------------------------------------------------
+# module model
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class JitSite:
+    """One function whose parameters are traced (jit target, or a callee of
+    lax control flow)."""
+
+    func: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    static_names: Set[str]
+    has_donate: bool
+    jit_node: ast.AST  # the call/decorator that applies jit (for location)
+    via: str  # "jit" | "lax"
+
+
+def _walk_parents(tree: ast.AST) -> None:
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._sart_parent = node  # type: ignore[attr-defined]
+
+
+def _parents(node: ast.AST) -> Iterator[ast.AST]:
+    while True:
+        node = getattr(node, "_sart_parent", None)
+        if node is None:
+            return
+        yield node
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    """Base Name of an attribute/subscript/call chain (``a.b[0].c()``->a)."""
+    while True:
+        if isinstance(expr, ast.Name):
+            return expr.id
+        if isinstance(expr, ast.Attribute):
+            expr = expr.value
+        elif isinstance(expr, ast.Subscript):
+            expr = expr.value
+        elif isinstance(expr, ast.Call):
+            expr = expr.func
+        else:
+            return None
+
+
+def _attr_path(expr: ast.AST) -> Optional[str]:
+    """Dotted path of a Name/Attribute chain (``jax.numpy.zeros``), or
+    None for anything more dynamic."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleModel:
+    """Everything the rules need from one parsed source file."""
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.src = src
+        self.tree = ast.parse(src, filename=path)
+        _walk_parents(self.tree)
+        self.lines = src.splitlines()
+
+        # ---- suppressions ------------------------------------------------
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                self.line_suppressions[i] = set(m.group(1).split(","))
+            if i <= 10:
+                mf = _SUPPRESS_FILE_RE.search(line)
+                if mf:
+                    self.file_suppressions |= set(mf.group(1).split(","))
+
+        # ---- import aliases ---------------------------------------------
+        self.jax_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.np_aliases: Set[str] = set()
+        self.lax_aliases: Set[str] = set()
+        self.jit_names: Set[str] = set()  # from jax import jit
+        self.partial_names: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name
+                    if a.name == "jax":
+                        self.jax_aliases.add(name)
+                    elif a.name == "jax.numpy":
+                        self.jnp_aliases.add(a.asname or "jax.numpy")
+                    elif a.name == "numpy":
+                        self.np_aliases.add(name)
+                    elif a.name == "functools":
+                        self.partial_names.add(f"{name}.partial")
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    name = a.asname or a.name
+                    if node.module == "jax":
+                        if a.name == "numpy":
+                            self.jnp_aliases.add(name)
+                        elif a.name == "lax":
+                            self.lax_aliases.add(name)
+                        elif a.name in ("jit", "pjit"):
+                            self.jit_names.add(name)
+                    elif node.module == "functools" and a.name == "partial":
+                        self.partial_names.add(name)
+
+        # ---- module-level string-tuple constants (static_argnames refs) --
+        self.str_tuple_consts: Dict[str, Set[str]] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                val = node.value
+                if isinstance(val, (ast.Tuple, ast.List)) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str)
+                    for e in val.elts
+                ):
+                    self.str_tuple_consts[node.targets[0].id] = {
+                        e.value for e in val.elts
+                    }
+
+        # ---- function table & jit application sites ----------------------
+        self.functions: Dict[str, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+        self.jit_sites: List[JitSite] = []
+        self._collect_jit_sites()
+
+    # ---- jit detection ---------------------------------------------------
+
+    def is_jit_ref(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.jit_names
+        path = _attr_path(expr)
+        if path is None:
+            return False
+        head, _, tail = path.partition(".")
+        return head in self.jax_aliases and tail in ("jit", "pjit")
+
+    def _is_partial_ref(self, expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.partial_names
+        return _attr_path(expr) in self.partial_names
+
+    def _static_names_from_kwargs(
+        self, call: ast.Call, func: Optional[ast.AST]
+    ) -> Tuple[Set[str], bool]:
+        names: Set[str] = set()
+        has_donate = False
+        params: List[str] = []
+        if isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = [a.arg for a in func.args.args]
+        elif isinstance(func, ast.Lambda):
+            params = [a.arg for a in func.args.args]
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                has_donate = True
+            elif kw.arg == "static_argnames":
+                names |= self._resolve_str_seq(kw.value)
+            elif kw.arg == "static_argnums":
+                for idx in self._resolve_int_seq(kw.value):
+                    if 0 <= idx < len(params):
+                        names.add(params[idx])
+        return names, has_donate
+
+    def _resolve_str_seq(self, expr: ast.AST) -> Set[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return {expr.value}
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for e in expr.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    out.add(e.value)
+            return out
+        if isinstance(expr, ast.Name):
+            return set(self.str_tuple_consts.get(expr.id, set()))
+        return set()
+
+    @staticmethod
+    def _resolve_int_seq(expr: ast.AST) -> List[int]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            return [expr.value]
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return [
+                e.value for e in expr.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)
+            ]
+        return []
+
+    def _jit_call_parts(
+        self, call: ast.Call
+    ) -> Optional[Tuple[ast.Call, List[ast.AST]]]:
+        """If ``call`` applies jit, return (the call carrying jit kwargs,
+        the wrapped-function expressions). Handles ``jax.jit(f, ...)``,
+        ``partial(jax.jit, ...)(f)`` and decorator forms."""
+        if self.is_jit_ref(call.func):
+            return call, list(call.args[:1])
+        if isinstance(call.func, ast.Call) and self._is_partial_ref(
+            call.func.func
+        ) and call.func.args and self.is_jit_ref(call.func.args[0]):
+            return call.func, list(call.args[:1])
+        return None
+
+    def _resolve_func(self, expr: ast.AST) -> Optional[ast.AST]:
+        if isinstance(expr, ast.Lambda):
+            return expr
+        if isinstance(expr, ast.Name):
+            return self.functions.get(expr.id)
+        # functools.partial(fn, ...) wrapping — look through to fn
+        if isinstance(expr, ast.Call) and self._is_partial_ref(expr.func) \
+                and expr.args:
+            return self._resolve_func(expr.args[0])
+        return None
+
+    def _collect_jit_sites(self) -> None:
+        lax_flow = ("while_loop", "fori_loop", "scan", "cond", "switch")
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self.is_jit_ref(dec):
+                        self.jit_sites.append(JitSite(
+                            node, set(), False, dec, "jit"))
+                    elif isinstance(dec, ast.Call):
+                        if self.is_jit_ref(dec.func):
+                            names, donate = self._static_names_from_kwargs(
+                                dec, node)
+                            self.jit_sites.append(JitSite(
+                                node, names, donate, dec, "jit"))
+                        elif self._is_partial_ref(dec.func) and dec.args \
+                                and self.is_jit_ref(dec.args[0]):
+                            names, donate = self._static_names_from_kwargs(
+                                dec, node)
+                            self.jit_sites.append(JitSite(
+                                node, names, donate, dec, "jit"))
+            elif isinstance(node, ast.Call):
+                parts = self._jit_call_parts(node)
+                if parts is not None:
+                    kw_call, wrapped = parts
+                    for expr in wrapped:
+                        func = self._resolve_func(expr)
+                        if func is not None:
+                            names, donate = self._static_names_from_kwargs(
+                                kw_call, func)
+                            self.jit_sites.append(JitSite(
+                                func, names, donate, node, "jit"))
+                else:
+                    # lax control-flow callees: their params are traced
+                    path = _attr_path(node.func)
+                    if path:
+                        head = path.split(".")[0]
+                        tail = path.split(".")[-1]
+                        in_lax = (
+                            head in self.lax_aliases
+                            or (head in self.jax_aliases and ".lax." in f".{path}.")
+                        )
+                        if in_lax and tail in lax_flow:
+                            for arg in node.args:
+                                func = self._resolve_func(arg)
+                                if func is not None:
+                                    self.jit_sites.append(JitSite(
+                                        func, set(), True, node, "lax"))
+
+    # ---- shared queries --------------------------------------------------
+
+    # jax.* functions whose results are device arrays (the jax module also
+    # hosts non-array APIs — jax.devices(), jax.profiler — that must not
+    # poison the device-derived value tracking)
+    _JAX_ARRAY_FNS = {
+        "device_put", "jit", "pjit", "vmap", "pmap", "grad",
+        "value_and_grad", "checkpoint", "remat",
+    }
+
+    def is_device_call(self, call: ast.Call) -> bool:
+        """Call whose result is (or wraps) a device array: anything rooted
+        at a jnp/lax alias, or the array-producing subset of jax.*."""
+        path = _attr_path(call.func)
+        if path is None:
+            # jax.jit(f)(...) / partial(jax.jit, ...)(f)(...): the callee
+            # is itself a call that applies jit
+            if isinstance(call.func, ast.Call):
+                return self._jit_call_parts(call.func) is not None
+            return False
+        head = path.split(".")[0]
+        if head in self.jnp_aliases | self.lax_aliases:
+            return True
+        if head in self.jax_aliases:
+            rest = path.split(".")[1:]
+            # jax.numpy.zeros / jax.lax.psum via the full path
+            if rest and rest[0] in ("numpy", "lax"):
+                return True
+            return bool(rest) and rest[0] in self._JAX_ARRAY_FNS
+        return False
+
+    def jnp_call_name(self, call: ast.Call) -> Optional[str]:
+        """Function name for a ``jnp.<name>(...)`` call, else None."""
+        path = _attr_path(call.func)
+        if path is None:
+            return None
+        head, _, tail = path.rpartition(".")
+        return tail if head in self.jnp_aliases else None
+
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        if rule_id in self.file_suppressions or "all" in self.file_suppressions:
+            return True
+        for ln in (line, line - 1):
+            sup = self.line_suppressions.get(ln)
+            if sup and (rule_id in sup or "all" in sup):
+                return True
+        return False
+
+
+def _scoped_walk(root: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that stays in ``root``'s scope: does not descend into
+    nested function definitions or lambdas (they get their own pass)."""
+    stack: List[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _traced_params(site: JitSite) -> Set[str]:
+    """Parameter names of a jit site that carry tracers: not static, and
+    either unannotated or annotated with an array-ish type. A parameter
+    annotated ``bool``/``str``/``SolverOptions``/... is assumed to be a
+    trace-time constant (branching on it fails loudly at trace time anyway,
+    so flagging it would only add noise)."""
+    args = site.func.args
+    out: Set[str] = set()
+    all_args = (
+        args.posonlyargs + args.args + args.kwonlyargs
+        + ([args.vararg] if args.vararg else [])
+    )
+    for a in all_args:
+        if a.arg in site.static_names or a.arg in ("self", "cls"):
+            continue
+        ann = a.annotation
+        if ann is None:
+            out.add(a.arg)
+            continue
+        ids = {
+            n.id for n in ast.walk(ann) if isinstance(n, ast.Name)
+        } | {
+            n.attr for n in ast.walk(ann) if isinstance(n, ast.Attribute)
+        } | ({ann.value} if isinstance(ann, ast.Constant)
+             and isinstance(ann.value, str) else set())
+        ann_text = " ".join(str(i) for i in ids)
+        if any(marker in ann_text for marker in _ARRAY_ANNOTATION_IDS):
+            out.add(a.arg)
+    return out
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+
+class Rule:
+    """Base class: subclasses define id/severity/title/hint and ``run``."""
+
+    id: str = ""
+    severity: str = "warning"
+    title: str = ""
+    hint: str = ""
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(
+        self, model: ModuleModel, node: ast.AST, message: str,
+        severity: Optional[str] = None,
+    ) -> Finding:
+        return Finding(
+            rule=self.id, severity=severity or self.severity,
+            path=model.path, line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0), message=message,
+            hint=self.hint,
+        )
+
+
+class TracerControlFlow(Rule):
+    """SL001 — Python ``if``/``while`` on a traced value inside a jitted
+    function: raises ``TracerBoolConversionError`` at trace time at best,
+    silently bakes one branch into the compiled program at worst. ``is
+    None`` tests and ``isinstance`` checks are static and exempt."""
+
+    id = "SL001"
+    severity = "error"
+    title = "tracer-dependent Python control flow in jitted function"
+    hint = ("replace with lax.cond/lax.select/jnp.where, or mark the "
+            "argument static (static_argnums/static_argnames)")
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        for site in model.jit_sites:
+            traced = _traced_params(site)
+            if not traced:
+                continue
+            for node in ast.walk(site.func):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                names = self._dynamic_test_names(node.test, traced)
+                if names:
+                    kind = "while" if isinstance(node, ast.While) else "if"
+                    yield self.finding(
+                        model, node,
+                        f"Python `{kind}` on traced parameter(s) "
+                        f"{', '.join(sorted(names))} inside a jitted "
+                        "function",
+                    )
+
+    @staticmethod
+    def _dynamic_test_names(test: ast.AST, traced: Set[str]) -> Set[str]:
+        exempt: Set[ast.AST] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                for sub in ast.walk(node):
+                    exempt.add(sub)
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id in (
+                    "isinstance", "len", "callable", "hasattr",
+                ):
+                    # len() of a traced array is its static leading dim;
+                    # isinstance/hasattr are type-level, always static
+                    for sub in ast.walk(node):
+                        exempt.add(sub)
+        out: Set[str] = set()
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name) and node.id in traced \
+                    and node not in exempt:
+                out.add(node.id)
+        return out
+
+
+class HostSyncInLoop(Rule):
+    """SL002 — host synchronization on a device value inside a Python
+    loop: ``.item()``, ``float()``/``int()``/``bool()``, ``np.asarray()``
+    on a value produced by a jnp/jax/lax call force a blocking D2H
+    round trip per loop step, serializing the device pipeline (~68 ms per
+    trip on a tunneled backend vs ~9 ms of device work — BASELINE.md)."""
+
+    id = "SL002"
+    severity = "error"
+    title = "host sync on device value inside a loop"
+    hint = ("hoist the transfer out of the loop, batch the fetches, or "
+            "keep the value on device (jnp.where/lax.cond)")
+
+    _CASTS = ("float", "int", "bool")
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        funcs = [
+            n for n in ast.walk(model.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Module))
+        ]
+        for func in funcs:
+            device = self._device_names(model, func)
+            for node in _scoped_walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not self._in_loop(node, func):
+                    continue
+                msg = self._sync_message(model, node, device)
+                if msg:
+                    yield self.finding(model, node, msg)
+
+    @staticmethod
+    def _in_loop(node: ast.AST, scope: ast.AST) -> bool:
+        for p in _parents(node):
+            if p is scope:
+                return False
+            if isinstance(p, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+            if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                return False  # a nested def's body isn't run by this loop
+        return False
+
+    def _device_names(self, model: ModuleModel, func: ast.AST) -> Set[str]:
+        """Names assigned (anywhere in the function) from an expression
+        containing a jnp/jax/lax-rooted call — one-step transitive."""
+        device: Set[str] = set()
+        for _ in range(2):  # two passes pick up x = jnp...; y = x + 1
+            for node in _scoped_walk(func):
+                if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                         ast.AnnAssign)):
+                    continue
+                value = node.value
+                if value is None:
+                    continue
+                is_dev = any(
+                    isinstance(sub, ast.Call) and model.is_device_call(sub)
+                    for sub in ast.walk(value)
+                ) or any(
+                    isinstance(sub, ast.Name) and sub.id in device
+                    for sub in ast.walk(value)
+                )
+                if not is_dev:
+                    continue
+                targets = (
+                    node.targets if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        device.add(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for e in t.elts:
+                            if isinstance(e, ast.Name):
+                                device.add(e.id)
+        return device
+
+    def _sync_message(
+        self, model: ModuleModel, call: ast.Call, device: Set[str]
+    ) -> Optional[str]:
+        def is_device_expr(expr: ast.AST) -> bool:
+            if any(
+                isinstance(sub, ast.Call) and model.is_device_call(sub)
+                for sub in ast.walk(expr)
+            ):
+                return True
+            root = _root_name(expr)
+            return root is not None and root in device
+
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" \
+                and not call.args and is_device_expr(fn.value):
+            return "`.item()` on a device value inside a loop"
+        if isinstance(fn, ast.Name) and fn.id in self._CASTS and call.args \
+                and is_device_expr(call.args[0]):
+            return (f"`{fn.id}()` on a device value inside a loop "
+                    "(implicit blocking transfer)")
+        path = _attr_path(fn)
+        if path:
+            head, _, tail = path.rpartition(".")
+            if head in model.np_aliases and tail in ("asarray", "array") \
+                    and call.args and is_device_expr(call.args[0]):
+                return (f"`{path}()` on a device value inside a loop "
+                        "(implicit blocking transfer)")
+        return None
+
+
+class ImplicitDtype(Rule):
+    """SL003 — jnp constructor without an explicit dtype: under
+    ``jax_enable_x64`` (the fp64 CPU-parity profile flips it on
+    process-wide) ``jnp.zeros(n)`` silently materializes f64, doubling
+    sweep bandwidth; the compile audit's no-f64 invariant catches the
+    compiled symptom, this catches the source."""
+
+    id = "SL003"
+    severity = "warning"
+    title = "jnp constructor without explicit dtype"
+    hint = "pass dtype= explicitly (the solver's compute dtype, opts.dtype)"
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = model.jnp_call_name(node)
+            if name is None:
+                continue
+            has_dtype_kw = any(kw.arg == "dtype" for kw in node.keywords)
+            if has_dtype_kw:
+                continue
+            if name in _DTYPE_CTORS:
+                pos = _DTYPE_CTORS[name]
+                if pos is not None and len(node.args) > pos:
+                    continue  # dtype passed positionally
+                yield self.finding(
+                    model, node,
+                    f"`jnp.{name}(...)` without an explicit dtype",
+                )
+            elif name in _VALUE_CTORS and node.args and len(node.args) < 2:
+                arg = node.args[0]
+                literal = isinstance(arg, ast.Constant) or (
+                    isinstance(arg, (ast.List, ast.Tuple)) and all(
+                        isinstance(e, ast.Constant) for e in arg.elts
+                    )
+                )
+                if literal:
+                    yield self.finding(
+                        model, node,
+                        f"`jnp.{name}()` of a Python literal without an "
+                        "explicit dtype (weak-type promotion; f64 under "
+                        "x64)",
+                    )
+
+
+class MissingDonation(Rule):
+    """SL004 — ``jax.jit`` without ``donate_argnums``/``donate_argnames``
+    on a function that looks like a state update (name matches
+    update/step/rescale/advance): the old state buffer stays live across
+    the call, doubling the state's HBM footprint. Informational — donation
+    is only safe when the caller provably never reuses the argument."""
+
+    id = "SL004"
+    severity = "info"
+    title = "state-update jit without buffer donation"
+    hint = ("donate the state argument (donate_argnums=...) if the caller "
+            "never reuses it; annotate with sart-lint: disable=SL004 if "
+            "reuse is intended")
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        for site in model.jit_sites:
+            if site.via != "jit" or site.has_donate:
+                continue
+            name = self._site_name(site)
+            if name and _STATE_NAME_RE.search(name):
+                yield self.finding(
+                    model, site.jit_node,
+                    f"jit of state-updating `{name}` without "
+                    "donate_argnums/donate_argnames",
+                )
+
+    @staticmethod
+    def _site_name(site: JitSite) -> Optional[str]:
+        if isinstance(site.func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return site.func.name
+        # lambda: use the assignment target of the jit call, if any
+        for p in _parents(site.jit_node):
+            if isinstance(p, ast.Assign) and p.targets:
+                t = p.targets[0]
+                if isinstance(t, ast.Name):
+                    return t.id
+                if isinstance(t, ast.Attribute):  # self._rescale_fn = ...
+                    return t.attr
+            if not isinstance(p, (ast.Call, ast.keyword)):
+                break
+        return None
+
+
+class StaticArgCandidate(Rule):
+    """SL005 — a traced parameter used in a shape position (``range()``,
+    a jnp constructor's shape argument, ``.reshape()``): concretization
+    fails at trace time, and "fixing" it by making the arg static without
+    thought forces a recompile per distinct value. Surfaced so the choice
+    is made explicitly."""
+
+    id = "SL005"
+    severity = "warning"
+    title = "traced parameter used in a shape position"
+    hint = ("mark the parameter static (static_argnums/static_argnames) "
+            "and audit call sites for value churn, or derive the shape "
+            "from an input array's .shape")
+
+    _SHAPE_CTORS = ("zeros", "ones", "empty", "full", "arange", "linspace")
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        for site in model.jit_sites:
+            traced = _traced_params(site)
+            if not traced:
+                continue
+            for node in ast.walk(site.func):
+                if not isinstance(node, ast.Call):
+                    continue
+                used = self._shape_position_params(model, node, traced)
+                for pname in sorted(used):
+                    yield self.finding(
+                        model, node,
+                        f"traced parameter `{pname}` used in a shape "
+                        "position (forces concretization / recompile)",
+                    )
+
+    def _shape_position_params(
+        self, model: ModuleModel, call: ast.Call, traced: Set[str]
+    ) -> Set[str]:
+        fn = call.func
+        shape_args: List[ast.AST] = []
+        if isinstance(fn, ast.Name) and fn.id == "range":
+            shape_args = list(call.args)
+        elif isinstance(fn, ast.Attribute) and fn.attr == "reshape":
+            shape_args = list(call.args)
+        else:
+            name = model.jnp_call_name(call)
+            if name in self._SHAPE_CTORS and call.args:
+                shape_args = [call.args[0]]
+        out: Set[str] = set()
+        for arg in shape_args:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Name) and sub.id in traced:
+                    out.add(sub.id)
+        return out
+
+
+class BroadExceptDeviceCode(Rule):
+    """SL006 — a bare ``except:`` (error), or ``except Exception``/
+    ``except BaseException`` whose try body runs device code (warning):
+    XLA compile errors, tracer leaks and debug-NaN aborts get swallowed,
+    turning a loud failure into silent wrong results or dead fallbacks."""
+
+    id = "SL006"
+    severity = "error"
+    title = "bare/broad except around device code"
+    hint = ("catch the specific exceptions the device call raises, or "
+            "re-raise after cleanup; annotate deliberate fallbacks")
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        for node in ast.walk(model.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            body_has_device = any(
+                isinstance(sub, ast.Call) and model.is_device_call(sub)
+                for stmt in node.body for sub in ast.walk(stmt)
+            )
+            for handler in node.handlers:
+                if handler.type is None:
+                    yield self.finding(
+                        model, handler,
+                        "bare `except:` (swallows KeyboardInterrupt and "
+                        "every device error)",
+                    )
+                elif body_has_device and isinstance(handler.type, ast.Name) \
+                        and handler.type.id in ("Exception", "BaseException"):
+                    yield self.finding(
+                        model, handler,
+                        f"`except {handler.type.id}` around device code "
+                        "(swallows XLA/tracer errors)",
+                        severity="warning",
+                    )
+
+
+ALL_RULES: Tuple[Rule, ...] = (
+    TracerControlFlow(), HostSyncInLoop(), ImplicitDtype(),
+    MissingDonation(), StaticArgCandidate(), BroadExceptDeviceCode(),
+)
+
+
+def lint_source(
+    path: str, src: str, *,
+    rules: Sequence[Rule] = ALL_RULES,
+    severity_overrides: Optional[Dict[str, str]] = None,
+) -> List[Finding]:
+    """Lint one file's source; returns unsuppressed findings in line
+    order. ``severity_overrides`` maps rule id -> severity (or "off")."""
+    overrides = severity_overrides or {}
+    try:
+        model = ModuleModel(path, src)
+    except SyntaxError as err:
+        return [Finding(
+            rule="SL000", severity="error", path=path,
+            line=err.lineno or 1, col=err.offset or 0,
+            message=f"syntax error: {err.msg}", hint="fix the syntax error",
+        )]
+    except ValueError as err:  # e.g. a null byte in the source
+        return [Finding(
+            rule="SL000", severity="error", path=path, line=1, col=0,
+            message=f"unparseable source: {err}",
+            hint="fix or exclude the file",
+        )]
+    findings: List[Finding] = []
+    for rule in rules:
+        if overrides.get(rule.id) == "off":
+            continue
+        for f in rule.run(model):
+            if model.suppressed(f.rule, f.line):
+                continue
+            sev = overrides.get(f.rule)
+            if sev:
+                f = dataclasses.replace(f, severity=sev)
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def lint_paths(
+    paths: Sequence[str], **kw
+) -> List[Finding]:
+    """Lint files and directories (recursively, ``*.py``)."""
+    import os
+
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(
+                    os.path.join(root, n) for n in sorted(names)
+                    if n.endswith(".py")
+                )
+        else:
+            files.append(p)
+    findings: List[Finding] = []
+    for f in sorted(set(files)):
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError) as err:
+            # one unreadable (or non-UTF-8) file must not kill the whole
+            # run — report it like any other finding and keep going
+            findings.append(Finding(
+                rule="SL000", severity="error", path=f, line=1, col=0,
+                message=f"unreadable source: {err}",
+                hint="fix the encoding or exclude the file",
+            ))
+            continue
+        findings.extend(lint_source(f, src, **kw))
+    return findings

@@ -28,6 +28,9 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="sartsolve",
         description="Impurity flux reconstruction for ITER: emissivity",
+        epilog="subcommands: `sartsolve lint` — static analysis for JAX "
+               "hazards (AST rules + compile audit; see `sartsolve lint "
+               "--help` and docs/STATIC_ANALYSIS.md).",
     )
     p.add_argument("-o", "--output_file", default="solution.h5",
                    help="Filename to save the solution.")
@@ -196,6 +199,16 @@ def _validate(args) -> None:
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "lint":
+        # static-analysis subcommand (docs/STATIC_ANALYSIS.md): AST lint
+        # rules + compile audit of the registered hot entry points. The
+        # solver CLI itself keeps the reference's flat flag set, so the
+        # subcommand is dispatched before the solver parser ever sees it
+        # ("lint" would otherwise parse as an input file).
+        from sartsolver_tpu.analysis.cli import lint_main
+
+        return lint_main(argv[1:])
     args = build_parser().parse_args(argv)
     _validate(args)
 

@@ -89,6 +89,53 @@ def parse_time_intervals(time_string: str) -> List[Tuple[float, float, float, fl
     return intervals
 
 
+# Static-analysis severity levels (analysis/rules.py) in decreasing order;
+# "off" is accepted in overrides to disable a rule entirely.
+LINT_SEVERITIES = ("error", "warning", "info")
+
+
+def parse_severity_overrides(spec: str) -> dict:
+    """Parse a ``sartsolve lint --severity`` override string.
+
+    Grammar: comma-separated ``RULE=LEVEL`` pairs, e.g.
+    ``"SL004=error,SL003=off"``; levels are :data:`LINT_SEVERITIES` plus
+    ``off``. Empty string -> no overrides. Invalid specs raise
+    :class:`SartInputError` (the lint CLI converts it into the same polite
+    message + exit(1) contract as the solver CLI's flag validation).
+    """
+    overrides: dict = {}
+    if not spec:
+        return overrides
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        rule, sep, level = part.partition("=")
+        rule, level = rule.strip(), level.strip()
+        if not sep or not rule or not level:
+            raise SartInputError(
+                f"Unable to parse severity override {part!r}; expected "
+                "RULE=LEVEL, e.g. 'SL004=error'."
+            )
+        if not (rule.startswith("SL") and rule[2:].isdigit()
+                and len(rule) == 5):
+            # catch typos at parse time (the lint CLI additionally checks
+            # the id against the registered rule set) — a silently
+            # ignored override would let the user believe a rule was
+            # disabled when it was not
+            raise SartInputError(
+                f"Unknown rule id {rule!r} in severity override; rule ids "
+                "look like 'SL004' (see `sartsolve lint --list-rules`)."
+            )
+        if level not in LINT_SEVERITIES + ("off",):
+            raise SartInputError(
+                f"Unknown severity {level!r} for rule {rule}; valid: "
+                f"{', '.join(LINT_SEVERITIES + ('off',))}."
+            )
+        overrides[rule] = level
+    return overrides
+
+
 @dataclasses.dataclass(frozen=True)
 class SolverOptions:
     """Validated solver parameters.

@@ -865,6 +865,94 @@ def _solve_normalized_batch_impl(
     return (res, fitted_fin) if return_fitted else res
 
 
+# --------------------------------------------------------------------------
+# compile-audit self-registration (analysis/registry.py). The iteration
+# sweep is THE hot program of the whole design; this pins its compiled
+# structure — no f64, no matrix-sized copy/convert inside the while body,
+# zero collectives on the single-device path, and a donated warm start
+# actually aliased to the solution output — plus a golden op-histogram
+# signature (analysis/goldens/sweep.*.json) that any structural drift must
+# consciously update. Shapes are small but tile-aligned; the invariants
+# are size-independent.
+
+from sartsolver_tpu.analysis.registry import (  # noqa: E402
+    AUDIT_P as _AUDIT_P,
+    AUDIT_V as _AUDIT_V,
+    register_audit_entry as _register_audit_entry,
+)
+
+
+def _audit_problem(rtm_dtype=None, with_scale: bool = False) -> SARTProblem:
+    """Abstract fixture problem for AOT audit lowerings (no device data)."""
+    return SARTProblem(
+        jax.ShapeDtypeStruct((_AUDIT_P, _AUDIT_V), rtm_dtype or jnp.float32),
+        jax.ShapeDtypeStruct((_AUDIT_V,), jnp.float32),
+        jax.ShapeDtypeStruct((_AUDIT_P,), jnp.float32),
+        None,
+        jax.ShapeDtypeStruct((_AUDIT_V,), jnp.float32) if with_scale else None,
+    )
+
+
+def _audit_batch_args(batch: int = 1):
+    return (
+        jax.ShapeDtypeStruct((batch, _AUDIT_P), jnp.float32),
+        jax.ShapeDtypeStruct((batch,), jnp.float32),
+        jax.ShapeDtypeStruct((batch, _AUDIT_V), jnp.float32),
+    )
+
+
+@_register_audit_entry(
+    "sweep",
+    description="Eq. 2/3 batched iteration sweep (two-matmul path, fp32), "
+                "warm-started with a donated f0",
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+    min_donated_args=1,
+)
+def _audit_sweep():
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off"
+    )
+    fn = jax.jit(
+        functools.partial(
+            _solve_normalized_batch_impl, opts=opts, axis_name=None,
+            voxel_axis=None, use_guess=False,
+        ),
+        # the warm-start pattern: f0 is the previous frame's (rescaled)
+        # solution, same shape/dtype/layout as this frame's solution
+        # output — donation must alias them or the state footprint doubles
+        donate_argnums=3,
+    )
+    return fn.lower(_audit_problem(), *_audit_batch_args())
+
+
+@_register_audit_entry(
+    "log_sweep",
+    description="logarithmic (Eq. 3) iteration sweep "
+                "(two-matmul path, fp32)",
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_log_sweep():
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        logarithmic=True,
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=True,
+    ))
+    return fn.lower(_audit_problem(), *_audit_batch_args())
+
+
 def prepare_measurement(measurement, opts: SolverOptions):
     """Host-side pre-step shared by the single-device and sharded drivers —
     the reference's ``pre_iteration_setup`` (sartsolver_cuda.cpp:138-194).

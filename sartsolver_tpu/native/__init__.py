@@ -50,13 +50,20 @@ def _build() -> Optional[str]:
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
-    """Load (building if needed) the native library; None when unavailable."""
+    """Load (building if needed) the native library; None when unavailable.
+
+    ``SART_NATIVE_LIB`` overrides the build with a pre-built shared object
+    path — the hook the ``make native-asan`` target uses to run the test
+    suite against a ``-fsanitize=address,undefined`` build of sartrt.cpp
+    (the ABI check below still applies, so a stale override fails safe to
+    the NumPy paths).
+    """
     global _lib, _build_failed
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            so = _build()
+            so = os.environ.get("SART_NATIVE_LIB") or _build()
             if so is None:
                 _build_failed = True
                 return None

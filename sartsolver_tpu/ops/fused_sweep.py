@@ -225,7 +225,10 @@ def fused_selftest() -> bool:
                 _np.allclose(_np.asarray(f_new), 4.0)
                 and _np.allclose(_np.asarray(fitted), 4.0 * 256)
             )
-        except Exception:
+        # the whole point of the self-test is to degrade ANY kernel
+        # failure (Mosaic compile error, runtime misbehavior) to the
+        # two-matmul path instead of crashing the solve
+        except Exception:  # sart-lint: disable=SL006
             ok = False
         _selftest_result[backend] = ok
     return _selftest_result[backend]
@@ -353,3 +356,75 @@ def fused_sweep(
         ),
         interpret=interpret,
     )(rtm, w, f, *aux)
+
+
+# --------------------------------------------------------------------------
+# compile-audit self-registration (analysis/registry.py). Interpret-mode
+# lowerings compile on any backend, so the fused loop's structure — the
+# while body must not grow a matrix-sized copy (fp32) or a full-matrix
+# dequantized convert (int8: only *panel*-sized dequant is legal, the whole
+# point of in-VMEM dequantization) — is pinned off-TPU too, alongside
+# golden op-histogram signatures. The builders import models.sart lazily:
+# that module imports this one at its top level.
+
+from sartsolver_tpu.analysis.registry import (  # noqa: E402
+    AUDIT_P as _AUDIT_P,
+    AUDIT_V as _AUDIT_V,
+    register_audit_entry as _register_audit_entry,
+)
+
+
+def _audit_fused_solver(rtm_dtype):
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import (
+        _audit_batch_args,
+        _audit_problem,
+        _solve_normalized_batch_impl,
+    )
+
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="interpret",
+        rtm_dtype=("int8" if rtm_dtype == jnp.int8 else None),
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=True,
+    ))
+    return fn.lower(
+        _audit_problem(rtm_dtype, with_scale=rtm_dtype == jnp.int8),
+        *_audit_batch_args(),
+    )
+
+
+@_register_audit_entry(
+    "fused_sweep",
+    description="fused Pallas iteration sweep inside the solver loop "
+                "(fp32, interpret mode)",
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_fused_sweep():
+    return _audit_fused_solver(jnp.float32)
+
+
+@_register_audit_entry(
+    "int8_fused_sweep",
+    description="int8-quantized fused sweep (per-voxel-scaled codes, "
+                "interpret mode)",
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    # dequantizing the codes panel in VMEM is the design; only a copy of
+    # the matrix would erase the 4x bandwidth win, so converts go
+    # unbudgeted here (the panel can legitimately be the whole fixture
+    # matrix at these small audit shapes)
+    loop_convert_threshold=None,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_int8_fused_sweep():
+    return _audit_fused_solver(jnp.int8)

@@ -1,3 +1,26 @@
 """Device-mesh parallelism: sharding specs, partition math, sharded solve."""
 
 from sartsolver_tpu.parallel.mesh import row_block_partition, make_mesh  # noqa: F401
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """``jax.shard_map`` across the JAX versions this repo runs on.
+
+    Newer JAX exposes ``jax.shard_map(..., check_vma=...)``; 0.4.x only has
+    ``jax.experimental.shard_map.shard_map(..., check_rep=...)`` (same
+    semantics, older name). One call site keeps the sharded driver working
+    on both without scattering version probes through the hot path.
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

@@ -1,0 +1,482 @@
+"""Static-analysis subsystem tests (sartsolver_tpu/analysis).
+
+For every AST rule: one fixture snippet seeding a true positive and one
+near-miss that must stay clean — the rule's precision contract. Plus the
+compile-audit machinery (registry completeness, invariant detection on a
+violating module, golden verify/mismatch round trip) and the package
+self-lint, which makes `sartsolve lint --self` part of the tier-1 verify
+path: a new hazard in the package fails the suite, not just the CLI.
+"""
+
+import json
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+from sartsolver_tpu.analysis.rules import ALL_RULES, lint_source
+
+# ---------------------------------------------------------------------------
+# rule fixtures: (rule_id, true-positive snippet, near-miss snippet)
+# ---------------------------------------------------------------------------
+
+_HEADER = "import jax\nimport jax.numpy as jnp\nimport numpy as np\n"
+
+RULE_FIXTURES = {
+    "SL001": (
+        # TP: jitted function branches on a traced (unannotated) parameter
+        """
+        @jax.jit
+        def update(x, threshold):
+            if threshold > 0:
+                return x * 2
+            return x
+        """,
+        # near miss: the branched-on parameter is static
+        """
+        import functools
+
+        @functools.partial(jax.jit, static_argnames=("flag",))
+        def update(x, flag):
+            if flag:
+                return x * 2
+            return x
+        """,
+    ),
+    "SL002": (
+        # TP: per-step .item() on a jnp-produced value inside a loop
+        """
+        def drain(n):
+            total = jnp.zeros(())
+            out = []
+            for k in range(n):
+                total = total + jnp.sin(k)
+                out.append(total.item())
+            return out
+        """,
+        # near miss: the sync happens once, after the loop
+        """
+        def drain(n):
+            total = jnp.zeros(())
+            for k in range(n):
+                total = total + jnp.sin(k)
+            return total.item()
+        """,
+    ),
+    "SL003": (
+        # TP: dtype-defaulting constructor without an explicit dtype
+        """
+        def buffers(n):
+            return jnp.zeros((n, 4))
+        """,
+        # near miss: dtype passed (positionally)
+        """
+        def buffers(n):
+            return jnp.zeros((n, 4), jnp.float32)
+        """,
+    ),
+    "SL004": (
+        # TP: state-update jit with no donation
+        """
+        rescale_state = jax.jit(lambda f, s: f * s)
+        """,
+        # near miss: donation declared
+        """
+        rescale_state = jax.jit(lambda f, s: f * s, donate_argnums=0)
+        """,
+    ),
+    "SL005": (
+        # TP: traced parameter used as a shape -> concretization error /
+        # forced-static recompile hazard
+        """
+        @jax.jit
+        def pad_to(x, n):
+            return x + jnp.zeros(n)
+        """,
+        # near miss: the shape-feeding parameter is static
+        """
+        import functools
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def pad_to(x, n):
+            return x + jnp.zeros(n)
+        """,
+    ),
+    "SL006": (
+        # TP: bare except around anything
+        """
+        def solve(x):
+            try:
+                return jnp.linalg.norm(x)
+            except:
+                return None
+        """,
+        # near miss: a typed handler
+        """
+        def solve(x):
+            try:
+                return jnp.linalg.norm(x)
+            except ValueError:
+                return None
+        """,
+    ),
+}
+
+
+def _lint_snippet(snippet: str):
+    return lint_source("fixture.py", _HEADER + textwrap.dedent(snippet))
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_rule_true_positive(rule_id):
+    positive, _ = RULE_FIXTURES[rule_id]
+    hits = [f for f in _lint_snippet(positive) if f.rule == rule_id]
+    assert hits, f"{rule_id} missed its seeded violation"
+
+
+@pytest.mark.parametrize("rule_id", sorted(RULE_FIXTURES))
+def test_rule_near_miss_stays_clean(rule_id):
+    _, negative = RULE_FIXTURES[rule_id]
+    hits = [f for f in _lint_snippet(negative) if f.rule == rule_id]
+    assert not hits, (
+        f"{rule_id} false positive on its near-miss fixture: "
+        + "; ".join(f.message for f in hits)
+    )
+
+
+def test_rule_catalogue_complete():
+    ids = {r.id for r in ALL_RULES}
+    assert ids == set(RULE_FIXTURES), (
+        "every rule needs a TP/near-miss fixture pair (and vice versa)"
+    )
+    for r in ALL_RULES:
+        assert r.severity in ("error", "warning", "info")
+        assert r.title and r.hint
+
+
+def test_broad_except_around_device_code_warns():
+    """SL006's second mode: `except Exception` is only flagged when the
+    try body actually runs device code, and at warning severity."""
+    flagged = _lint_snippet(
+        """
+        def probe(x):
+            try:
+                return jnp.dot(x, x)
+            except Exception:
+                return None
+        """
+    )
+    hits = [f for f in flagged if f.rule == "SL006"]
+    assert hits and hits[0].severity == "warning"
+    clean = _lint_snippet(
+        """
+        def probe(path):
+            try:
+                return open(path).read()
+            except Exception:
+                return None
+        """
+    )
+    assert not [f for f in clean if f.rule == "SL006"]
+
+
+def test_inline_suppression_and_severity_override():
+    src = _HEADER + textwrap.dedent(
+        """
+        def a(n):
+            return jnp.zeros((n, 4))
+
+        def b(n):
+            return jnp.zeros((n, 4))  # sart-lint: disable=SL003
+        """
+    )
+    findings = lint_source("fixture.py", src)
+    assert [f.rule for f in findings] == ["SL003"], findings
+    off = lint_source("fixture.py", src,
+                      severity_overrides={"SL003": "off"})
+    assert not off
+    hard = lint_source("fixture.py", src,
+                       severity_overrides={"SL003": "error"})
+    assert hard and hard[0].severity == "error"
+
+
+def test_severity_override_parsing():
+    from sartsolver_tpu.config import SartInputError, parse_severity_overrides
+
+    assert parse_severity_overrides("") == {}
+    assert parse_severity_overrides("SL004=error, SL003=off") == {
+        "SL004": "error", "SL003": "off"
+    }
+    with pytest.raises(SartInputError):
+        parse_severity_overrides("SL004")
+    with pytest.raises(SartInputError):
+        parse_severity_overrides("SL004=loud")
+    with pytest.raises(SartInputError):
+        # a typoed rule id must fail loudly, not silently do nothing
+        parse_severity_overrides("SL04=off")
+
+
+def test_lint_cli_rejects_unknown_rule_override(capsys):
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    assert lint_main(["--list-rules", "--severity", "SL999=off"]) == 1
+    assert "SL999" in capsys.readouterr().err
+
+
+def test_opcode_parsing_handles_tuples_layouts_and_comments():
+    """The audit's loop invariants are only as good as the opcode parser:
+    tuple-result ops (a `while`, XLA's combined all-reduce), TPU tiled
+    layouts (`{1,0:T(8,128)}`), and /*index=N*/ comments in wide tuple
+    types must all still yield the opcode — a None here makes every loop
+    invariant pass vacuously."""
+    from sartsolver_tpu.analysis.hlo import opcode_of
+
+    cases = [
+        ("%copy.1 = f32[128,1024]{1,0:T(8,128)} copy(%a)", "copy"),
+        ("%ar = (f32[512]{0}, f32[512]{0}) all-reduce(%a, %b), "
+         "to_apply=%add", "all-reduce"),
+        ("%w.1 = (f32[1,1024]{1,0}, pred[1]{0}, /*index=5*/s32[1]{0}) "
+         "while((f32[1,1024]{1,0}, pred[1]{0}, s32[1]{0}) %init), "
+         "condition=%cond, body=%body", "while"),
+        ("  ROOT %r = (f64[256,512], s32[]) tuple(%m, %i)", "tuple"),
+        ("%cv = bf16[128,256]{1,0:T(8,128)(2,1)} convert(s8[128,256] "
+         "%codes)", "convert"),
+        ("%f = f32[8]{0} fusion(%a), kind=kLoop, calls=%fc", "fusion"),
+        ("%c = f32[] constant(0)", "constant"),
+    ]
+    for line, want in cases:
+        assert opcode_of(line) == want, (line, opcode_of(line))
+
+
+def test_aliased_params_parses_compiled_alias_table():
+    """The compiled-side donation corroboration: the module header's
+    input_output_alias table maps outputs to donated parameter indices."""
+    from sartsolver_tpu.analysis.hlo import aliased_params
+
+    txt = (
+        "HloModule jit_f, is_scheduled=true, input_output_alias={ {}: "
+        "(0, {}, may-alias), {1}: (3, {}, must-alias) }, "
+        "entry_computation_layout={(f32[8,8]{1,0})->f32[8,8]{1,0}}\n"
+        "ENTRY %main () -> f32[] {\n  ROOT %c = f32[] constant(0)\n}\n"
+    )
+    assert aliased_params(txt) == {0, 3}
+    assert aliased_params("HloModule plain\n") == set()
+
+
+def test_lint_survives_unreadable_and_unparseable_files(tmp_path):
+    """One bad file (non-UTF-8, or a null byte) must become an SL000
+    finding, not a traceback that kills the whole run."""
+    from sartsolver_tpu.analysis.rules import lint_paths
+
+    (tmp_path / "latin.py").write_bytes(b"# caf\xe9\nx = 1\n")
+    (tmp_path / "nul.py").write_bytes(b"x = 1\x00\n")
+    (tmp_path / "ok.py").write_text("import jax.numpy as jnp\n\n"
+                                    "def f(n):\n    return jnp.zeros((n,))\n")
+    findings = lint_paths([str(tmp_path)])
+    rules = sorted(f.rule for f in findings)
+    assert rules.count("SL000") == 2, findings
+    assert "SL003" in rules  # the healthy file was still linted
+
+
+def test_sharded_golden_loop_histogram_counts_collectives():
+    """The checked-in sharded golden must actually contain the loop's two
+    designed all-reduces — i.e. the parser sees collectives inside the
+    while body (guards against a parser regression re-hiding them)."""
+    import jax
+
+    from sartsolver_tpu.analysis.audit import GOLDENS_DIR
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("goldens are checked in for the cpu backend")
+    path = os.path.join(GOLDENS_DIR, "sharded_batch.cpu.json")
+    with open(path) as fh:
+        golden = json.load(fh)
+    assert golden["histogram"].get("while", 0) >= 1
+    assert golden["loop_histogram"].get("all-reduce", 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# compile audit
+# ---------------------------------------------------------------------------
+
+
+def test_registry_has_the_hot_entry_points():
+    from sartsolver_tpu.analysis.registry import load_registered_entries
+
+    entries = load_registered_entries()
+    assert {"sweep", "fused_sweep", "sharded_batch"} <= set(entries)
+    # the donation-aliasing invariant must be carried by at least one entry
+    assert any(e.min_donated_args > 0 for e in entries.values())
+
+
+def test_compile_audit_invariants_pass():
+    """Every registered entry lowers, compiles, and satisfies its declared
+    invariants (golden comparison exercised separately)."""
+    from sartsolver_tpu.analysis.audit import run_compile_audit
+
+    reports = run_compile_audit(skip_goldens=True)
+    assert reports
+    bad = [r.format() for r in reports if r.failed]
+    assert not bad, "\n".join(bad)
+    assert sum(r.status == "ok" for r in reports) >= 3
+
+
+def test_compile_audit_verifies_checked_in_goldens():
+    import jax
+
+    from sartsolver_tpu.analysis.audit import GOLDENS_DIR, run_compile_audit
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("goldens are checked in for the cpu backend")
+    reports = run_compile_audit()
+    by_status = {r.name: r for r in reports}
+    for name in ("sweep", "fused_sweep", "sharded_batch"):
+        assert by_status[name].status == "ok", by_status[name].format()
+        assert os.path.exists(
+            os.path.join(GOLDENS_DIR, f"{name}.cpu.json"))
+
+
+def test_audit_detects_violations_and_golden_drift(tmp_path):
+    """Feed the checker a module that violates every loop invariant, and
+    verify golden mismatch/missing detection against a scratch dir."""
+    from sartsolver_tpu.analysis.audit import (
+        check_invariants, run_entry, signature,
+    )
+    from sartsolver_tpu.analysis.registry import AuditEntry, AUDIT_REGISTRY
+
+    bad_hlo = textwrap.dedent("""\
+        HloModule bad, entry_computation_layout={()->f32[]}
+
+        %body (p: (f64[256,512], s32[])) -> (f64[256,512], s32[]) {
+          %p = (f64[256,512], s32[]) parameter(0)
+          %m = f64[256,512] get-tuple-element((f64[256,512], s32[]) %p), index=0
+          %t = f64[512,256] transpose(f64[256,512] %m), dimensions={1,0}
+          %c = f64[256,512] convert(f64[256,512] %m)
+          %ar = f64[256,512] all-reduce(f64[256,512] %c), to_apply=%body
+          %i = s32[] constant(1)
+          ROOT %r = (f64[256,512], s32[]) tuple(%m, %i)
+        }
+
+        %cond (p: (f64[256,512], s32[])) -> pred[] {
+          %p = (f64[256,512], s32[]) parameter(0)
+          ROOT %lt = pred[] constant(true)
+        }
+
+        ENTRY %main () -> f32[] {
+          %init = (f64[256,512], s32[]) tuple()
+          %w = (f64[256,512], s32[]) while((f64[256,512], s32[]) %init), condition=%cond, body=%body
+          ROOT %out = f32[] constant(0)
+        }
+        """)
+    entry = AuditEntry(
+        name="synthetic", build=lambda: None, description="synthetic",
+        loop_copy_threshold=256 * 512,
+        loop_convert_threshold=256 * 512,
+        loop_collective_budget={"all-reduce": 0},
+        min_donated_args=1,
+    )
+    violations = check_invariants(bad_hlo, entry, lowered_text="module {}")
+    kinds = "\n".join(violations)
+    assert "f64 ops" in kinds
+    assert "transpose/copy" in kinds
+    assert "convert" in kinds
+    assert "all-reduce" in kinds and "budget" in kinds
+    assert "donation" in kinds
+    assert len(violations) == 5
+
+    # golden round trip on a real (small) registered entry
+    name = "sweep"
+    entry = AUDIT_REGISTRY[name]
+    scratch = str(tmp_path)
+    missing = run_entry(entry, goldens_dir=scratch)
+    assert missing.status == "golden-missing"
+    updated = run_entry(entry, goldens_dir=scratch, update_goldens=True)
+    assert updated.status == "updated"
+    ok = run_entry(entry, goldens_dir=scratch)
+    assert ok.status == "ok", ok.format()
+    # corrupt the golden -> mismatch with a readable diff
+    path = os.path.join(scratch, os.listdir(scratch)[0])
+    with open(path) as fh:
+        golden = json.load(fh)
+    golden["histogram"]["dot"] = golden["histogram"].get("dot", 0) + 7
+    with open(path, "w") as fh:
+        json.dump(golden, fh)
+    drift = run_entry(entry, goldens_dir=scratch)
+    assert drift.status == "golden-mismatch"
+    assert any("dot" in v for v in drift.violations)
+
+
+def test_while_loop_required_guard():
+    """An entry whose loop got traced away must fail, not vacuously pass."""
+    from sartsolver_tpu.analysis.audit import check_invariants
+    from sartsolver_tpu.analysis.registry import AuditEntry
+
+    no_loop = "ENTRY %main () -> f32[] {\n  ROOT %c = f32[] constant(0)\n}\n"
+    entry = AuditEntry(
+        name="x", build=lambda: None, description="x",
+        loop_copy_threshold=1,
+    )
+    violations = check_invariants(no_loop, entry)
+    assert violations and "while" in violations[0]
+
+
+# ---------------------------------------------------------------------------
+# package self-lint (the verify-path hook: new hazards fail the suite)
+# ---------------------------------------------------------------------------
+
+
+def test_package_self_lint_clean():
+    import sartsolver_tpu
+    from sartsolver_tpu.analysis.rules import lint_paths
+
+    pkg = os.path.dirname(os.path.abspath(sartsolver_tpu.__file__))
+    findings = lint_paths([pkg])
+    errors = [f.format() for f in findings if f.severity == "error"]
+    assert not errors, (
+        "error-severity lint findings in the package (fix, or annotate "
+        "deliberate ones with `# sart-lint: disable=...`):\n"
+        + "\n".join(errors)
+    )
+    # warnings/infos must be fixed or explicitly annotated too — the
+    # first-self-run contract; new ones need a conscious decision
+    assert not [f.format() for f in findings], (
+        "unannotated lint findings in the package:\n"
+        + "\n".join(f.format() for f in findings)
+    )
+
+
+def test_lint_cli_end_to_end(tmp_path, capsys):
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(_HEADER + textwrap.dedent(
+        """
+        @jax.jit
+        def update(x, threshold):
+            if threshold > 0:
+                return x * 2
+            return x
+        """
+    ))
+    rc = lint_main([str(bad)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "SL001" in out
+    good = tmp_path / "good.py"
+    good.write_text(_HEADER + "def f(x):\n    return jnp.sin(x)\n")
+    assert lint_main([str(good)]) == 0
+    assert lint_main(["--list-rules"]) == 0
+    assert "SL001" in capsys.readouterr().out
+
+
+def test_lint_cli_json_output(tmp_path, capsys):
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    f = tmp_path / "m.py"
+    f.write_text(_HEADER + "def b(n):\n    return jnp.zeros((n, 4))\n")
+    rc = lint_main([str(f), "--json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 0  # warnings don't fail
+    assert payload["warnings"] == 1
+    assert payload["findings"][0]["rule"] == "SL003"

@@ -26,7 +26,11 @@ import tempfile
 import h5py
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# tier-1 must collect cleanly without the optional `test` extra installed;
+# hypothesis-backed sweeps simply skip when it is absent
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from sartsolver_tpu.config import SartInputError
 from sartsolver_tpu.io import hdf5files as hf

@@ -45,7 +45,9 @@ def test_halo_laplacian_partition_matches_dense():
     want = x @ L.T  # [B, V]
 
     mesh = make_mesh(1, S)
-    got = jax.jit(jax.shard_map(
+    from sartsolver_tpu.parallel import shard_map
+
+    got = jax.jit(shard_map(
         lambda sl, xb: sharded_penalty(
             type(slap)(*(a[0] for a in sl)), xb, "voxels"
         ),
