@@ -2,6 +2,8 @@
 #
 #   make lint        - sartsolve lint --self (AST rules + compile audit)
 #   make test        - tier-1 test suite (CPU backend, ROADMAP.md contract)
+#   make faults      - fault-injection matrix: per-site recover/degrade
+#                      proofs (docs/RESILIENCE.md; subset of tier-1)
 #   make verify      - lint, then tier-1 tests (the fail-fast CI path)
 #   make native-asan - rebuild the native helper with ASan+UBSan and run
 #                      its tests against it (skips cleanly with no g++)
@@ -12,7 +14,7 @@ PYTHON ?= python
 BUILD_DIR ?= .build
 ASAN_SO := $(BUILD_DIR)/libsartrt_asan.so
 
-.PHONY: lint test verify native-asan goldens
+.PHONY: lint test faults verify native-asan goldens
 
 lint:
 	JAX_PLATFORMS=cpu $(PYTHON) -m sartsolver_tpu.cli lint --self
@@ -20,6 +22,15 @@ lint:
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# The fault-injection matrix (docs/RESILIENCE.md): for every named site a
+# recover leg (transient fault retried, clean output, exit 0) and a
+# degrade leg (budget exhausted -> FAILED/DIVERGED row + exit 2, or
+# resumable infrastructure abort + exit 3). Runs inside the tier-1 time
+# budget (~25 s on the CI box); `make test` includes it.
+faults:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_resilience.py -q \
+		-p no:cacheprovider
 
 # New static-analysis violations fail before the (much slower) test run.
 verify: lint test

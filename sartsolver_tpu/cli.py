@@ -30,7 +30,11 @@ def build_parser() -> argparse.ArgumentParser:
         description="Impurity flux reconstruction for ITER: emissivity",
         epilog="subcommands: `sartsolve lint` — static analysis for JAX "
                "hazards (AST rules + compile audit; see `sartsolve lint "
-               "--help` and docs/STATIC_ANALYSIS.md).",
+               "--help` and docs/STATIC_ANALYSIS.md). "
+               "exit codes: 0 success; 1 input/flag error; 2 run completed "
+               "with FAILED/DIVERGED frames; 3 aborted on an unrecoverable "
+               "infrastructure failure after retries (file resumable) — "
+               "see docs/RESILIENCE.md.",
     )
     p.add_argument("-o", "--output_file", default="solution.h5",
                    help="Filename to save the solution.")
@@ -140,6 +144,31 @@ def build_parser() -> argparse.ArgumentParser:
                           "RTM ingest, per-frame solve — the first frame "
                           "includes XLA compilation — and output writes) at "
                           "the end of the run.")
+    res = p.add_argument_group(
+        "resilience options",
+        "fault handling (docs/RESILIENCE.md): retry/backoff knobs are "
+        "environment variables (SART_RETRY_ATTEMPTS/_BASE_DELAY/"
+        "_MAX_DELAY/_DEADLINE); fault injection for testing via "
+        "SART_FAULT=site:kind:prob[:count].")
+    res.add_argument("--divergence_recovery", type=int, default=0,
+                     help="In-solve divergence guard: a frame whose "
+                          "residual metric goes non-finite or exploding "
+                          "rolls back to its last good iterate and "
+                          "retries with halved relaxation, up to N "
+                          "escalations; exhaustion (or non-finite input "
+                          "data) marks the frame DIVERGED (status -2) "
+                          "and the run continues. 0 (default) disables "
+                          "the guard (reference behavior: divergence "
+                          "spins to the iteration cap or NaNs the "
+                          "output).")
+    res.add_argument("--fail_fast", action="store_true",
+                     help="Disable per-frame failure isolation: the first "
+                          "frame whose ingest or solve fails aborts the "
+                          "run (the reference's behavior) instead of "
+                          "being recorded as a FAILED status row (-3) "
+                          "while the run continues. Multihost runs "
+                          "always fail fast (a per-process frame skip "
+                          "would desynchronize the collective loop).")
     tpu.add_argument("--multihost", action="store_true",
                      help="Multi-host run (one process per host, e.g. a TPU "
                           "pod slice): initialize the JAX multi-controller "
@@ -196,6 +225,15 @@ def _validate(args) -> None:
              "have no warm-start dependency).")
     if args.chain_frames < 1:
         fail(f"Argument chain_frames must be >= 1, {args.chain_frames} given.")
+    if args.divergence_recovery < 0:
+        fail("Argument divergence_recovery must be >= 0, "
+             f"{args.divergence_recovery} given.")
+    if (args.divergence_recovery and args.logarithmic
+            and args.fused_sweep in ("on", "interpret")):
+        fail("Argument divergence_recovery cannot combine --logarithmic "
+             f"with --fused_sweep {args.fused_sweep}: the per-frame "
+             "relaxation scale cannot enter the fused kernel's literal "
+             "exponent; use --fused_sweep auto/off.")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -209,7 +247,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.analysis.cli import lint_main
 
         return lint_main(argv[1:])
-    args = build_parser().parse_args(argv)
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as err:
+        # argparse exits 2 on unknown/malformed flags, which would collide
+        # with EXIT_PARTIAL in the documented exit-code contract (a
+        # scheduler would read a typo'd flag as "completed with failed
+        # frames"); remap to the input-error code (EXIT_INPUT_ERROR = 1,
+        # literal here so --help never pays the import). --help's exit 0
+        # passes through.
+        raise SystemExit(1 if err.code else 0) from None
     _validate(args)
 
     # Heavy imports deferred so `--help` stays instant.
@@ -225,10 +272,29 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     configure_compilation_cache()
 
+    from sartsolver_tpu.resilience.failures import (
+        EXIT_INFRASTRUCTURE, FRAME_FAILED, RECOVERABLE_FRAME_ERRORS,
+        FrameFailure, OutputWriteError, RunSummary, failed_row,
+    )
+    from sartsolver_tpu.resilience.retry import (
+        RetriesExhausted, reset_retry_stats,
+    )
+
+    # per-run accounting: the retry counters feed this run's end-of-run
+    # summary, not a process-lifetime total
+    reset_retry_stats()
+
     if args.multihost:
         from sartsolver_tpu.parallel import multihost as mh
 
-        mh.initialize()
+        try:
+            mh.initialize()
+        except RetriesExhausted as err:
+            # the coordinator never came up within the retry budget; this
+            # is infrastructure, not user input — distinct exit code so a
+            # scheduler can tell "fix the flags" from "requeue the job"
+            print(f"Unrecoverable after retries: {err}", file=sys.stderr)
+            return EXIT_INFRASTRUCTURE
 
     from sartsolver_tpu.config import (
         SartInputError, SolverOptions, parse_time_intervals,
@@ -311,6 +377,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 relaxation=args.relaxation,
                 relaxation_decay=args.relaxation_decay,
                 max_iterations=args.max_iterations,
+                divergence_recovery=args.divergence_recovery,
                 # forwarded so an explicit --fused_sweep on fails loudly
                 # (the fused sweep is fp32-only) instead of silently
                 # degrading to the unfused path
@@ -328,6 +395,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 relaxation=args.relaxation,
                 relaxation_decay=args.relaxation_decay,
                 max_iterations=args.max_iterations,
+                divergence_recovery=args.divergence_recovery,
                 rtm_dtype=args.rtm_dtype,
                 fused_sweep=args.fused_sweep,
             )
@@ -537,11 +605,34 @@ def main(argv: Optional[List[str]] = None) -> int:
             if primary else _NullWriter()
         )
 
-        with profiler_ctx, writer_ctx as writer, FramePrefetcher(composite_image) as frames:
+        # Per-frame failure isolation (docs/RESILIENCE.md): a frame whose
+        # ingest retries are exhausted arrives as a FrameFailure item, and
+        # a frame whose staging/solve dispatch fails with a recoverable
+        # error is caught below — either way the frame is recorded as a
+        # FAILED status row (-3, zeros) and the run continues. Off with
+        # --fail_fast; multihost runs always fail fast: each process reads
+        # frames independently, so a per-process skip would desynchronize
+        # the collective frame loop (the in-solve divergence guard stays
+        # active there — it runs inside the jitted program, identically on
+        # every process).
+        isolate = not (args.fail_fast or args.multihost)
+        summary = RunSummary()
+
+        with profiler_ctx, writer_ctx as writer, FramePrefetcher(
+            composite_image, isolate_failures=isolate
+        ) as frames:
             if resume_state is not None:
                 frames = (
                     item for item in frames if not already_written(item[1])
                 )
+
+            def record_failed(ftime, cam_times, err):
+                writer.add(failed_row(nvoxel), FRAME_FAILED, ftime,
+                           cam_times, iterations=-1)
+                summary.record_status(FRAME_FAILED, ftime)
+                if primary:
+                    print(f"Frame at t={ftime}: FAILED "
+                          f"({type(err).__name__}: {err})", file=sys.stderr)
             # Solutions stay ON DEVICE on every path: one packed scalar
             # fetch per solve group, solution transfer deferred to the
             # async writer's thread, warm starts chained device-side
@@ -598,11 +689,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         writer.add(result.solution_fetcher(b),
                                    int(statuses[b]), ftime, cam_times,
                                    iterations=int(result.iterations[b]))
+                        summary.record_status(int(statuses[b]), ftime)
                         if primary:
                             print(f"Processed in: {per_frame_ms} ms "
                                   f"(average over {label} of {len(metas)}; "
                                   f"{int(result.iterations[b])} iterations)")
                     write_ok = True
+
+                def drain_inflight():
+                    # write the already-dispatched group now, so rows
+                    # recorded after it stay in frame order
+                    nonlocal prev
+                    if prev is not None and write_ok:
+                        to_write, prev = prev, None
+                        write_group(*to_write)
 
                 def flush():
                     nonlocal prev
@@ -611,7 +711,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                         stack = np.concatenate(
                             [stack, pad_tail(stack, K - len(pending))])
                     t0 = _time.perf_counter()
-                    result = solve_group(stack)  # async dispatch
+                    try:
+                        result = solve_group(stack)  # async dispatch
+                    except RECOVERABLE_FRAME_ERRORS as err:
+                        if not isolate:
+                            raise
+                        # the group produced nothing: its frames all fail,
+                        # in order, after the in-flight group's rows; the
+                        # warm carry skips the dead group (the previous
+                        # chain result is still the seed of the next)
+                        drain_inflight()
+                        for _, ftime, cam_times in pending:
+                            record_failed(ftime, cam_times, err)
+                        pending.clear()
+                        return
                     # swap BEFORE writing: if write_group raises, `prev`
                     # already holds the new unwritten group for the drain
                     # below (never the just-written one — no double write)
@@ -622,6 +735,17 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 try:
                     for item in frames:
+                        if isinstance(item, FrameFailure):
+                            # keep rows frame-ordered: dispatch what is
+                            # pending, drain the in-flight group, then
+                            # record the dead frame (a rare-path pipeline
+                            # stall, only on actual failures)
+                            if pending:
+                                flush()
+                            drain_inflight()
+                            record_failed(item.time, item.camera_times,
+                                          item.error)
+                            continue
                         pending.append(item)
                         if len(pending) == K:
                             flush()
@@ -691,19 +815,35 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f0_host: Optional[np.ndarray] = None  # host warm / resume seed
                 if resume_state is not None and not args.no_guess:
                     f0_host = resume_state.last_solution
-                for frame, ftime, cam_times in frames:
+                for item in frames:
+                    if isinstance(item, FrameFailure):
+                        record_failed(item.time, item.camera_times,
+                                      item.error)
+                        continue  # warm start carries over the dead frame
+                    frame, ftime, cam_times = item
                     t0 = _time.perf_counter()
-                    dres = solver.solve_batch(
-                        np.asarray(frame)[None, :],
-                        None if f0_host is None else f0_host[None, :],
-                        local=use_local, device_result=True,
-                        warm=warm_dev,
-                    )
+                    try:
+                        dres = solver.solve_batch(
+                            np.asarray(frame)[None, :],
+                            None if f0_host is None else f0_host[None, :],
+                            local=use_local, device_result=True,
+                            warm=warm_dev,
+                        )
+                    except RECOVERABLE_FRAME_ERRORS as err:
+                        if not isolate:
+                            raise
+                        # staging/dispatch failed for THIS frame only; the
+                        # previous warm start (and an unconsumed resume
+                        # seed) stays valid for the next frame
+                        record_failed(ftime, cam_times, err)
+                        continue
                     f0_host = None  # resume seed consumed; chain on device
                     warm_dev = None if args.no_guess else dres
-                    writer.add(dres.solution_fetcher(0), int(dres.status[0]),
+                    status = int(dres.status[0])
+                    writer.add(dres.solution_fetcher(0), status,
                                ftime, cam_times,
                                iterations=int(dres.iterations[0]))
+                    summary.record_status(status, ftime)
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
                     timer.add("solve frame", elapsed_ms / 1e3)
                     if primary:
@@ -728,6 +868,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"fused sweep: requested={args.fused_sweep} "
                   f"resolved={opts.fused_sweep} "
                   f"engaged={FUSED_ENGAGEMENT['last'] or 'not traced'}")
+        # End-of-run resilience accounting: printed whenever anything
+        # degraded or recovered (always under --timing), and a run with
+        # FAILED/DIVERGED frames exits with the partial code so a
+        # scheduler can see "completed, but look at the statuses" without
+        # opening the file.
+        if primary and (summary.n_failed or summary.had_retries()
+                        or args.timing):
+            print(summary.format())
+        if summary.n_failed:
+            return summary.exit_code()
+    except RetriesExhausted as err:
+        # a retried site (RTM ingest, multihost init, a non-isolated
+        # frame read) failed permanently: infrastructure, not input
+        print(f"Unrecoverable after retries: {err}", file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
+    except OutputWriteError as err:
+        # a solution-file flush failed mid-run; the file is resumable up
+        # to its last committed flush
+        print(err, file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
     except KeyError as err:
         # h5py raises KeyError for missing datasets/attributes in otherwise
         # openable files; surface it as the fail-fast message + exit 1 the

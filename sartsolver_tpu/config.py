@@ -15,6 +15,12 @@ from typing import List, Tuple
 # Solver status codes (reference source/sartsolver.cpp:16-17).
 SUCCESS = 0
 MAX_ITERATIONS_EXCEEDED = -1
+# Extension beyond the reference's two codes: the in-solve divergence
+# guard (SolverOptions.divergence_recovery) exhausted its rollback /
+# relaxation-halving ladder for this frame; the solution row holds the
+# last finite iterate. The pipeline-level FRAME_FAILED = -3 lives in
+# resilience/failures.py (it is never produced by the solver itself).
+DIVERGED = -2
 
 
 class SartInputError(ValueError):
@@ -195,6 +201,19 @@ class SolverOptions:
     # problem is not pixel-sharded and shapes are tile-aligned; "interpret"
     # runs the kernel in the Pallas interpreter (CPU testing).
     fused_sweep: str = "auto"
+    # In-solve divergence recovery (resilience layer, docs/RESILIENCE.md):
+    # the iteration body watches the residual metric for non-finite or
+    # exploding values; a tripped frame rolls back to its last good
+    # iterate, halves its relaxation, and retries — up to this many
+    # escalations, after which the frame freezes with status DIVERGED
+    # (config.DIVERGED) while the rest of the batch continues. 0 (default)
+    # disables the guard entirely: the traced program is byte-identical
+    # to the pre-resilience solver (reference behavior: divergence spins
+    # to the iteration cap or NaNs the output).
+    divergence_recovery: int = 0
+    # A frame counts as exploding when its ||Hf||^2 exceeds this multiple
+    # of max(||g||^2, 1) (both normalized); non-finite metrics always trip.
+    divergence_threshold: float = 1.0e4
     # Accumulate the convergence metric's ||Hf||^2 in fp64 (emulated as
     # float32 pairs on TPU) even when the compute dtype is fp32, so the
     # |dC| < tol stall crossing (Eq. 5, sartsolver.cpp:224-228) stops
@@ -262,3 +281,13 @@ class SolverOptions:
             raise ValueError("rtm_dtype='int8' requires dtype='float32'.")
         if self.fused_sweep not in ("auto", "on", "off", "interpret"):
             raise ValueError("fused_sweep must be 'auto', 'on', 'off' or 'interpret'.")
+        if self.divergence_recovery < 0:
+            raise ValueError(
+                "Attribute divergence_recovery must be >= 0 (0 disables "
+                "the in-solve divergence guard)."
+            )
+        if self.divergence_threshold <= 1:
+            raise ValueError(
+                "Attribute divergence_threshold must be > 1 (a multiple "
+                "of the measurement norm)."
+            )

@@ -249,7 +249,15 @@ class CompositeImage:
         needed frame ONCE, compress via the RTM frame mask, and scatter it
         into the pixel runs this instance serves (a contiguous range is
         the one-run case).
+
+        Named fault site ``hdf5.frame_read`` (resilience/faults.py): the
+        whole cache fill is the retry unit of the prefetcher's frame-read
+        retry loop — a failed fill leaves the cache untouched, so a retry
+        re-reads from HDF5 with no partial state.
         """
+        from sartsolver_tpu.resilience import faults
+
+        faults.fire(faults.SITE_FRAME_READ)
         cache_size_t = min(self.max_cache_size, len(self.time) - itime)
         cached = np.zeros((cache_size_t, self.npix))
         last_needed = max(off + cnt for off, cnt in self.runs)
@@ -285,5 +293,8 @@ class CompositeImage:
             if last_needed <= start_pixel:
                 break
 
-        self._cached_frames = cached
+        # data-corruption leg of the same site: a 'nan' fault poisons the
+        # block the way a bad sensor frame / torn DMA would; the solver's
+        # input guard (divergence_recovery) turns it into a DIVERGED frame
+        self._cached_frames = faults.corrupt(faults.SITE_FRAME_READ, cached)
         self.cache_offset = itime

@@ -32,7 +32,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array, lax
 
-from sartsolver_tpu.config import MAX_ITERATIONS_EXCEEDED, SUCCESS, SolverOptions
+from sartsolver_tpu.config import (
+    DIVERGED,
+    MAX_ITERATIONS_EXCEEDED,
+    SUCCESS,
+    SolverOptions,
+)
 from sartsolver_tpu.ops.fused_sweep import fused_available, fused_sweep
 from sartsolver_tpu.ops.laplacian import (
     LaplacianCOO,
@@ -97,6 +102,20 @@ def _resolve_fused(
     if mode == "off":
         return None
     explicit = mode in ("on", "interpret")
+    if opts.divergence_recovery and opts.logarithmic:
+        # the guard's per-frame relaxation scale enters the LOG update as
+        # a traced exponent, which the fused kernel's literal-constant
+        # closure cannot carry (the LINEAR update folds the scale into the
+        # pixel weights, so it fuses fine)
+        if explicit:
+            raise ValueError(
+                f"fused_sweep='{mode}' requested but divergence_recovery "
+                "is enabled on the logarithmic solver; the per-frame "
+                "relaxation scale cannot enter the fused kernel's literal "
+                "exponent. Use fused_sweep='auto'/'off' or the linear "
+                "solver."
+            )
+        return None
     if axis_name is not None:
         if explicit:
             raise ValueError(
@@ -794,10 +813,25 @@ def _solve_normalized_batch_impl(
                            fwd_scale=0 if is_int8 else None,
                            interpret=fused == "interpret")
 
-    def run_sweep(f, fitted, penalty, dk):
+    # In-solve divergence recovery (docs/RESILIENCE.md): with R > 0 the
+    # loop carries a per-frame relaxation scale, a recovery counter and a
+    # diverged flag; an iteration whose residual metric goes non-finite or
+    # explodes rolls the frame back to its entering state (the rollback
+    # target is simply the carry — the candidate is discarded before it is
+    # ever stored), halves its relaxation scale, and retries. After R
+    # recoveries the frame freezes with status DIVERGED, holding its last
+    # finite iterate, while the rest of the batch continues. R == 0 traces
+    # the original program byte-for-byte (every guard op is skipped at
+    # Python level), so goldens/parity are untouched by default.
+    recovery = int(opts.divergence_recovery)
+    explode = float(opts.divergence_threshold)
+
+    def run_sweep(f, fitted, penalty, dk, ascale):
         """(f_upd, fitted_upd or None): the iteration's two RTM sweeps.
         ``dk`` is the schedule factor decay^k (a traced scalar; 1 when the
-        schedule is off, in which case it is never materialized)."""
+        schedule is off, in which case it is never materialized);
+        ``ascale`` is the divergence guard's per-frame [B] relaxation
+        scale (None when the guard is off)."""
         if opts.logarithmic:
             w = jnp.where(meas_mask, fitted, 0) * inv_length
             if fused is not None:
@@ -812,6 +846,10 @@ def _solve_normalized_batch_impl(
             exponent = jnp.asarray(opts.relaxation, dtype)
             if scheduled:
                 exponent = exponent * dk
+            if ascale is not None:
+                # per-frame guard scale enters the multiplicative update
+                # through the exponent: ratio ** (alpha * ascale_b)
+                exponent = exponent * ascale[:, None]
             ratio = ((obs + eps) / (fit + eps)) ** exponent
             return f * ratio * jnp.exp(-penalty), None
         w = jnp.where(meas_mask, g - fitted, 0) * inv_length
@@ -820,20 +858,27 @@ def _solve_normalized_batch_impl(
             # folds into the pixel weights (inv_density keeps the base
             # alpha) — the same fold for the fused and two-matmul paths
             w = w * dk
+        if ascale is not None:
+            # same fold for the guard's per-frame scale (exact when 1.0)
+            w = w * ascale[:, None]
         if fused is not None:
             return run_fused(w, f, [inv_density[None, :]] + ([penalty] if has_pen else []))
         bp = _psum(back_project(rtm, w, accum_dtype=dtype), axis_name)
         return jnp.maximum(f + inv_density[None, :] * bp - penalty, 0), None
 
     def body(carry):
-        f, fitted, conv_prev, it, done, iters = carry
+        if recovery:
+            f, fitted, conv_prev, it, done, iters, ascale, recov, div = carry
+        else:
+            f, fitted, conv_prev, it, done, iters = carry
+            ascale = None
         if opts.logarithmic:
             penalty = compute_penalty(jnp.log(f))
         else:
             penalty = compute_penalty(f)
         dk = (jnp.asarray(decay, dtype) ** it.astype(dtype)
               if scheduled else None)
-        f_upd, fitted_upd = run_sweep(f, fitted, penalty, dk)
+        f_upd, fitted_upd = run_sweep(f, fitted, penalty, dk, ascale)
 
         f_new = jnp.where(done[:, None], f, f_upd)  # converged frames freeze
         if fitted_upd is not None:
@@ -847,20 +892,80 @@ def _solve_normalized_batch_impl(
         else:  # the reference CUDA path's fp32 dot (sartsolver_cuda.cpp:253)
             fsq = _psum(jnp.sum(fitted_new * fitted_new, axis=1), axis_name)
         conv = (msq - fsq) / msq
+        if recovery:
+            # the candidate update is judged BEFORE it is stored: a bad
+            # frame keeps its entering (f, fitted, conv) — the rollback —
+            # so the carry always holds the last good iterate
+            bad = (~done) & (
+                ~(jnp.isfinite(fsq) & jnp.isfinite(conv))
+                | (fsq > explode * jnp.maximum(msq, 1.0))
+            )
+            exhausted = bad & (recov >= recovery)
+            f_new = jnp.where(bad[:, None], f, f_new)
+            fitted_new = jnp.where(bad[:, None], fitted, fitted_new)
+            conv = jnp.where(bad, conv_prev, conv)
+            ascale = jnp.where(bad & ~exhausted, ascale * 0.5, ascale)
+            recov = recov + bad.astype(jnp.int32)
+            # a rolled-back frame must not trip the stall test (its conv
+            # equals conv_prev by construction, not by convergence)
+            newly = ((~done) & ~bad & (it >= 1)
+                     & (jnp.abs(conv - conv_prev) < tol))
+            ended = newly | exhausted
+            iters = jnp.where(ended, it + 1, iters)
+            return (f_new, fitted_new, conv, it + 1, done | ended, iters,
+                    ascale, recov, div | exhausted)
         newly = (~done) & (it >= 1) & (jnp.abs(conv - conv_prev) < tol)
         iters = jnp.where(newly, it + 1, iters)
         return (f_new, fitted_new, conv, it + 1, done | newly, iters)
 
     def cond(carry):
-        _, _, _, it, done, _ = carry
+        it, done = carry[3], carry[4]
         return (it < opts.max_iterations) & ~jnp.all(done)
 
-    init = (
-        f0, fitted0, jnp.zeros(B, dtype), jnp.asarray(0, jnp.int32),
-        jnp.zeros(B, bool), jnp.full(B, opts.max_iterations, jnp.int32),
-    )
-    f, fitted_fin, conv, it, done, iters = lax.while_loop(cond, body, init)
-    status = jnp.where(done, SUCCESS, MAX_ITERATIONS_EXCEEDED).astype(jnp.int32)
+    if recovery:
+        # Pre-flight input guard: a frame whose measurement, seed or
+        # ||g||^2 is already non-finite (a NaN-poisoned sensor frame, a
+        # corrupted warm start) has no good iterate to roll back to — the
+        # rollback ladder cannot help it. Such frames are marked DIVERGED
+        # at iteration 0 with a zero solution instead of burning the
+        # ladder (or, guard off, spinning to the iteration cap with NaN
+        # output). Cheap [B]-wise bookkeeping, only traced in recovery
+        # mode; reductions mirror the solver's sharding.
+        gbad = _psum(
+            jnp.sum(jnp.where(jnp.isfinite(g), 0, 1), axis=1,
+                    dtype=jnp.int32),
+            axis_name,
+        )
+        fbad = _psum(
+            jnp.sum(jnp.where(jnp.isfinite(f0), 0, 1), axis=1,
+                    dtype=jnp.int32),
+            voxel_axis,
+        )
+        input_bad = (gbad > 0) | (fbad > 0) | ~jnp.isfinite(msq)
+        f0 = jnp.where(input_bad[:, None], 0, f0)
+        fitted0 = jnp.where(input_bad[:, None], 0, fitted0)
+        init = (
+            f0, fitted0, jnp.zeros(B, dtype), jnp.asarray(0, jnp.int32),
+            input_bad,
+            jnp.where(input_bad, 0, opts.max_iterations).astype(jnp.int32),
+            jnp.ones(B, dtype),  # per-frame relaxation scale
+            jnp.zeros(B, jnp.int32),  # recoveries consumed
+            input_bad,  # diverged (pre-failed, or ladder exhausted later)
+        )
+        f, fitted_fin, conv, it, done, iters, _, _, div = lax.while_loop(
+            cond, body, init
+        )
+        status = jnp.where(
+            div, DIVERGED,
+            jnp.where(done, SUCCESS, MAX_ITERATIONS_EXCEEDED),
+        ).astype(jnp.int32)
+    else:
+        init = (
+            f0, fitted0, jnp.zeros(B, dtype), jnp.asarray(0, jnp.int32),
+            jnp.zeros(B, bool), jnp.full(B, opts.max_iterations, jnp.int32),
+        )
+        f, fitted_fin, conv, it, done, iters = lax.while_loop(cond, body, init)
+        status = jnp.where(done, SUCCESS, MAX_ITERATIONS_EXCEEDED).astype(jnp.int32)
     res = SolveResult(f, status, iters, conv)
     return (res, fitted_fin) if return_fitted else res
 
@@ -953,6 +1058,34 @@ def _audit_log_sweep():
     return fn.lower(_audit_problem(), *_audit_batch_args())
 
 
+@_register_audit_entry(
+    "recovery_sweep",
+    description="iteration sweep with the in-solve divergence guard "
+                "(rollback + relaxation halving; two-matmul path, fp32)",
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_recovery_sweep():
+    # The guard's hot-path cost must stay elementwise [B]/[B, P] bookkeeping:
+    # no matrix-sized copies/converts may appear in the loop body, and the
+    # single-device program stays collective-free — the same invariants as
+    # the plain sweep, pinned separately because the guard re-traces the
+    # body with three extra carries and a second where-select per state.
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        divergence_recovery=2,
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=False,
+    ))
+    return fn.lower(_audit_problem(), *_audit_batch_args(2))
+
+
 def prepare_measurement(measurement, opts: SolverOptions):
     """Host-side pre-step shared by the single-device and sharded drivers —
     the reference's ``pre_iteration_setup`` (sartsolver_cuda.cpp:138-194).
@@ -971,6 +1104,13 @@ def prepare_measurement(measurement, opts: SolverOptions):
     g64 = np.asarray(measurement, dtype=np.float64)
     if opts.normalize:
         norm = float(np.max(g64, initial=0.0))
+        if not np.isfinite(norm):
+            # a NaN/inf-poisoned pixel must not poison the whole frame's
+            # normalization: the finite pixels still define the scale, the
+            # poisoned ones stay non-finite for the solver's input guard
+            # (divergence_recovery) to flag — and the frame's solution row
+            # denormalizes by a finite factor either way
+            norm = float(np.max(g64[np.isfinite(g64)], initial=0.0))
         if norm <= 0:
             norm = 1.0
     else:

@@ -51,30 +51,51 @@ def initialize(
     With no arguments, coordination is discovered from the environment —
     automatic on Cloud TPU pods, or via JAX's standard
     ``JAX_COORDINATOR_ADDRESS``/``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID``.
+
+    Named fault site ``multihost.init``, wrapped in the site's retry
+    policy (resilience/retry.py): on a pod bring-up the coordinator is
+    routinely not listening yet when workers start — a connect failure is
+    retried with backoff instead of killing the worker; exhaustion raises
+    ``RetriesExhausted`` for the CLI's infrastructure exit.
     """
     already = getattr(jax.distributed, "is_initialized", None)
     if already is not None and already():
         return
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as err:
-        # "only be called once": already initialized (race or old JAX).
-        # "must be called before": the XLA backend is already up in this
-        # process (e.g. a second CLI invocation in one interpreter) — the
-        # multi-controller runtime can't start anymore; continue
-        # single-process, which is what such a process is.
-        if ("only be called once" not in str(err)
-                and "must be called before" not in str(err)):
-            raise
-    except ValueError as err:
-        # No coordinator discoverable (not on a pod, no JAX_COORDINATOR_*
-        # env): a single-process run needs no coordination service.
-        if "coordinator_address" not in str(err):
-            raise
+    from sartsolver_tpu.resilience import faults
+    from sartsolver_tpu.resilience.retry import retry_call
+
+    def attempt() -> None:
+        faults.fire(faults.SITE_MULTIHOST_INIT)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as err:
+            # "only be called once": already initialized (race or old JAX).
+            # "must be called before": the XLA backend is already up in this
+            # process (e.g. a second CLI invocation in one interpreter) — the
+            # multi-controller runtime can't start anymore; continue
+            # single-process, which is what such a process is. Both are
+            # terminal states, never retried — re-raised as the benign
+            # sentinel below before the retry wrapper can see them.
+            if ("only be called once" not in str(err)
+                    and "must be called before" not in str(err)):
+                raise
+        except ValueError as err:
+            # No coordinator discoverable (not on a pod, no JAX_COORDINATOR_*
+            # env): a single-process run needs no coordination service.
+            if "coordinator_address" not in str(err):
+                raise
+
+    retry_call(
+        attempt, site=faults.SITE_MULTIHOST_INIT,
+        # transient bring-up failures: injected I/O faults and the
+        # coordinator-unreachable RuntimeErrors the benign filter above
+        # let through
+        retry_on=(OSError, RuntimeError),
+    )
 
 
 def is_primary() -> bool:
@@ -152,9 +173,8 @@ def read_and_quantize_rtm(
         colmax = np.zeros(c_hi - c_lo, np.float32)
         for r0 in range(0, npixel, chunk):
             n = min(chunk, npixel - r0)
-            stripe = read_rtm_block(
+            stripe = _read_stripe_retried(
                 sorted_matrix_files, rtm_name, n, nvoxel, r0,
-                dtype=np.float32,
                 offset_voxel=c_lo, nvoxel_local=c_hi - c_lo,
                 sparse_cache=sparse_cache,
                 cache_rows=(0, npixel), cache_cols=(c_lo, c_hi),
@@ -183,6 +203,31 @@ def read_and_quantize_rtm(
         P(VOXEL_AXIS if VOXEL_AXIS in mesh.shape else None),
     )
     return codes, scale
+
+
+def _read_stripe_retried(
+    sorted_matrix_files, rtm_name, n, nvoxel, r0, **kwargs
+) -> np.ndarray:
+    """One RTM row-stripe read under the ``hdf5.rtm_ingest`` retry policy.
+
+    The stripe read is idempotent (a pure hyperslab/triplet read into a
+    fresh buffer), so a transient I/O failure — torn NFS read, a
+    filesystem briefly remounting — costs one backoff instead of the
+    whole tens-of-GB ingest. Exhaustion raises ``RetriesExhausted``; the
+    run cannot continue without its matrix, and the CLI maps that to the
+    infrastructure exit code.
+    """
+    from sartsolver_tpu.resilience import faults
+    from sartsolver_tpu.resilience.retry import retry_call
+
+    def attempt() -> np.ndarray:
+        faults.fire(faults.SITE_RTM_INGEST)
+        return read_rtm_block(
+            sorted_matrix_files, rtm_name, n, nvoxel, r0,
+            dtype=np.float32, **kwargs,
+        )
+
+    return retry_call(attempt, site=faults.SITE_RTM_INGEST)
 
 
 def read_and_shard_rtm(
@@ -312,9 +357,8 @@ def read_and_shard_rtm(
                     if c_hi <= c_lo:
                         return None
                     n = min(chunk_rows, rows_have - cs)
-                    return read_rtm_block(
+                    return _read_stripe_retried(
                         sorted_matrix_files, rtm_name, n, nvoxel, r0 + cs,
-                        dtype=np.float32,
                         offset_voxel=c_lo, nvoxel_local=c_hi - c_lo,
                         sparse_cache=sparse_cache,
                         cache_rows=row_span, cache_cols=col_span,

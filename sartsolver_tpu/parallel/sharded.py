@@ -63,7 +63,13 @@ from sartsolver_tpu.parallel.mesh import (
 
 def _stage(host_array, mesh, spec) -> jax.Array:
     """Host -> global sharded array; multi-host safe (device_put cannot
-    target non-addressable devices)."""
+    target non-addressable devices). Named fault site ``device.put``: a
+    staging failure (device OOM, a preempted/hung device runtime) is a
+    per-solve-call hazard the CLI's frame isolation absorbs into FAILED
+    frames."""
+    from sartsolver_tpu.resilience import faults
+
+    faults.fire(faults.SITE_DEVICE_PUT)
     if jax.process_count() == 1:
         return jax.device_put(host_array, NamedSharding(mesh, spec))
     from sartsolver_tpu.parallel.multihost import make_global
@@ -739,12 +745,17 @@ class DistributedSARTSolver:
         solver's staged problem arrays, not its results' buffers, so a
         still-alive result remains a legitimate seed (the foreign-warm
         pattern)."""
+        # jax.Array.is_deleted is called directly (ADVICE r5): the former
+        # getattr(..., lambda: False) probe would silently skip the check
+        # forever after a jax API rename, resurfacing the opaque XLA
+        # dispatch error this guard exists to pre-empt — an AttributeError
+        # here is the loud signal that the guard needs porting.
         dead = [
             name for name, arr in (
                 ("solution", warm.solution_norm),
                 ("fitted", warm.fitted_norm),
             )
-            if arr is not None and getattr(arr, "is_deleted", lambda: False)()
+            if arr is not None and arr.is_deleted()
         ]
         if dead:
             raise ValueError(
@@ -790,6 +801,9 @@ class DistributedSARTSolver:
         per-frame setup forward projection — one full RTM read saved per
         warm frame (models/sart fitted0 docs).
         """
+        from sartsolver_tpu.resilience import faults
+
+        faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
         if warm is not None and f0 is not None:
@@ -870,6 +884,9 @@ class DistributedSARTSolver:
         no-op up to one ulp of the compute dtype, and a warm start is only
         an initial guess).
         """
+        from sartsolver_tpu.resilience import faults
+
+        faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
         if warm is not None and f0 is not None:

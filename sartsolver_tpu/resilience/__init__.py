@@ -1,0 +1,55 @@
+"""Resilience layer: fault injection, retry/backoff, failure taxonomy.
+
+The reference solver is a batch MPI job that dies on any fault; a
+production service reconstructing hundreds of frames must treat a torn
+HDF5 read, a preempted host or one NaN-poisoned frame as an expected
+event, not a process-fatal exception (docs/RESILIENCE.md). This package
+supplies the three host-side building blocks, each threaded through the
+stack by the module that owns the hazard:
+
+- :mod:`~sartsolver_tpu.resilience.faults` — a deterministic
+  fault-injection registry (``SART_FAULT=site:kind:prob[:count]`` env +
+  programmatic API) with named sites in HDF5 ingest, prefetch, device
+  staging, solve dispatch, output flush and multihost init, so every
+  recovery path is testable without real hardware faults.
+- :mod:`~sartsolver_tpu.resilience.retry` — bounded retry with
+  exponential backoff + deterministic jitter and a per-site deadline,
+  wrapped around HDF5 frame reads, RTM stripe ingest and
+  ``jax.distributed.initialize``.
+- :mod:`~sartsolver_tpu.resilience.failures` — the failure taxonomy:
+  frame-level statuses (``DIVERGED``/``FRAME_FAILED``), the exception
+  classes the CLI's per-frame isolation may absorb, process exit codes,
+  and the end-of-run :class:`~sartsolver_tpu.resilience.failures.RunSummary`.
+
+The in-solve divergence guard (rollback to the last good iterate +
+relaxation halving, ``SolverOptions.divergence_recovery``) lives in
+``models/sart.py`` — it runs inside the jitted while_loop, not on the
+host.
+"""
+
+from sartsolver_tpu.resilience.failures import (  # noqa: F401
+    EXIT_INFRASTRUCTURE,
+    EXIT_INPUT_ERROR,
+    EXIT_OK,
+    EXIT_PARTIAL,
+    FRAME_FAILED,
+    RECOVERABLE_FRAME_ERRORS,
+    FrameFailure,
+    OutputWriteError,
+    RunSummary,
+)
+from sartsolver_tpu.resilience.faults import (  # noqa: F401
+    FAULT_SITES,
+    InjectedFault,
+    InjectedIOError,
+    clear_faults,
+    corrupt,
+    fire,
+    inject,
+)
+from sartsolver_tpu.resilience.retry import (  # noqa: F401
+    RetriesExhausted,
+    RetryPolicy,
+    retry_call,
+    retry_stats,
+)

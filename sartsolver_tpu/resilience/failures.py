@@ -1,0 +1,164 @@
+"""Failure taxonomy: statuses, exceptions, exit codes, run summary.
+
+The reference has exactly two per-frame statuses (0 converged, -1
+iteration cap) and one process outcome (alive or dead). A resilient
+service needs a richer, *stable* vocabulary — every value here is part of
+the output-file and exit-code contract (docs/RESILIENCE.md,
+docs/FORMATS.md):
+
+Per-frame statuses (``solution/status``; extends config.py's codes):
+
+- ``0``  SUCCESS — converged.
+- ``-1`` MAX_ITERATIONS_EXCEEDED — iteration cap (reference parity; not
+  a failure).
+- ``-2`` DIVERGED — the in-solve divergence guard exhausted its
+  rollback/relaxation-halving ladder; the row holds the last *finite*
+  iterate (models/sart.py).
+- ``-3`` FRAME_FAILED — the frame never produced a solution (ingest
+  retries exhausted, staging/solve dispatch fault); the row holds zeros
+  and ``iterations = -1``.
+
+Process exit codes (the CLI contract):
+
+- ``0`` EXIT_OK — every frame SUCCESS or MAX_ITERATIONS_EXCEEDED.
+- ``1`` EXIT_INPUT_ERROR — user input/flag problem (reference parity).
+- ``2`` EXIT_PARTIAL — the run COMPLETED but at least one frame is
+  DIVERGED/FRAME_FAILED; the output file holds every frame's row.
+- ``3`` EXIT_INFRASTRUCTURE — the run ABORTED on an unrecoverable
+  infrastructure failure after retries (RTM ingest, output flush,
+  multihost init); the output file is resumable.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+from sartsolver_tpu.config import DIVERGED, MAX_ITERATIONS_EXCEEDED, SUCCESS
+from sartsolver_tpu.resilience.faults import InjectedFault, InjectedIOError
+from sartsolver_tpu.resilience.retry import RetriesExhausted, retry_stats
+
+FRAME_FAILED = -3
+
+EXIT_OK = 0
+EXIT_INPUT_ERROR = 1
+EXIT_PARTIAL = 2
+EXIT_INFRASTRUCTURE = 3
+
+
+class OutputWriteError(RuntimeError):
+    """A solution-file flush failed mid-run. Distinct from ``OSError`` so
+    the CLI maps it to EXIT_INFRASTRUCTURE (the file is resumable), not
+    the polite input-error exit."""
+
+
+class FrameFailure(NamedTuple):
+    """A frame the prefetcher could not deliver (read retries exhausted).
+
+    Shaped like the ``(frame, time, camera_times)`` stream items —
+    ``frame`` is None and ``[1]`` is still the composite time — so it
+    flows through the CLI's resume filter unchanged; the frame loop
+    pattern-matches on the type and records a FRAME_FAILED row instead of
+    solving.
+    """
+
+    frame: None
+    time: float
+    camera_times: List[float]
+    error: BaseException
+
+
+# What the CLI's per-frame isolation may absorb into a FRAME_FAILED row.
+# Deliberately narrow: an unexpected ValueError/TypeError is an internal
+# bug and must traceback (tests/test_cli.py::test_internal_error_propagates),
+# not be laundered into a "failed frame". JaxRuntimeError is the REAL
+# counterpart of the injected device.put/solve.dispatch faults — device
+# OOM, a preempted/halted runtime — raised at execute time, never for
+# trace-time bugs (those surface as TypeError/ValueError before any
+# frame-specific work). Guarded import: jax is always loaded by the time
+# a solve can fail, but this module must stay importable without it.
+try:
+    from jax.errors import JaxRuntimeError as _JaxRuntimeError
+
+    _DEVICE_ERRORS = (_JaxRuntimeError,)
+except ImportError:  # pragma: no cover - jax is a hard dep in practice
+    _DEVICE_ERRORS = ()
+
+RECOVERABLE_FRAME_ERRORS = (
+    OSError,  # includes InjectedIOError and real I/O errors
+    InjectedFault,
+    RetriesExhausted,
+) + _DEVICE_ERRORS
+
+
+def status_name(status: int) -> str:
+    return {
+        SUCCESS: "converged",
+        MAX_ITERATIONS_EXCEEDED: "max-iterations",
+        DIVERGED: "diverged",
+        FRAME_FAILED: "failed",
+    }.get(int(status), f"unknown({int(status)})")
+
+
+class RunSummary:
+    """End-of-run accounting of per-frame outcomes and retry activity."""
+
+    def __init__(self) -> None:
+        self.counts = {SUCCESS: 0, MAX_ITERATIONS_EXCEEDED: 0,
+                       DIVERGED: 0, FRAME_FAILED: 0}
+        self.failed_times: List[float] = []
+
+    def record_status(self, status: int, time: Optional[float] = None) -> None:
+        status = int(status)
+        self.counts[status] = self.counts.get(status, 0) + 1
+        if status in (DIVERGED, FRAME_FAILED) and time is not None:
+            self.failed_times.append(float(time))
+
+    @property
+    def n_frames(self) -> int:
+        return sum(self.counts.values())
+
+    @property
+    def n_failed(self) -> int:
+        return self.counts[DIVERGED] + self.counts[FRAME_FAILED]
+
+    def had_retries(self) -> bool:
+        return any(
+            v["recoveries"] or v["exhausted"]
+            for v in retry_stats().values()
+        )
+
+    def exit_code(self) -> int:
+        return EXIT_PARTIAL if self.n_failed else EXIT_OK
+
+    def format(self) -> str:
+        parts = [
+            f"{n} {status_name(s)}"
+            for s, n in sorted(self.counts.items(), reverse=True) if n
+        ]
+        lines = [
+            f"resilience summary: {self.n_frames} frame(s): "
+            + ", ".join(parts or ["none"])
+        ]
+        if self.failed_times:
+            shown = ", ".join(f"{t:g}" for t in self.failed_times[:8])
+            more = len(self.failed_times) - 8
+            lines.append(
+                "  failed frame time(s): " + shown
+                + (f" (+{more} more)" if more > 0 else "")
+            )
+        for site, v in sorted(retry_stats().items()):
+            if v["recoveries"] or v["exhausted"]:
+                lines.append(
+                    f"  retries at {site}: {v['attempts']} attempt(s), "
+                    f"{v['recoveries']} recovered, {v['exhausted']} exhausted"
+                )
+        return "\n".join(lines)
+
+
+def failed_row(nvoxel: int) -> np.ndarray:
+    """The solution row written for a FRAME_FAILED frame (all zeros, the
+    dataset fill value — indistinguishable from never-written except by
+    its status, which is the point: the status column is authoritative)."""
+    return np.zeros(nvoxel, np.float64)

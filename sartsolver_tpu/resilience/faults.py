@@ -1,0 +1,236 @@
+"""Deterministic fault-injection registry.
+
+Every recovery path in the stack (docs/RESILIENCE.md) is guarded by a
+*named site*: a point in real production code that consults this registry
+and — only when a fault is armed for it — raises or corrupts data. With
+nothing armed a site costs one dict lookup on a cold I/O path; the hot
+jitted solver code contains no sites (its resilience is the in-solve
+divergence guard, ``models/sart.py``).
+
+Arming faults:
+
+- Environment: ``SART_FAULT=site:kind:prob[:count][,site:kind:prob...]``
+  parsed once on first use (``reset()`` re-reads). ``prob`` is the
+  per-encounter trip probability drawn from a per-site RNG seeded by
+  ``SART_FAULT_SEED`` (default 0) — a given spec therefore trips on the
+  exact same encounters every run. ``count`` caps the number of trips
+  (default unlimited); ``prob=1`` with a count gives fully deterministic
+  "fail the first N encounters" faults, which is what the test matrix
+  uses.
+- Programmatic: :func:`inject` / :func:`clear_faults`, or the
+  :func:`injected` context manager.
+
+Kinds:
+
+- ``io`` — the site raises :class:`InjectedIOError` (an ``OSError``),
+  modeling a torn read / NFS blip / torn write.
+- ``error`` — the site raises :class:`InjectedFault` (a ``RuntimeError``),
+  modeling a non-I/O infrastructure failure (e.g. a device runtime error).
+- ``nan`` — sites that pass data through :func:`corrupt` get the array
+  NaN-poisoned, modeling bad sensor frames / bit flips; exception sites
+  ignore this kind.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import zlib
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def site_seed(site: str) -> int:
+    """Stable per-site seed component. ``hash(str)`` is salted per process
+    (PYTHONHASHSEED), which would make a prob < 1 fault trip on different
+    encounters every run; CRC32 is stable across processes and Python
+    versions, so a given SART_FAULT spec reproduces exactly."""
+    return zlib.crc32(site.encode())
+
+# Named injection sites. Free-form strings are rejected at arm time so a
+# typo in SART_FAULT fails loudly instead of silently never firing.
+SITE_FRAME_READ = "hdf5.frame_read"  # io/image.py: composite frame ingest
+SITE_RTM_INGEST = "hdf5.rtm_ingest"  # parallel/multihost.py: RTM stripe read
+SITE_PREFETCH = "prefetch.next"      # utils/prefetch.py: worker loop
+SITE_DEVICE_PUT = "device.put"       # parallel/sharded.py: host->device staging
+SITE_SOLVE = "solve.dispatch"        # parallel/sharded.py: solve entry
+SITE_FLUSH = "io.flush"              # io/solution.py: output flush
+SITE_MULTIHOST_INIT = "multihost.init"  # parallel/multihost.py: runtime init
+
+FAULT_SITES = frozenset({
+    SITE_FRAME_READ, SITE_RTM_INGEST, SITE_PREFETCH, SITE_DEVICE_PUT,
+    SITE_SOLVE, SITE_FLUSH, SITE_MULTIHOST_INIT,
+})
+
+FAULT_KINDS = ("io", "error", "nan")
+
+
+class InjectedIOError(OSError):
+    """An injected I/O fault (kind ``io``)."""
+
+
+class InjectedFault(RuntimeError):
+    """An injected non-I/O fault (kind ``error``)."""
+
+
+@dataclasses.dataclass
+class _Fault:
+    site: str
+    kind: str
+    prob: float
+    count: Optional[int]  # max trips; None = unlimited
+    trips: int = 0
+    encounters: int = 0
+    rng: np.random.Generator = dataclasses.field(
+        default_factory=lambda: np.random.default_rng(0)
+    )
+
+    def should_trip(self) -> bool:
+        self.encounters += 1
+        if self.count is not None and self.trips >= self.count:
+            return False
+        # the draw happens on every encounter (tripped or capped alike) so
+        # the trip pattern of one site never depends on another's cap
+        hit = self.prob >= 1.0 or self.rng.random() < self.prob
+        if hit:
+            self.trips += 1
+        return hit
+
+
+# site -> armed fault; None means "not yet initialized from the env".
+_faults: Optional[Dict[str, _Fault]] = None
+_lock = threading.Lock()
+
+
+def parse_fault_spec(spec: str) -> Dict[str, _Fault]:
+    """Parse a ``SART_FAULT`` spec string into armed faults.
+
+    Grammar: comma-separated ``site:kind:prob[:count]`` entries. Raises
+    ``ValueError`` on unknown sites/kinds or malformed numbers — an armed
+    fault that never fires because of a typo would make the whole matrix
+    vacuous.
+    """
+    seed = int(os.environ.get("SART_FAULT_SEED", "0"))
+    out: Dict[str, _Fault] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"Malformed SART_FAULT entry {entry!r}; expected "
+                "site:kind:prob[:count]."
+            )
+        site, kind, prob_s = parts[0], parts[1], parts[2]
+        if site not in FAULT_SITES:
+            raise ValueError(
+                f"Unknown fault site {site!r}; valid: "
+                f"{', '.join(sorted(FAULT_SITES))}."
+            )
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"Unknown fault kind {kind!r}; valid: "
+                f"{', '.join(FAULT_KINDS)}."
+            )
+        prob = float(prob_s)
+        if not (0.0 < prob <= 1.0):
+            raise ValueError(f"Fault probability must be in (0, 1], got {prob}.")
+        count = int(parts[3]) if len(parts) == 4 else None
+        if count is not None and count < 1:
+            raise ValueError(f"Fault count must be >= 1, got {count}.")
+        out[site] = _Fault(
+            site, kind, prob, count,
+            rng=np.random.default_rng([seed, site_seed(site)]),
+        )
+    return out
+
+
+def _active() -> Dict[str, _Fault]:
+    global _faults
+    if _faults is None:
+        with _lock:
+            if _faults is None:
+                _faults = parse_fault_spec(os.environ.get("SART_FAULT", ""))
+    return _faults
+
+
+def inject(site: str, kind: str = "io", prob: float = 1.0,
+           count: Optional[int] = None) -> None:
+    """Arm a fault programmatically (same semantics as the env spec)."""
+    armed = parse_fault_spec(
+        f"{site}:{kind}:{prob}" + (f":{count}" if count is not None else "")
+    )
+    _active().update(armed)
+
+
+def clear_faults() -> None:
+    """Disarm every fault (env- and programmatically-armed alike)."""
+    global _faults
+    with _lock:
+        _faults = {}
+
+
+def reset() -> None:
+    """Forget all state; the next use re-reads ``SART_FAULT``."""
+    global _faults
+    with _lock:
+        _faults = None
+
+
+class injected:
+    """Context manager arming a fault for its scope (tests)."""
+
+    def __init__(self, site: str, kind: str = "io", prob: float = 1.0,
+                 count: Optional[int] = None):
+        self._args = (site, kind, prob, count)
+
+    def __enter__(self):
+        inject(*self._args)
+        return self
+
+    def __exit__(self, *exc):
+        _active().pop(self._args[0], None)
+
+
+def fire(site: str) -> None:
+    """Raise the armed exception fault for ``site``, if it trips.
+
+    The zero-fault path is one dict lookup; ``nan`` faults never raise
+    (they act through :func:`corrupt`).
+    """
+    fault = _active().get(site)
+    if fault is None or fault.kind == "nan":
+        return
+    if fault.should_trip():
+        if fault.kind == "io":
+            raise InjectedIOError(
+                f"injected I/O fault at {site} (trip {fault.trips})"
+            )
+        raise InjectedFault(
+            f"injected fault at {site} (trip {fault.trips})"
+        )
+
+
+def corrupt(site: str, array: np.ndarray) -> np.ndarray:
+    """NaN-poison ``array`` if a ``nan`` fault trips at ``site``.
+
+    Returns the input unchanged (no copy) on the zero-fault path; a
+    tripped fault returns a poisoned copy (the first element set to NaN —
+    enough to poison any reduction over the data that contains it).
+    """
+    fault = _active().get(site)
+    if fault is None or fault.kind != "nan":
+        return array
+    if not fault.should_trip():
+        return array
+    poisoned = np.array(array, dtype=np.float64, copy=True)
+    poisoned.reshape(-1)[0] = np.nan
+    return poisoned
+
+
+def fault_trips() -> Dict[str, int]:
+    """Trip counts per armed site (observability / test assertions)."""
+    return {site: f.trips for site, f in _active().items()}

@@ -6,6 +6,21 @@ background thread stays one-or-more frames ahead in the composite stream
 while the device solves, hiding ingest behind compute (h5py releases the
 GIL during reads). Depth is bounded so at most ``depth`` frames of host
 memory are in flight.
+
+Resilience (docs/RESILIENCE.md): each frame read is wrapped in the
+``prefetch.next`` retry policy (bounded attempts, exponential backoff —
+resilience/retry.py), so a transient I/O blip costs one backoff, not the
+run. When retries are exhausted the behavior forks on
+``isolate_failures``:
+
+- ``False`` (library default, the pre-resilience contract): the stream
+  ends and the error is re-raised on the consumer side.
+- ``True`` (the CLI's single-process frame loop): a
+  :class:`~sartsolver_tpu.resilience.failures.FrameFailure` item is
+  emitted *in place of* the unreadable frame — its composite time and
+  per-camera times come from the in-memory alignment tables, no I/O — and
+  the stream continues with the next frame, so one dead frame costs one
+  FAILED row instead of the run.
 """
 
 from __future__ import annotations
@@ -17,6 +32,13 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from sartsolver_tpu.io.image import CompositeImage
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.failures import FrameFailure
+from sartsolver_tpu.resilience.retry import (
+    RetriesExhausted,
+    RetryPolicy,
+    retry_call,
+)
 
 
 class FramePrefetcher:
@@ -25,12 +47,25 @@ class FramePrefetcher:
     Use as a context manager (or call :meth:`close`) when the iterator may be
     abandoned early — e.g. the consumer raising mid-loop — so the worker
     thread is released rather than left blocked on a full queue.
+
+    With ``isolate_failures=True`` the stream may also yield
+    :class:`FrameFailure` items (see module docstring); consumers opting in
+    must pattern-match on the item type.
     """
 
-    def __init__(self, composite: CompositeImage, depth: int = 2):
+    def __init__(
+        self,
+        composite: CompositeImage,
+        depth: int = 2,
+        *,
+        isolate_failures: bool = False,
+        retry_policy: Optional[RetryPolicy] = None,
+    ):
         if depth < 1:
             raise ValueError("Prefetch depth must be positive.")
         self._composite = composite
+        self._isolate = isolate_failures
+        self._policy = retry_policy
         self._queue: "queue.Queue[Optional[Tuple[np.ndarray, float, list]]]" = (
             queue.Queue(maxsize=depth)
         )
@@ -49,14 +84,39 @@ class FramePrefetcher:
                 continue
         return False
 
+    def _read_frame(self, i: int):
+        """One retried frame read (the retry unit spans the whole cache
+        fill — io/image.py:_cache_hdf5 — which leaves no partial state on
+        failure)."""
+
+        def attempt():
+            faults.fire(faults.SITE_PREFETCH)
+            frame = self._composite.frame(i)
+            return (frame, self._composite.frame_time(i),
+                    self._composite.camera_frame_time(i))
+
+        return retry_call(
+            attempt, site=faults.SITE_PREFETCH, policy=self._policy,
+            retry_on=(OSError,),
+        )
+
     def _worker(self) -> None:
         try:
-            while not self._stop.is_set():
-                frame = self._composite.next_frame()
-                if frame is None:
-                    break
-                item = (frame, self._composite.frame_time(),
-                        self._composite.camera_frame_time())
+            for i in range(len(self._composite)):
+                if self._stop.is_set():
+                    return
+                try:
+                    item = self._read_frame(i)
+                except RetriesExhausted as err:
+                    if not self._isolate:
+                        raise
+                    # the frame is unreadable but its composite time is
+                    # host memory: emit a typed failure so the consumer
+                    # records a FAILED row and the stream survives
+                    item = FrameFailure(
+                        None, self._composite.frame_time(i),
+                        self._composite.camera_frame_time(i), err,
+                    )
                 if not self._put(item):
                     return
         except BaseException as err:  # surfaced on the consumer side

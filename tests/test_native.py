@@ -65,8 +65,19 @@ def test_prefetcher_yields_all_frames_in_order(tmp_path):
 
 def test_prefetcher_propagates_errors(tmp_path):
     class Exploding:
-        def next_frame(self):
+        # the prefetcher's indexed-streaming surface (frame/len + the
+        # time accessors it reads for failure isolation)
+        def __len__(self):
+            return 3
+
+        def frame(self, i=None):
             raise RuntimeError("boom")
+
+        def frame_time(self, i=None):
+            return 0.0
+
+        def camera_frame_time(self, i=None):
+            return []
 
     with pytest.raises(RuntimeError, match="boom"):
         list(FramePrefetcher(Exploding()))

@@ -1,0 +1,701 @@
+"""Resilience layer: fault matrix, retry/backoff, divergence recovery,
+per-frame failure isolation (docs/RESILIENCE.md).
+
+For every named injection site the matrix proves both legs:
+
+- **recover** — a transient fault (one tripped attempt) is retried and the
+  run completes with the same output as a clean run, exit 0;
+- **degrade** — a persistent fault exhausts its budget and the run takes
+  its documented degradation path: a FAILED/DIVERGED status row + run
+  continues + exit 2 for per-frame hazards, a resumable abort + exit 3
+  for infrastructure hazards.
+
+The killdrill (tests/test_killdrill.py) separately proves the resumed
+output stays byte-identical with the resilience layer active (it is
+always active — the retry wrappers and isolation are the default path).
+
+``make faults`` runs exactly this module.
+"""
+
+import os
+import threading
+import time
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+from sartsolver_tpu.cli import main
+from sartsolver_tpu.config import DIVERGED, SolverOptions
+from sartsolver_tpu.models.sart import make_problem, solve
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.failures import (
+    EXIT_INFRASTRUCTURE,
+    EXIT_PARTIAL,
+    FRAME_FAILED,
+    FrameFailure,
+)
+from sartsolver_tpu.resilience.retry import (
+    RetriesExhausted,
+    RetryPolicy,
+    reset_retry_stats,
+    retry_call,
+    retry_stats,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults(monkeypatch):
+    """Every test starts and ends with no armed faults, fresh retry stats
+    and fast backoff (the real defaults would add ~0.1 s per retry)."""
+    monkeypatch.setenv("SART_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("SART_RETRY_MAX_DELAY", "0.002")
+    faults.clear_faults()
+    reset_retry_stats()
+    yield
+    faults.clear_faults()
+    reset_retry_stats()
+
+
+# ---------------------------------------------------------------------------
+# fault-injection registry
+# ---------------------------------------------------------------------------
+
+def test_fault_spec_parsing_and_validation():
+    armed = faults.parse_fault_spec(
+        "hdf5.frame_read:io:1, solve.dispatch:error:0.5:3"
+    )
+    assert armed["hdf5.frame_read"].kind == "io"
+    assert armed["solve.dispatch"].count == 3
+    for bad in ("nosuch.site:io:1", "hdf5.frame_read:meteor:1",
+                "hdf5.frame_read:io:0", "hdf5.frame_read:io:2",
+                "hdf5.frame_read:io", "hdf5.frame_read:io:1:0"):
+        with pytest.raises(ValueError):
+            faults.parse_fault_spec(bad)
+
+
+def test_fault_env_round_trip(monkeypatch):
+    monkeypatch.setenv("SART_FAULT", "io.flush:io:1:2")
+    faults.reset()
+    with pytest.raises(faults.InjectedIOError):
+        faults.fire(faults.SITE_FLUSH)
+    with pytest.raises(faults.InjectedIOError):
+        faults.fire(faults.SITE_FLUSH)
+    faults.fire(faults.SITE_FLUSH)  # count=2 exhausted: no more trips
+    assert faults.fault_trips()["io.flush"] == 2
+    monkeypatch.delenv("SART_FAULT")
+    faults.reset()
+
+
+def test_fault_count_and_kinds():
+    faults.inject(faults.SITE_SOLVE, "error", count=1)
+    with pytest.raises(faults.InjectedFault):
+        faults.fire(faults.SITE_SOLVE)
+    faults.fire(faults.SITE_SOLVE)  # capped
+
+    faults.inject(faults.SITE_FRAME_READ, "nan", count=1)
+    faults.fire(faults.SITE_FRAME_READ)  # nan kind never raises
+    arr = np.ones((2, 3))
+    poisoned = faults.corrupt(faults.SITE_FRAME_READ, arr)
+    assert np.isnan(poisoned).any() and not np.isnan(arr).any()
+    # capped: second corrupt is the identity, no copy
+    assert faults.corrupt(faults.SITE_FRAME_READ, arr) is arr
+
+
+def test_fault_probability_is_seeded_deterministic():
+    faults.inject(faults.SITE_PREFETCH, "io", prob=0.5)
+    pattern1 = []
+    for _ in range(32):
+        try:
+            faults.fire(faults.SITE_PREFETCH)
+            pattern1.append(False)
+        except faults.InjectedIOError:
+            pattern1.append(True)
+    faults.clear_faults()
+    faults.inject(faults.SITE_PREFETCH, "io", prob=0.5)
+    pattern2 = []
+    for _ in range(32):
+        try:
+            faults.fire(faults.SITE_PREFETCH)
+            pattern2.append(False)
+        except faults.InjectedIOError:
+            pattern2.append(True)
+    assert pattern1 == pattern2
+    assert any(pattern1) and not all(pattern1)
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff
+# ---------------------------------------------------------------------------
+
+def test_retry_recovers_after_transient_failure():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, site="hdf5.rtm_ingest",
+                      policy=RetryPolicy(attempts=4, base_delay=0),
+                      sleep=lambda s: None) == "ok"
+    stats = retry_stats()["hdf5.rtm_ingest"]
+    assert stats["attempts"] == 3 and stats["recoveries"] == 1
+
+
+def test_retry_exhaustion_raises_with_cause():
+    def dead():
+        raise OSError("permanent")
+
+    with pytest.raises(RetriesExhausted) as exc:
+        retry_call(dead, site="hdf5.rtm_ingest",
+                   policy=RetryPolicy(attempts=3, base_delay=0),
+                   sleep=lambda s: None)
+    assert isinstance(exc.value.__cause__, OSError)
+    assert exc.value.attempts == 3
+    assert retry_stats()["hdf5.rtm_ingest"]["exhausted"] == 1
+
+
+def test_retry_does_not_swallow_internal_errors():
+    def bug():
+        raise ValueError("internal bug")
+
+    with pytest.raises(ValueError, match="internal bug"):
+        retry_call(bug, site="hdf5.rtm_ingest",
+                   policy=RetryPolicy(attempts=5, base_delay=0),
+                   sleep=lambda s: None)
+    assert retry_stats()["hdf5.rtm_ingest"]["attempts"] == 1
+
+
+def test_retry_backoff_is_exponential_capped_jittered():
+    delays = []
+
+    def dead():
+        raise OSError("x")
+
+    with pytest.raises(RetriesExhausted):
+        retry_call(dead, site="prefetch.next",
+                   policy=RetryPolicy(attempts=5, base_delay=0.1,
+                                      max_delay=0.3, jitter=0.1),
+                   sleep=delays.append)
+    assert len(delays) == 4  # no sleep after the final attempt
+    # exponential under the cap, +-10% jitter
+    assert 0.09 <= delays[0] <= 0.11
+    assert 0.18 <= delays[1] <= 0.22
+    assert all(d <= 0.3 * 1.1 for d in delays)
+    assert delays[3] <= 0.33  # capped
+
+
+def test_retry_deadline_gives_up_early(monkeypatch):
+    t = {"now": 0.0}
+    monkeypatch.setattr(time, "monotonic", lambda: t["now"])
+
+    def dead():
+        t["now"] += 40.0
+        raise OSError("slow device")
+
+    with pytest.raises(RetriesExhausted) as exc:
+        retry_call(dead, site="multihost.init",
+                   policy=RetryPolicy(attempts=10, base_delay=0,
+                                      deadline=60.0),
+                   sleep=lambda s: None)
+    assert exc.value.attempts == 2  # 80s elapsed > 60s deadline
+
+
+# ---------------------------------------------------------------------------
+# FramePrefetcher error paths (ADVICE: satellite coverage)
+# ---------------------------------------------------------------------------
+
+def _make_composite(tmp_path, **kw):
+    from sartsolver_tpu.io import hdf5files as hf
+    from sartsolver_tpu.io.image import CompositeImage
+
+    paths, *_ = fx.write_world(tmp_path, **kw)
+    m, i = hf.categorize_input_files(
+        [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+         paths["img_a"], paths["img_b"]])
+    sm, si = hf.sort_rtm_files(m), hf.sort_image_files(i)
+    masks = hf.read_rtm_frame_masks(sm)
+    return CompositeImage(si, masks, [(0.0, 10.0, 0.0, 0.0)], fx.NPIXEL)
+
+
+def test_prefetcher_surfaces_worker_exception(tmp_path, monkeypatch):
+    """A non-retryable worker error (an internal bug, not I/O) ends the
+    stream and re-raises on the consumer side — never silently truncates."""
+    from sartsolver_tpu.io.image import CompositeImage
+    from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+    composite = _make_composite(tmp_path)
+    orig = CompositeImage.frame
+
+    def broken(self, i=None):
+        if i == 2:
+            raise ValueError("internal decode bug")
+        return orig(self, i)
+
+    monkeypatch.setattr(CompositeImage, "frame", broken)
+    got = []
+    with FramePrefetcher(composite) as frames:
+        with pytest.raises(ValueError, match="internal decode bug"):
+            for item in frames:
+                got.append(item)
+    assert len(got) == 2  # frames 0 and 1 arrived before the error
+
+
+def test_prefetcher_close_during_blocked_put(tmp_path):
+    """close() while the worker is blocked on a full queue must release
+    the thread, not deadlock."""
+    from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+    composite = _make_composite(tmp_path, n_frames=8)
+    pf = FramePrefetcher(composite, depth=1)
+    deadline = time.monotonic() + 5
+    while pf._queue.qsize() < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)  # worker fills the depth-1 queue, then blocks
+    assert pf._queue.qsize() >= 1
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_retries_transient_read(tmp_path):
+    """One tripped read attempt is retried transparently: every frame
+    arrives, in order, and the retry stats show the recovery."""
+    from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+    composite = _make_composite(tmp_path)
+    faults.inject(faults.SITE_PREFETCH, "io", count=1)
+    with FramePrefetcher(composite) as frames:
+        got = list(frames)
+    assert len(got) == 4
+    assert not any(isinstance(item, FrameFailure) for item in got)
+    assert retry_stats()["prefetch.next"]["recoveries"] == 1
+
+
+def test_prefetcher_isolates_exhausted_frame(tmp_path):
+    """Retries exhausted on one frame: with isolation the stream yields a
+    FrameFailure for it and CONTINUES; without isolation (the library
+    default) the stream aborts with RetriesExhausted."""
+    from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+    policy = RetryPolicy(attempts=2, base_delay=0)
+    composite = _make_composite(tmp_path)
+    faults.inject(faults.SITE_PREFETCH, "io", count=2)  # = frame 0's budget
+    with FramePrefetcher(composite, isolate_failures=True,
+                         retry_policy=policy) as frames:
+        got = list(frames)
+    assert len(got) == 4
+    assert isinstance(got[0], FrameFailure)
+    assert got[0].time == composite.frame_time(0)
+    assert isinstance(got[0].error, RetriesExhausted)
+    assert all(not isinstance(item, FrameFailure) for item in got[1:])
+
+    # same fault, no isolation: the stream dies with the exhaustion error
+    faults.inject(faults.SITE_PREFETCH, "io", count=2)
+    composite2 = _make_composite(tmp_path)
+    with FramePrefetcher(composite2, retry_policy=policy) as frames:
+        with pytest.raises(RetriesExhausted):
+            list(frames)
+
+
+# ---------------------------------------------------------------------------
+# in-solve divergence recovery (models/sart.py)
+# ---------------------------------------------------------------------------
+
+def _small_case(seed=0, P=16, V=12):
+    rng = np.random.default_rng(seed)
+    H = rng.uniform(0.1, 1.0, (P, V)).astype(np.float32)
+    f_true = rng.uniform(0.5, 2.0, V)
+    return H, H @ f_true
+
+
+def test_guard_off_by_default_and_identical_when_healthy():
+    H, g = _small_case()
+    o_off = SolverOptions(max_iterations=200, conv_tolerance=1e-6)
+    o_on = SolverOptions(max_iterations=200, conv_tolerance=1e-6,
+                         divergence_recovery=3)
+    assert o_off.divergence_recovery == 0
+    r_off = solve(make_problem(H, opts=o_off), g, opts=o_off)
+    r_on = solve(make_problem(H, opts=o_on), g, opts=o_on)
+    np.testing.assert_array_equal(
+        np.asarray(r_off.solution), np.asarray(r_on.solution))
+    assert int(r_off.iterations) == int(r_on.iterations)
+    assert int(r_on.status) == 0
+
+
+def test_nan_poisoned_frame_fails_cleanly():
+    """Non-finite measurement: no good iterate can exist (the Eq. 4 guess
+    is computed from the poisoned data), so the input guard pre-fails the
+    frame — status DIVERGED, zero solution, zero iterations burned."""
+    H, g = _small_case(1)
+    g = g.copy()
+    g[0] = np.nan
+    opts = SolverOptions(max_iterations=200, conv_tolerance=1e-6,
+                         divergence_recovery=3)
+    res = solve(make_problem(H, opts=opts), g, opts=opts)
+    assert int(res.status) == DIVERGED
+    assert int(res.iterations) == 0
+    np.testing.assert_array_equal(np.asarray(res.solution), 0.0)
+
+
+def test_corrupted_seed_fails_cleanly():
+    H, g = _small_case(2)
+    f0 = np.full(H.shape[1], np.inf)
+    opts = SolverOptions(max_iterations=50, divergence_recovery=2)
+    res = solve(make_problem(H, opts=opts), g, f0=f0, opts=opts)
+    assert int(res.status) == DIVERGED and int(res.iterations) == 0
+
+
+def test_batch_isolates_poisoned_frame():
+    """One poisoned frame in a batch diverges alone; its neighbours solve
+    to exactly what they solve in a clean batch."""
+    from sartsolver_tpu.models.sart import (
+        prepare_measurement, solve_normalized_batch,
+    )
+    import jax.numpy as jnp
+
+    H, g = _small_case(3)
+    opts = SolverOptions(max_iterations=200, conv_tolerance=1e-6,
+                         divergence_recovery=3)
+    problem = make_problem(H, opts=opts)
+    g_bad = g.copy()
+    g_bad[0] = np.nan
+
+    def stage(frames):
+        gs, msqs = [], []
+        for fr in frames:
+            g64, msq, _ = prepare_measurement(fr, opts)
+            gs.append(np.asarray(g64, np.float32))
+            msqs.append(msq)
+        return (jnp.asarray(np.stack(gs)),
+                jnp.asarray(np.asarray(msqs, np.float32)),
+                jnp.zeros((len(frames), H.shape[1]), jnp.float32))
+
+    res = solve_normalized_batch(
+        problem, *stage([g, g_bad, g * 1.1]), opts=opts, use_guess=True)
+    ref = solve_normalized_batch(
+        problem, *stage([g, g * 1.1]), opts=opts, use_guess=True)
+    assert list(np.asarray(res.status)) == [0, DIVERGED, 0]
+    sol = np.asarray(res.solution)
+    np.testing.assert_array_equal(sol[0], np.asarray(ref.solution)[0])
+    np.testing.assert_array_equal(sol[2], np.asarray(ref.solution)[1])
+    np.testing.assert_array_equal(sol[1], 0.0)
+
+
+def test_escalation_ladder_rolls_back_and_exhausts():
+    """Genuine numeric divergence (an explicit-Euler-unstable Laplacian
+    weight): the guard trips, rolls back, halves, and iterates again
+    between trips — ending in a clean DIVERGED frame holding a finite
+    iterate, where the unguarded solver runs to the cap with the iterate
+    grown ~1e9."""
+    from sartsolver_tpu.ops.laplacian import make_laplacian
+
+    H, g = _small_case(3)
+    V = H.shape[1]
+    rows, cols, vals = [], [], []
+    for i in range(V):
+        rows.append(i); cols.append(i); vals.append(2.0)
+        if i > 0:
+            rows.append(i); cols.append(i - 1); vals.append(-1.0)
+        if i < V - 1:
+            rows.append(i); cols.append(i + 1); vals.append(-1.0)
+    lap = make_laplacian(np.asarray(rows), np.asarray(cols),
+                         np.asarray(vals, np.float32), dtype="float32")
+    kw = dict(max_iterations=500, conv_tolerance=1e-6, beta_laplace=0.8)
+    o_on = SolverOptions(divergence_recovery=6, divergence_threshold=1e3, **kw)
+    o_off = SolverOptions(**kw)
+    r_on = solve(make_problem(H, lap, opts=o_on), g, opts=o_on)
+    r_off = solve(make_problem(H, lap, opts=o_off), g, opts=o_off)
+
+    assert int(r_on.status) == DIVERGED
+    # the solve RESUMED after each rollback: more iterations than trips
+    assert 6 < int(r_on.iterations) < 500
+    sol_on = np.asarray(r_on.solution)
+    assert np.isfinite(sol_on).all()
+    # unguarded: the oscillation grows unbounded for the whole cap
+    assert np.asarray(r_off.solution).max() > 1e6 * sol_on.max()
+
+
+def test_dark_frame_with_guard_stays_benign():
+    """An all-zero (shutter-closed) frame must NOT be reported DIVERGED:
+    prepare_measurement remaps msq <= 0 to 1.0 before the solver sees it,
+    so the guard-on outcome matches guard-off (a finite solve that
+    terminates on the stall test, reference-parity status)."""
+    H, _ = _small_case(5)
+    g = np.zeros(H.shape[0])
+    o_on = SolverOptions(max_iterations=500, conv_tolerance=1e-6,
+                         divergence_recovery=3)
+    o_off = SolverOptions(max_iterations=500, conv_tolerance=1e-6)
+    r_on = solve(make_problem(H, opts=o_on), g, opts=o_on)
+    r_off = solve(make_problem(H, opts=o_off), g, opts=o_off)
+    assert int(r_on.status) == int(r_off.status) != DIVERGED
+    assert int(r_on.iterations) == int(r_off.iterations) < 500
+    np.testing.assert_array_equal(
+        np.asarray(r_on.solution), np.asarray(r_off.solution))
+
+
+def test_fault_seed_is_process_stable():
+    """Trip patterns must reproduce across processes: hash(str) is salted
+    per interpreter, so the site seed uses a stable digest."""
+    assert faults.site_seed("prefetch.next") == int(
+        __import__("zlib").crc32(b"prefetch.next"))
+
+
+def test_real_device_errors_are_recoverable():
+    """The real counterpart of the injected device faults (jaxlib's
+    XlaRuntimeError — device OOM, halted runtime) must be in the
+    isolation set, or production runs die on exactly the hazard the
+    sites model; trace-time bug types must NOT be."""
+    from jax.errors import JaxRuntimeError
+
+    from sartsolver_tpu.resilience.failures import RECOVERABLE_FRAME_ERRORS
+
+    assert issubclass(JaxRuntimeError, RECOVERABLE_FRAME_ERRORS)
+    assert not issubclass(ValueError, RECOVERABLE_FRAME_ERRORS)
+    assert not issubclass(TypeError, RECOVERABLE_FRAME_ERRORS)
+
+
+def test_log_solver_guard_and_fused_refusal():
+    H, g = _small_case(4)
+    g = g.copy()
+    g[1] = np.nan
+    opts = SolverOptions(max_iterations=50, logarithmic=True,
+                         divergence_recovery=2)
+    res = solve(make_problem(H, opts=opts), g, opts=opts)
+    assert int(res.status) == DIVERGED
+    with pytest.raises(ValueError, match="divergence_recovery"):
+        bad = SolverOptions(max_iterations=50, logarithmic=True,
+                            divergence_recovery=2, fused_sweep="interpret")
+        solve(make_problem(H, opts=bad), g, opts=bad)
+
+
+# ---------------------------------------------------------------------------
+# CLI fault matrix: recover AND degrade per injection site
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def world(tmp_path):
+    return fx.write_world(tmp_path, with_laplacian=True)
+
+
+def run_cli(paths, *extra):
+    return main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "300", "-c", "1e-6",
+        *extra,
+    ])
+
+
+def _read_out(paths):
+    with h5py.File(paths["output"], "r") as f:
+        return (f["solution/value"][:], f["solution/status"][:],
+                f["solution/iterations"][:])
+
+
+def test_cli_frame_read_transient_recovers(world, monkeypatch):
+    """SITE hdf5.frame_read, recover leg: one torn read is retried; the
+    output equals a clean run's, exit 0."""
+    paths, *_ = world
+    assert run_cli(paths, "--max_cached_frames", "1") == 0
+    clean = _read_out(paths)
+    faults.inject(faults.SITE_FRAME_READ, "io", count=1)
+    assert run_cli(paths, "--max_cached_frames", "1") == 0
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    np.testing.assert_array_equal(got[1], clean[1])
+    assert retry_stats()["prefetch.next"]["recoveries"] == 1
+
+
+def test_cli_frame_read_persistent_isolated(world, capsys):
+    """SITE hdf5.frame_read, degrade leg: retries exhausted on one frame →
+    FAILED status row, zeros, iterations -1; the other frames solve; exit
+    2; summary printed."""
+    paths, *_ = world
+    faults.inject(faults.SITE_FRAME_READ, "io", count=3)  # = retry budget
+    rc = run_cli(paths, "--max_cached_frames", "1")
+    assert rc == EXIT_PARTIAL
+    value, status, iters = _read_out(paths)
+    assert status.shape[0] == 4
+    assert list(status) == [FRAME_FAILED, 0, 0, 0]
+    assert iters[0] == -1
+    np.testing.assert_array_equal(value[0], 0.0)
+    assert (value[1:] > 0).any()
+    err = capsys.readouterr()
+    assert "FAILED" in err.err
+    assert "resilience summary" in err.out
+    assert "1 failed" in err.out
+
+
+def test_cli_frame_read_nan_diverges(world):
+    """SITE hdf5.frame_read, corruption leg: a NaN-poisoned frame becomes
+    a DIVERGED row under --divergence_recovery; the run continues, exit
+    2."""
+    paths, *_ = world
+    faults.inject(faults.SITE_FRAME_READ, "nan", count=1)
+    rc = run_cli(paths, "--max_cached_frames", "1",
+                 "--divergence_recovery", "2")
+    assert rc == EXIT_PARTIAL
+    value, status, iters = _read_out(paths)
+    assert list(status) == [DIVERGED, 0, 0, 0]
+    np.testing.assert_array_equal(value[0], 0.0)
+
+
+def test_cli_solve_fault_fails_group_and_continues(world):
+    """SITE solve.dispatch: a dispatch fault fails exactly its chain group
+    (FAILED rows, order preserved), later groups solve, exit 2."""
+    paths, *_ = world
+    faults.inject(faults.SITE_SOLVE, "error", count=1)
+    rc = run_cli(paths, "--chain_frames", "2")
+    assert rc == EXIT_PARTIAL
+    value, status, iters = _read_out(paths)
+    assert list(status) == [FRAME_FAILED, FRAME_FAILED, 0, 0]
+    assert (value[2:] > 0).any()
+
+
+def test_cli_solve_fault_serial_single_frame(world):
+    """SITE solve.dispatch, serial loop: exactly one frame fails; the
+    next frame's warm start falls back to the last good one."""
+    paths, *_ = world
+    faults.inject(faults.SITE_SOLVE, "error", count=1)
+    rc = run_cli(paths, "--chain_frames", "1")
+    assert rc == EXIT_PARTIAL
+    _, status, _ = _read_out(paths)
+    assert list(status) == [FRAME_FAILED, 0, 0, 0]
+
+
+def test_cli_device_put_fault_isolated(world):
+    """SITE device.put: a staging fault is absorbed like a solve fault."""
+    paths, *_ = world
+    faults.inject(faults.SITE_DEVICE_PUT, "io", count=1)
+    rc = run_cli(paths, "--chain_frames", "2")
+    assert rc == EXIT_PARTIAL
+    _, status, _ = _read_out(paths)
+    assert sorted(status)[:2] == [FRAME_FAILED, FRAME_FAILED]
+    assert (status == 0).sum() == 2
+
+
+def test_cli_fail_fast_disables_isolation(world):
+    """--fail_fast: the first exhausted frame aborts the run with the
+    infrastructure exit code (the reference's die-on-fault behavior,
+    minus the retries)."""
+    paths, *_ = world
+    faults.inject(faults.SITE_FRAME_READ, "io", count=3)
+    rc = run_cli(paths, "--max_cached_frames", "1", "--fail_fast")
+    assert rc == EXIT_INFRASTRUCTURE
+
+
+def test_cli_flush_fault_aborts_resumable(world, capsys):
+    """SITE io.flush, degrade leg: a flush failure aborts with the
+    infrastructure exit code and the file resumes to a clean run's
+    output."""
+    paths, *_ = world
+    ref = paths["output"] + ".ref.h5"
+    assert run_cli({**paths, "output": ref}) == 0
+    with h5py.File(ref, "r") as f:
+        want = f["solution/value"][:]
+
+    faults.inject(faults.SITE_FLUSH, "io", count=1)
+    rc = run_cli(paths, "--max_cached_solutions", "1")
+    assert rc == EXIT_INFRASTRUCTURE
+    assert "resumable" in capsys.readouterr().err
+    assert run_cli(paths, "--resume") == 0
+    value, status, _ = _read_out(paths)
+    assert status.shape[0] == 4 and (status == 0).all()
+    np.testing.assert_allclose(value, want, rtol=1e-10, atol=1e-13)
+
+
+def test_cli_rtm_ingest_transient_recovers(world):
+    """SITE hdf5.rtm_ingest, recover leg: a torn stripe read is retried;
+    byte-identical output, exit 0."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    clean = _read_out(paths)
+    faults.inject(faults.SITE_RTM_INGEST, "io", count=1)
+    assert run_cli(paths) == 0
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    assert retry_stats()["hdf5.rtm_ingest"]["recoveries"] == 1
+
+
+def test_cli_rtm_ingest_exhausted_aborts(world, capsys):
+    """SITE hdf5.rtm_ingest, degrade leg: no matrix, no run —
+    infrastructure exit after the retry budget."""
+    paths, *_ = world
+    faults.inject(faults.SITE_RTM_INGEST, "io", count=100)
+    rc = run_cli(paths)
+    assert rc == EXIT_INFRASTRUCTURE
+    assert "Unrecoverable after retries" in capsys.readouterr().err
+
+
+def test_cli_multihost_init_transient_recovers(world):
+    """SITE multihost.init, recover leg: the coordinator answers on the
+    second attempt (single-process degenerate multihost run)."""
+    paths, *_ = world
+    faults.inject(faults.SITE_MULTIHOST_INIT, "error", count=1)
+    assert run_cli(paths, "--multihost") == 0
+    assert retry_stats()["multihost.init"]["recoveries"] == 1
+
+
+def test_cli_multihost_init_exhausted_aborts(world, capsys):
+    """SITE multihost.init, degrade leg: the coordinator never comes up."""
+    paths, *_ = world
+    faults.inject(faults.SITE_MULTIHOST_INIT, "error", count=100)
+    rc = run_cli(paths, "--multihost")
+    assert rc == EXIT_INFRASTRUCTURE
+    assert "Unrecoverable after retries" in capsys.readouterr().err
+
+
+def test_cli_divergence_recovery_flag_validation(world, capsys):
+    paths, *_ = world
+    with pytest.raises(SystemExit):
+        run_cli(paths, "--divergence_recovery", "-1")
+    capsys.readouterr()
+    with pytest.raises(SystemExit):
+        run_cli(paths, "--divergence_recovery", "2", "-L",
+                "--fused_sweep", "on")
+    assert "divergence_recovery" in capsys.readouterr().err
+
+
+def test_cli_divergence_recovery_healthy_run_identical(world):
+    """The guard threaded through the CLI changes nothing on a healthy
+    run (the per-frame where-selects are exact identities)."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    clean = _read_out(paths)
+    assert run_cli(paths, "--divergence_recovery", "3") == 0
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    np.testing.assert_array_equal(got[1], clean[1])
+    np.testing.assert_array_equal(got[2], clean[2])
+
+
+def test_cli_flag_parse_error_exits_1_not_2(world, capsys):
+    """argparse's native exit code for bad flags is 2, which would collide
+    with EXIT_PARTIAL in the documented contract; the CLI remaps it."""
+    paths, *_ = world
+    with pytest.raises(SystemExit) as exc:
+        run_cli(paths, "--no_such_flag")
+    assert exc.value.code == 1
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as exc:
+        run_cli(paths, "--batch_frames", "notanumber")
+    assert exc.value.code == 1
+    capsys.readouterr()
+    with pytest.raises(SystemExit) as exc:
+        main(["--help"])
+    assert exc.value.code == 0  # --help stays 0
+    capsys.readouterr()
+
+
+def test_cli_failed_frames_not_retried_on_resume(world):
+    """Documented FAILED-row semantics: --resume treats a FAILED row as
+    written (rows are append-only; rerun without --resume to retry)."""
+    paths, *_ = world
+    faults.inject(faults.SITE_FRAME_READ, "io", count=3)
+    assert run_cli(paths, "--max_cached_frames", "1") == EXIT_PARTIAL
+    assert run_cli(paths, "--resume") == 0  # nothing left to do
+    _, status, _ = _read_out(paths)
+    assert list(status) == [FRAME_FAILED, 0, 0, 0]
