@@ -4,6 +4,10 @@
 #   make test        - tier-1 test suite (CPU backend, ROADMAP.md contract)
 #   make faults      - fault-injection matrix: per-site recover/degrade
 #                      proofs (docs/RESILIENCE.md; subset of tier-1)
+#   make drills      - availability drill matrix: SIGKILL + graceful-stop
+#                      (SIGTERM, exit 4) + hang-watchdog + OOM-degradation
+#                      end-to-end drills (docs/RESILIENCE.md §5-§7;
+#                      subset of tier-1)
 #   make verify      - lint, then tier-1 tests (the fail-fast CI path)
 #   make native-asan - rebuild the native helper with ASan+UBSan and run
 #                      its tests against it (skips cleanly with no g++)
@@ -14,7 +18,7 @@ PYTHON ?= python
 BUILD_DIR ?= .build
 ASAN_SO := $(BUILD_DIR)/libsartrt_asan.so
 
-.PHONY: lint test faults verify native-asan goldens
+.PHONY: lint test faults drills verify native-asan goldens
 
 lint:
 	JAX_PLATFORMS=cpu $(PYTHON) -m sartsolver_tpu.cli lint --self
@@ -31,6 +35,14 @@ test:
 faults:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_resilience.py -q \
 		-p no:cacheprovider
+
+# The availability drill matrix (docs/RESILIENCE.md §5-§7): real-process
+# SIGKILL + SIGTERM kill/stop/resume drills at deterministic flush-window
+# markers, plus the watchdog hang-escalation and OOM batch-halving drills.
+# Runs inside the tier-1 time budget; `make test` includes it.
+drills:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_killdrill.py \
+		tests/test_availability.py -q -p no:cacheprovider
 
 # New static-analysis violations fail before the (much slower) test run.
 verify: lint test

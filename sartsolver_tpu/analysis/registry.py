@@ -86,6 +86,7 @@ ENTRY_MODULES = (
     "sartsolver_tpu.models.sart",
     "sartsolver_tpu.ops.fused_sweep",
     "sartsolver_tpu.parallel.sharded",
+    "sartsolver_tpu.resilience.degrade",
 )
 
 

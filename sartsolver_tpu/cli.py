@@ -33,7 +33,10 @@ def build_parser() -> argparse.ArgumentParser:
                "--help` and docs/STATIC_ANALYSIS.md). "
                "exit codes: 0 success; 1 input/flag error; 2 run completed "
                "with FAILED/DIVERGED frames; 3 aborted on an unrecoverable "
-               "infrastructure failure after retries (file resumable) — "
+               "infrastructure failure after retries or a watchdog hard "
+               "abort (file resumable); 4 stopped gracefully on "
+               "SIGTERM/SIGINT after draining the in-flight frame group "
+               "(file resumable; second signal aborts immediately) — "
                "see docs/RESILIENCE.md.",
     )
     p.add_argument("-o", "--output_file", default="solution.h5",
@@ -149,7 +152,14 @@ def build_parser() -> argparse.ArgumentParser:
         "fault handling (docs/RESILIENCE.md): retry/backoff knobs are "
         "environment variables (SART_RETRY_ATTEMPTS/_BASE_DELAY/"
         "_MAX_DELAY/_DEADLINE); fault injection for testing via "
-        "SART_FAULT=site:kind:prob[:count].")
+        "SART_FAULT=site:kind:prob[:count]. Availability knobs: "
+        "SART_WATCHDOG_TIMEOUT seconds arms the hang watchdog "
+        "(stack dump + stuck-frame escalation; SART_WATCHDOG_GRACE "
+        "before the hard abort), SART_HEARTBEAT_FILE is touched on "
+        "every completed frame for external supervisors; SIGTERM/SIGINT "
+        "stop gracefully at a frame-group boundary (exit 4, resumable), "
+        "and RESOURCE_EXHAUSTED dispatch failures halve the frame-group "
+        "size before failing frames.")
     res.add_argument("--divergence_recovery", type=int, default=0,
                      help="In-solve divergence guard: a frame whose "
                           "residual metric goes non-finite or exploding "
@@ -272,17 +282,30 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     configure_compilation_cache()
 
+    from sartsolver_tpu.resilience import degrade, shutdown, watchdog
     from sartsolver_tpu.resilience.failures import (
-        EXIT_INFRASTRUCTURE, FRAME_FAILED, RECOVERABLE_FRAME_ERRORS,
-        FrameFailure, OutputWriteError, RunSummary, failed_row,
+        EXIT_INFRASTRUCTURE, EXIT_INTERRUPTED, FRAME_FAILED,
+        RECOVERABLE_FRAME_ERRORS, FrameFailure, OutputWriteError, RunSummary,
+        WatchdogTimeout, failed_row,
     )
     from sartsolver_tpu.resilience.retry import (
         RetriesExhausted, reset_retry_stats,
     )
+    # imported up here (not with the other writer imports inside the try):
+    # the except clause below must be able to name it even when the
+    # failure happens before the frame-loop imports ran
+    from sartsolver_tpu.utils.asyncwriter import DeferredWriteError
 
     # per-run accounting: the retry counters feed this run's end-of-run
     # summary, not a process-lifetime total
     reset_retry_stats()
+
+    # Graceful preemption (docs/RESILIENCE.md §5): SIGTERM/SIGINT sets a
+    # stop flag honored at frame-group boundaries (drain, flush, exit 4);
+    # a second signal aborts immediately. Installed before the (possibly
+    # long) ingest so a preemption during it is at least remembered —
+    # the first boundary check then stops the run before any solve.
+    shutdown.install()
 
     if args.multihost:
         from sartsolver_tpu.parallel import multihost as mh
@@ -294,6 +317,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # is infrastructure, not user input — distinct exit code so a
             # scheduler can tell "fix the flags" from "requeue the job"
             print(f"Unrecoverable after retries: {err}", file=sys.stderr)
+            shutdown.uninstall()
             return EXIT_INFRASTRUCTURE
 
     from sartsolver_tpu.config import (
@@ -309,6 +333,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
 
     from sartsolver_tpu.utils.timing import PhaseTimer
+
+    # Created before the ingest so availability events anywhere in the
+    # run (a watchdog fire during solver construction included) land in
+    # the end-of-run accounting.
+    summary = RunSummary()
+    # Hang watchdog (docs/RESILIENCE.md §6): armed by
+    # SART_WATCHDOG_TIMEOUT and scoped to the WHOLE expensive body —
+    # RTM ingest, solver construction (device staging beacons), frame
+    # loop and the writer drain on exit — a hang anywhere must escalate
+    # (FRAME_FAILED inside the frame loop, a resumable exit-3 abort
+    # elsewhere), never wedge. No-op when disabled.
+    wd = watchdog.Watchdog.from_env(on_event=summary.record_event)
+    if wd is not None:
+        wd.start()
 
     timer = PhaseTimer()
     _t = _time.perf_counter()
@@ -593,6 +631,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
 
+        # Each queued entry holds (or lazily fetches) one nvoxel fp64 row,
+        # so the queue depth bounds host memory the writer may hold behind
+        # a slow filesystem; SART_WRITER_QUEUE=1 makes the solve loop run
+        # lockstep with the writer (the SIGTERM drills use that to pin
+        # group-boundary stops deterministically).
+        import os as _os
+
+        writer_queue = max(1, int(_os.environ.get("SART_WRITER_QUEUE", "16")))
         writer_ctx = (
             # write off-thread so periodic HDF5 flushes never stall the
             # solve loop (read / solve / write pipeline)
@@ -601,7 +647,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 max_cache_size=args.max_cached_solutions,
                 # pass the already-read state so the file is inspected once
                 resume=resume_state if resume_state is not None else False,
-            ))
+            ), max_pending=writer_queue)
             if primary else _NullWriter()
         )
 
@@ -616,7 +662,22 @@ def main(argv: Optional[List[str]] = None) -> int:
         # active there — it runs inside the jitted program, identically on
         # every process).
         isolate = not (args.fail_fast or args.multihost)
-        summary = RunSummary()
+        stop_state = {"interrupted": False}
+
+        def stop_now() -> bool:
+            """Group-boundary stop poll. Multihost: a one-int host
+            allgather so every process stops at the SAME boundary
+            (the scheduler's signals land at different instants;
+            parallel/multihost.agree_stop)."""
+            local = shutdown.stop_requested()
+            if args.multihost:
+                return mh.agree_stop(local)
+            return local
+
+        def degrade_event(message: str) -> None:
+            summary.record_event(message)
+            if primary:
+                print(f"sartsolve: {message}", file=sys.stderr)
 
         with profiler_ctx, writer_ctx as writer, FramePrefetcher(
             composite_image, isolate_failures=isolate
@@ -630,6 +691,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 writer.add(failed_row(nvoxel), FRAME_FAILED, ftime,
                            cam_times, iterations=-1)
                 summary.record_status(FRAME_FAILED, ftime)
+                watchdog.beacon(watchdog.PHASE_FRAME_DONE)
                 if primary:
                     print(f"Frame at t={ftime}: FAILED "
                           f"({type(err).__name__}: {err})", file=sys.stderr)
@@ -659,10 +721,28 @@ def main(argv: Optional[List[str]] = None) -> int:
                 and k+1's host-side staging overlap k/k+1 device compute
                 instead of serializing with it.
 
+                Availability (docs/RESILIENCE.md §5/§7): K is only the
+                STARTING group size — a dispatch that dies with
+                RESOURCE_EXHAUSTED halves the size and re-solves the same
+                frames (degrade.GroupSizeLadder; the reduction sticks),
+                and a stop request (SIGTERM/SIGINT) is honored at group
+                boundaries: no new group is dispatched, the in-flight
+                group drains, undispatched frames are left for --resume.
+
                 The printed value is the group's incremental wall clock
                 over the pipeline divided by the group size — the honest
                 steady-state per-frame cost, not one frame's own time —
                 and each frame's exact iteration count."""
+                ladder = degrade.GroupSizeLadder(K, on_event=degrade_event)
+                # The halving ladder is a PER-PROCESS decision, so it must
+                # stay off in multihost runs: one process re-dispatching a
+                # half-sized collective program while its peers run the
+                # full size would deadlock the pod (the same reasoning
+                # that forces frame-level fail-fast there). A multihost
+                # OOM therefore aborts fail-fast like any other device
+                # error — requeue with --resume and a smaller
+                # --chain_frames/--batch_frames.
+                active_ladder = None if args.multihost else ladder
                 pending = []
                 prev = None  # (result, metas, t_dispatch) awaiting write
                 last_done = None
@@ -690,6 +770,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                    int(statuses[b]), ftime, cam_times,
                                    iterations=int(result.iterations[b]))
                         summary.record_status(int(statuses[b]), ftime)
+                        watchdog.beacon(watchdog.PHASE_FRAME_DONE)
                         if primary:
                             print(f"Processed in: {per_frame_ms} ms "
                                   f"(average over {label} of {len(metas)}; "
@@ -704,53 +785,85 @@ def main(argv: Optional[List[str]] = None) -> int:
                         to_write, prev = prev, None
                         write_group(*to_write)
 
-                def flush():
+                def flush(final=False):
+                    """Dispatch pending frames in ladder-sized groups.
+
+                    Mid-run this is called exactly when a full group
+                    accumulated; ``final`` additionally dispatches a
+                    partial tail (padded up to the group size). The
+                    while-loop re-reads ``ladder.size`` so an OOM halving
+                    re-solves the SAME frames at the reduced size."""
                     nonlocal prev
-                    stack = np.stack([fr for fr, _, _ in pending])
-                    if len(pending) < K:
-                        stack = np.concatenate(
-                            [stack, pad_tail(stack, K - len(pending))])
-                    t0 = _time.perf_counter()
-                    try:
-                        result = solve_group(stack)  # async dispatch
-                    except RECOVERABLE_FRAME_ERRORS as err:
-                        if not isolate:
-                            raise
-                        # the group produced nothing: its frames all fail,
-                        # in order, after the in-flight group's rows; the
-                        # warm carry skips the dead group (the previous
-                        # chain result is still the seed of the next)
-                        drain_inflight()
-                        for _, ftime, cam_times in pending:
-                            record_failed(ftime, cam_times, err)
-                        pending.clear()
-                        return
-                    # swap BEFORE writing: if write_group raises, `prev`
-                    # already holds the new unwritten group for the drain
-                    # below (never the just-written one — no double write)
-                    to_write, prev = prev, (result, list(pending), t0)
-                    pending.clear()
-                    if to_write is not None:
-                        write_group(*to_write)
+                    while pending and (final
+                                       or len(pending) >= ladder.size):
+                        group = pending[:ladder.size]
+                        stack = np.stack([fr for fr, _, _ in group])
+                        if len(group) < ladder.size:
+                            stack = np.concatenate(
+                                [stack,
+                                 pad_tail(stack, ladder.size - len(group))])
+                        t0 = _time.perf_counter()
+                        try:
+                            # availability-wrapped dispatch (the same
+                            # wrapper the guarded_dispatch compile-audit
+                            # entry lowers through): beacon + OOM
+                            # classification against the ladder
+                            result, _oom = degrade.dispatch_guarded(
+                                lambda: solve_group(stack),
+                                ladder=active_ladder,
+                            )
+                        except RECOVERABLE_FRAME_ERRORS as err:
+                            if not isolate:
+                                raise
+                            # the group produced nothing: its frames all
+                            # fail, in order, after the in-flight group's
+                            # rows; the warm carry skips the dead group
+                            # (the previous chain result is still the
+                            # seed of the next)
+                            drain_inflight()
+                            for _, ftime, cam_times in group:
+                                record_failed(ftime, cam_times, err)
+                            del pending[:len(group)]
+                            continue
+                        if result is None:
+                            # OOM halved the ladder: re-solve the SAME
+                            # frames at the smaller size (the warm carry
+                            # is intact — the failed dispatch never
+                            # updated it)
+                            continue
+                        # swap BEFORE writing: if write_group raises,
+                        # `prev` already holds the new unwritten group for
+                        # the drain below (never the just-written one —
+                        # no double write)
+                        to_write, prev = prev, (result, group, t0)
+                        del pending[:len(group)]
+                        if to_write is not None:
+                            write_group(*to_write)
 
                 try:
                     for item in frames:
+                        if not pending and stop_now():
+                            # frame-group boundary stop: no new group is
+                            # started; the in-flight group drains below
+                            # and the run exits EXIT_INTERRUPTED
+                            stop_state["interrupted"] = True
+                            break
                         if isinstance(item, FrameFailure):
                             # keep rows frame-ordered: dispatch what is
                             # pending, drain the in-flight group, then
                             # record the dead frame (a rare-path pipeline
                             # stall, only on actual failures)
                             if pending:
-                                flush()
+                                flush(final=True)
                             drain_inflight()
                             record_failed(item.time, item.camera_times,
                                           item.error)
                             continue
                         pending.append(item)
-                        if len(pending) == K:
+                        if len(pending) >= ladder.size:
                             flush()
-                    if pending:
-                        flush()
+                    if pending and not stop_state["interrupted"]:
+                        flush(final=True)
                 except BaseException as err:
                     # Best-effort drain of the in-flight group: a
                     # frame-read or solve error must not silently discard
@@ -759,9 +872,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # would punch a frame hole into the file — the
                     # non-contiguity that corrupts --resume) or a
                     # KeyboardInterrupt (the drain's blocking device fetch
-                    # would make Ctrl-C appear ignored on a wedged
-                    # backend); its own errors never mask the one already
-                    # propagating.
+                    # would make an abort appear ignored on a wedged
+                    # backend; with the CLI's shutdown handlers installed
+                    # the first Ctrl-C takes the graceful stop path
+                    # instead and the second dies by the signal, so this
+                    # branch guards library/embedded callers); its own
+                    # errors never mask the one already propagating.
                     if (prev is not None and write_ok
                             and not isinstance(err, KeyboardInterrupt)):
                         try:
@@ -772,6 +888,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 else:
                     if prev is not None:
                         write_group(*prev)  # normal path: errors propagate
+                finally:
+                    # consolidated degradation line in the run summary —
+                    # recorded on success AND aborts (a degraded run that
+                    # later dies must still show the reduced size)
+                    ladder_line = ladder.summary()
+                    if ladder_line:
+                        summary.record_event(ladder_line)
 
             if args.batch_frames > 1:
                 run_grouped(
@@ -815,7 +938,20 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f0_host: Optional[np.ndarray] = None  # host warm / resume seed
                 if resume_state is not None and not args.no_guess:
                     f0_host = resume_state.last_solution
-                for item in frames:
+                # Multihost stop polls are a host allgather; per-frame
+                # that round trip would rival the ~9 ms warm-frame solve
+                # itself, so poll every 4th frame there (the stride is
+                # identical on every process — the frame streams are —
+                # so the collective cadence stays agreed). Single-host
+                # polls are a local flag read: every frame.
+                stop_stride = 4 if args.multihost else 1
+                for idx, item in enumerate(frames):
+                    if idx % stop_stride == 0 and stop_now():
+                        # per-frame boundary stop (the serial loop's
+                        # group size is 1): already-written frames are
+                        # flushed on exit, the rest resume later
+                        stop_state["interrupted"] = True
+                        break
                     if isinstance(item, FrameFailure):
                         record_failed(item.time, item.camera_times,
                                       item.error)
@@ -844,6 +980,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                ftime, cam_times,
                                iterations=int(dres.iterations[0]))
                     summary.record_status(status, ftime)
+                    watchdog.beacon(watchdog.PHASE_FRAME_DONE)
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
                     timer.add("solve frame", elapsed_ms / 1e3)
                     if primary:
@@ -853,6 +990,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         if primary:
             import h5py
 
+            # fresh beacon: the voxel-map write gets its own watchdog
+            # budget instead of inheriting whatever silence preceded it
+            watchdog.beacon(watchdog.PHASE_FLUSH)
             with h5py.File(args.output_file, "a") as f:
                 has_grid = "voxel_map" in f
             if not has_grid:  # resumed runs already wrote the grid
@@ -873,9 +1013,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         # FAILED/DIVERGED frames exits with the partial code so a
         # scheduler can see "completed, but look at the statuses" without
         # opening the file.
+        # Only a stop that actually truncated the run (a boundary check
+        # broke out of the frame loop) exits 4. A signal that lands after
+        # the last boundary check can only mean every frame completed —
+        # reporting THAT as "interrupted, requeue me" would make a
+        # scheduler re-run a finished job (and mask EXIT_PARTIAL).
+        interrupted = stop_state["interrupted"]
         if primary and (summary.n_failed or summary.had_retries()
-                        or args.timing):
+                        or summary.events or interrupted or args.timing):
             print(summary.format())
+        if interrupted:
+            # graceful preemption stop (docs/RESILIENCE.md §5): the
+            # in-flight group drained, the writer flushed, the voxel map
+            # is in place — the file is a consistent prefix of the run
+            if primary:
+                sig = shutdown.stop_signal() or "a stop request"
+                print(
+                    f"Interrupted by {sig}: {summary.n_frames} frame(s) "
+                    "written; the output file is resumable (--resume).",
+                    file=sys.stderr,
+                )
+            return EXIT_INTERRUPTED
         if summary.n_failed:
             return summary.exit_code()
     except RetriesExhausted as err:
@@ -883,11 +1041,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         # frame read) failed permanently: infrastructure, not input
         print(f"Unrecoverable after retries: {err}", file=sys.stderr)
         return EXIT_INFRASTRUCTURE
+    except WatchdogTimeout as err:
+        # the hang watchdog interrupted a stall that per-frame isolation
+        # could not absorb (--fail_fast, multihost, or a stall outside
+        # the frame scope): the process is saved, the run is not —
+        # infrastructure exit, file resumable
+        print(f"Aborted by the hang watchdog: {err}", file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
     except OutputWriteError as err:
         # a solution-file flush failed mid-run; the file is resumable up
         # to its last committed flush
         print(err, file=sys.stderr)
         return EXIT_INFRASTRUCTURE
+    except DeferredWriteError as err:
+        # the async writer latched an infrastructure-class failure (a
+        # wedged lazy device fetch interrupted by the watchdog, an
+        # I/O error outside the flush path); an internal bug as the
+        # cause still tracebacks loudly
+        if isinstance(err.__cause__, RECOVERABLE_FRAME_ERRORS):
+            print(f"Asynchronous writer failed: {err}", file=sys.stderr)
+            return EXIT_INFRASTRUCTURE
+        raise
     except KeyError as err:
         # h5py raises KeyError for missing datasets/attributes in otherwise
         # openable files; surface it as the fail-fast message + exit 1 the
@@ -900,6 +1074,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # tracebacks loudly instead of being swallowed.
         print(err, file=sys.stderr)
         return 1
+    finally:
+        if wd is not None:
+            wd.stop()
+        shutdown.uninstall()
 
     return 0
 

@@ -157,9 +157,12 @@ class SolutionWriter:
         behind — datasets longer than the completed-frame count — is
         truncated immediately, so appends continue from a consistent
         state."""
-        if nvoxel == 0:
+        # <= 0, not == 0: a negative nvoxel would propagate into dataset
+        # shapes and a negative cache size would mean "flush never" —
+        # both previously slipped through the equality check
+        if nvoxel <= 0:
             raise ValueError("Argument nvoxel must be positive.")
-        if max_cache_size == 0:
+        if max_cache_size <= 0:
             raise ValueError("Attribute max_cache_size must be positive.")
         self.filename = filename
         self.nvox = nvoxel
@@ -214,9 +217,10 @@ class SolutionWriter:
         """
         if not self._solutions:
             return
-        from sartsolver_tpu.resilience import faults
+        from sartsolver_tpu.resilience import faults, watchdog
         from sartsolver_tpu.resilience.failures import OutputWriteError
 
+        watchdog.beacon(watchdog.PHASE_FLUSH)
         try:
             faults.fire(faults.SITE_FLUSH)
             if self.first_flush:

@@ -1130,6 +1130,12 @@ def solve(
 ) -> SolveResult:
     """Single-device solve on a full (unsharded) problem. The sharded
     equivalent lives in ``sartsolver_tpu.parallel.sharded``."""
+    from sartsolver_tpu.resilience import watchdog
+
+    # host-side progress beacon (docs/RESILIENCE.md §6): library users
+    # running under a watchdog get hang detection on this entry too; the
+    # beacon never enters the trace, so compiled programs are unchanged
+    watchdog.beacon(watchdog.PHASE_DISPATCH)
     dtype = jnp.dtype(opts.dtype)
     g64, msq, norm = prepare_measurement(measurement, opts)
 

@@ -217,10 +217,14 @@ def _read_stripe_retried(
     run cannot continue without its matrix, and the CLI maps that to the
     infrastructure exit code.
     """
-    from sartsolver_tpu.resilience import faults
+    from sartsolver_tpu.resilience import faults, watchdog
     from sartsolver_tpu.resilience.retry import retry_call
 
     def attempt() -> np.ndarray:
+        # per-chunk progress beacon: the ingest of a tens-of-GB matrix is
+        # legitimately long, so the watchdog tracks chunk turnover, not
+        # the whole phase (docs/RESILIENCE.md §6)
+        watchdog.beacon(watchdog.PHASE_PREFETCH)
         faults.fire(faults.SITE_RTM_INGEST)
         return read_rtm_block(
             sorted_matrix_files, rtm_name, n, nvoxel, r0,
@@ -550,6 +554,28 @@ def broadcast_resume_state(state, nvoxel: int, error: Optional[str] = None):
         last = state.last_solution if primary else np.zeros(nvoxel, np.float64)
         last = bcast_f64_exact(last)
     return ResumeState(times, last)
+
+
+def agree_stop(local_stop: bool) -> bool:
+    """Unanimous-boundary stop agreement for graceful preemption.
+
+    A scheduler preempting a pod slice SIGTERMs every process, but the
+    signals land at slightly different instants; if each process honored
+    only its *own* flag it could stop one frame group before or after its
+    peers, leaving the others wedged inside a collective
+    (resilience/shutdown.py). The CLI therefore polls this at every
+    group boundary: a one-int host allgather (main thread, same cadence
+    on every process — the frame streams are identical by construction),
+    any process's flag stops them all at the SAME boundary. Single
+    process: the local flag, no collective."""
+    if jax.process_count() == 1:
+        return bool(local_stop)
+    from jax.experimental import multihost_utils as mhu
+
+    flags = np.asarray(mhu.process_allgather(
+        np.asarray([1 if local_stop else 0], np.int32)
+    ))
+    return bool(flags.any())
 
 
 def make_global(host_value: np.ndarray, mesh, spec: P) -> jax.Array:

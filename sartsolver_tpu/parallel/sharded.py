@@ -67,8 +67,9 @@ def _stage(host_array, mesh, spec) -> jax.Array:
     staging failure (device OOM, a preempted/hung device runtime) is a
     per-solve-call hazard the CLI's frame isolation absorbs into FAILED
     frames."""
-    from sartsolver_tpu.resilience import faults
+    from sartsolver_tpu.resilience import faults, watchdog
 
+    watchdog.beacon(watchdog.PHASE_STAGE)  # staging-phase progress beacon
     faults.fire(faults.SITE_DEVICE_PUT)
     if jax.process_count() == 1:
         return jax.device_put(host_array, NamedSharding(mesh, spec))
@@ -139,6 +140,11 @@ class DeviceSolveResult:
         as fp32 exactly: status (0/-1) and iterations (<= 2000) are small
         integers; convergence was computed in the device dtype."""
         if self._scalars is None:
+            from sartsolver_tpu.resilience import watchdog
+
+            # result-fetch beacon: this D2H blocks until the device work
+            # completed — the watchdog's canary for a wedged runtime
+            watchdog.beacon(watchdog.PHASE_FETCH)
             packed = np.asarray(self._packed)
             self._scalars = (
                 packed[0].astype(np.int32),
@@ -165,6 +171,9 @@ class DeviceSolveResult:
         synchronous path (and the reference's D2H-then-multiply,
         sartsolver_cuda.cpp:264-265)."""
         if self._host is None:
+            from sartsolver_tpu.resilience import watchdog
+
+            watchdog.beacon(watchdog.PHASE_FETCH)
             sol = np.asarray(self._solution_fetch).astype(np.float64)
             self._host = (
                 sol[:, : self._solver.nvoxel] * self.norms[:, None]
@@ -801,8 +810,9 @@ class DistributedSARTSolver:
         per-frame setup forward projection — one full RTM read saved per
         warm frame (models/sart fitted0 docs).
         """
-        from sartsolver_tpu.resilience import faults
+        from sartsolver_tpu.resilience import faults, watchdog
 
+        watchdog.beacon(watchdog.PHASE_DISPATCH)
         faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
@@ -884,8 +894,9 @@ class DistributedSARTSolver:
         no-op up to one ulp of the compute dtype, and a warm start is only
         an initial guess).
         """
-        from sartsolver_tpu.resilience import faults
+        from sartsolver_tpu.resilience import faults, watchdog
 
+        watchdog.beacon(watchdog.PHASE_DISPATCH)
         faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)

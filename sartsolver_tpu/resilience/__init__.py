@@ -21,15 +21,40 @@ stack by the module that owns the hazard:
   classes the CLI's per-frame isolation may absorb, process exit codes,
   and the end-of-run :class:`~sartsolver_tpu.resilience.failures.RunSummary`.
 
-The in-solve divergence guard (rollback to the last good iterate +
-relaxation halving, ``SolverOptions.divergence_recovery``) lives in
-``models/sart.py`` — it runs inside the jitted while_loop, not on the
-host.
+The *availability* layer (PR 3) adds the three pressures that dominate
+fleet operation on a shared accelerator pool:
+
+- :mod:`~sartsolver_tpu.resilience.shutdown` — graceful preemption:
+  SIGTERM/SIGINT sets a stop flag the frame loop honors at group
+  boundaries (drain, flush, ``EXIT_INTERRUPTED = 4``, resumable file);
+  a second signal aborts immediately.
+- :mod:`~sartsolver_tpu.resilience.watchdog` — hang watchdog: per-phase
+  progress beacons feed a monitor thread that, after
+  ``SART_WATCHDOG_TIMEOUT`` seconds of silence, dumps all thread stacks
+  and escalates the stuck frame into the FRAME_FAILED /
+  EXIT_INFRASTRUCTURE taxonomy (never a deadlocked process); optional
+  ``SART_HEARTBEAT_FILE`` touched per frame for external supervisors.
+- :mod:`~sartsolver_tpu.resilience.degrade` — adaptive OOM degradation:
+  a ``RESOURCE_EXHAUSTED`` dispatch failure halves the frame-group size
+  and re-solves the same frames (sticking for the rest of the run)
+  before falling back to per-frame isolation.
+
+All three are host-side only: with the layer disabled the traced
+programs are byte-identical (the ``guarded_dispatch`` compile-audit
+golden pins this). The in-solve divergence guard (rollback to the last
+good iterate + relaxation halving,
+``SolverOptions.divergence_recovery``) lives in ``models/sart.py`` — it
+runs inside the jitted while_loop, not on the host.
 """
 
+from sartsolver_tpu.resilience.degrade import (  # noqa: F401
+    GroupSizeLadder,
+    is_resource_exhausted,
+)
 from sartsolver_tpu.resilience.failures import (  # noqa: F401
     EXIT_INFRASTRUCTURE,
     EXIT_INPUT_ERROR,
+    EXIT_INTERRUPTED,
     EXIT_OK,
     EXIT_PARTIAL,
     FRAME_FAILED,
@@ -37,11 +62,13 @@ from sartsolver_tpu.resilience.failures import (  # noqa: F401
     FrameFailure,
     OutputWriteError,
     RunSummary,
+    WatchdogTimeout,
 )
 from sartsolver_tpu.resilience.faults import (  # noqa: F401
     FAULT_SITES,
     InjectedFault,
     InjectedIOError,
+    InjectedOOM,
     clear_faults,
     corrupt,
     fire,

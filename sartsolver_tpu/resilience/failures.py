@@ -26,7 +26,12 @@ Process exit codes (the CLI contract):
   DIVERGED/FRAME_FAILED; the output file holds every frame's row.
 - ``3`` EXIT_INFRASTRUCTURE — the run ABORTED on an unrecoverable
   infrastructure failure after retries (RTM ingest, output flush,
-  multihost init); the output file is resumable.
+  multihost init) or a watchdog hard abort; the output file is resumable.
+- ``4`` EXIT_INTERRUPTED — the run STOPPED GRACEFULLY on SIGTERM/SIGINT
+  (resilience/shutdown.py): the in-flight frame group was drained, the
+  async writer flushed, and the output file is resumable; frames not yet
+  dispatched were not solved. A second signal aborts immediately (death
+  by the signal, conventional 128+N status).
 """
 
 from __future__ import annotations
@@ -45,12 +50,23 @@ EXIT_OK = 0
 EXIT_INPUT_ERROR = 1
 EXIT_PARTIAL = 2
 EXIT_INFRASTRUCTURE = 3
+EXIT_INTERRUPTED = 4
 
 
 class OutputWriteError(RuntimeError):
     """A solution-file flush failed mid-run. Distinct from ``OSError`` so
     the CLI maps it to EXIT_INFRASTRUCTURE (the file is resumable), not
     the polite input-error exit."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """Raised *into* a stuck thread by the hang watchdog
+    (resilience/watchdog.py) after the progress beacons stalled past
+    ``SART_WATCHDOG_TIMEOUT``. Defined here (not in watchdog.py) so the
+    taxonomy module owns every member of RECOVERABLE_FRAME_ERRORS without
+    an import cycle: a frame whose staging/dispatch was interrupted is
+    escalated into the same FRAME_FAILED path as the injected
+    ``device.put``/``solve.dispatch`` faults it stands in for."""
 
 
 class FrameFailure(NamedTuple):
@@ -87,8 +103,9 @@ except ImportError:  # pragma: no cover - jax is a hard dep in practice
 
 RECOVERABLE_FRAME_ERRORS = (
     OSError,  # includes InjectedIOError and real I/O errors
-    InjectedFault,
+    InjectedFault,  # includes InjectedOOM (the injected RESOURCE_EXHAUSTED)
     RetriesExhausted,
+    WatchdogTimeout,  # a hung frame interrupted by the watchdog
 ) + _DEVICE_ERRORS
 
 
@@ -108,12 +125,23 @@ class RunSummary:
         self.counts = {SUCCESS: 0, MAX_ITERATIONS_EXCEEDED: 0,
                        DIVERGED: 0, FRAME_FAILED: 0}
         self.failed_times: List[float] = []
+        # availability events (watchdog fires, OOM degradations, stop
+        # requests): free-form one-liners appended by their owners and
+        # echoed verbatim in format() — anything that degraded or
+        # recovered must be visible in the end-of-run accounting
+        self.events: List[str] = []
 
     def record_status(self, status: int, time: Optional[float] = None) -> None:
         status = int(status)
         self.counts[status] = self.counts.get(status, 0) + 1
         if status in (DIVERGED, FRAME_FAILED) and time is not None:
             self.failed_times.append(float(time))
+
+    def record_event(self, event: str) -> None:
+        """Note an availability event (thread-safe under the GIL: the
+        watchdog monitor thread appends concurrently with the frame
+        loop)."""
+        self.events.append(str(event))
 
     @property
     def n_frames(self) -> int:
@@ -154,6 +182,8 @@ class RunSummary:
                     f"  retries at {site}: {v['attempts']} attempt(s), "
                     f"{v['recoveries']} recovered, {v['exhausted']} exhausted"
                 )
+        for event in self.events:
+            lines.append(f"  {event}")
         return "\n".join(lines)
 
 

@@ -29,6 +29,17 @@ Kinds:
 - ``nan`` — sites that pass data through :func:`corrupt` get the array
   NaN-poisoned, modeling bad sensor frames / bit flips; exception sites
   ignore this kind.
+- ``oom`` — the site raises :class:`InjectedOOM` (an
+  :class:`InjectedFault` whose message carries the runtime's
+  ``RESOURCE_EXHAUSTED`` marker), modeling a device out-of-memory on
+  dispatch; drives the adaptive batch-halving ladder
+  (``resilience/degrade.py``).
+- ``hang`` — the site blocks in a cooperative sleep loop (modeling a
+  wedged device runtime / stalled filesystem) until the hang watchdog
+  (``resilience/watchdog.py``) interrupts it with an async
+  ``WatchdogTimeout``, or until ``SART_HANG_RELEASE`` seconds (default
+  300) elapse — the release valve keeps an unwatched test from
+  deadlocking; it then raises :class:`InjectedFault`.
 """
 
 from __future__ import annotations
@@ -36,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 import zlib
 from typing import Dict, Optional
 
@@ -64,7 +76,7 @@ FAULT_SITES = frozenset({
     SITE_SOLVE, SITE_FLUSH, SITE_MULTIHOST_INIT,
 })
 
-FAULT_KINDS = ("io", "error", "nan")
+FAULT_KINDS = ("io", "error", "nan", "hang", "oom")
 
 
 class InjectedIOError(OSError):
@@ -73,6 +85,15 @@ class InjectedIOError(OSError):
 
 class InjectedFault(RuntimeError):
     """An injected non-I/O fault (kind ``error``)."""
+
+
+class InjectedOOM(InjectedFault):
+    """An injected device out-of-memory (kind ``oom``). Subclasses
+    :class:`InjectedFault` so per-frame isolation absorbs it once the
+    degradation ladder is exhausted; the message carries the runtime's
+    ``RESOURCE_EXHAUSTED`` marker so
+    :func:`sartsolver_tpu.resilience.degrade.is_resource_exhausted`
+    matches it and a real XLA OOM identically."""
 
 
 @dataclasses.dataclass
@@ -195,6 +216,22 @@ class injected:
         _active().pop(self._args[0], None)
 
 
+def _hang(site: str, trip: int) -> None:
+    """Block cooperatively: small sleeps so the watchdog's async
+    ``WatchdogTimeout`` (PyThreadState_SetAsyncExc delivers between
+    bytecodes, i.e. each time a sleep returns) interrupts promptly.
+    ``SART_HANG_RELEASE`` bounds the hang so a drill whose watchdog is
+    misconfigured fails loudly instead of deadlocking the test run."""
+    release = float(os.environ.get("SART_HANG_RELEASE", "300"))
+    deadline = time.monotonic() + release
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+    raise InjectedFault(
+        f"injected hang at {site} (trip {trip}) released after {release}s "
+        "(SART_HANG_RELEASE) without a watchdog interrupt"
+    )
+
+
 def fire(site: str) -> None:
     """Raise the armed exception fault for ``site``, if it trips.
 
@@ -209,6 +246,14 @@ def fire(site: str) -> None:
             raise InjectedIOError(
                 f"injected I/O fault at {site} (trip {fault.trips})"
             )
+        if fault.kind == "oom":
+            raise InjectedOOM(
+                f"injected RESOURCE_EXHAUSTED at {site} "
+                f"(trip {fault.trips}): out of memory while trying to "
+                "allocate the dispatch buffers"
+            )
+        if fault.kind == "hang":
+            _hang(site, fault.trips)
         raise InjectedFault(
             f"injected fault at {site} (trip {fault.trips})"
         )

@@ -12,8 +12,15 @@ Ordering, flush cadence and crash semantics are the wrapped writer's: only
 the worker thread touches the HDF5 file (h5py requires single-thread file
 access), frames are written in submission order, and an interrupted run
 still keeps every flushed cache window (``--resume`` picks up from there).
-A write error is re-raised on the next ``add`` or on ``close`` — fail-fast,
-one frame later than the synchronous writer.
+A write error is latched and surfaced on the next ``add`` or on ``close``
+— fail-fast, one frame later than the synchronous writer. The latched
+error is re-raised through a *chained wrapper* (:class:`DeferredWriteError`,
+or an :class:`~sartsolver_tpu.resilience.failures.OutputWriteError` wrapper
+when that is the cause, preserving the CLI's exit-code mapping): re-raising
+the same exception object from several call sites would stack a new
+traceback segment onto it at every raise, burying the original failure
+point; the wrapper keeps the worker-side traceback pristine as
+``__cause__`` while each surfacing site raises a fresh object.
 """
 
 from __future__ import annotations
@@ -23,6 +30,16 @@ import threading
 from typing import Optional, Sequence
 
 import numpy as np
+
+from sartsolver_tpu.resilience.failures import OutputWriteError
+
+
+class DeferredWriteError(RuntimeError):
+    """An asynchronous write failed earlier; ``__cause__`` is the original
+    worker-side exception with its traceback intact. (A latched
+    ``OutputWriteError`` cause is re-wrapped as ``OutputWriteError``
+    instead, so the CLI's infrastructure exit-code mapping is unchanged by
+    the async indirection.)"""
 
 
 class AsyncSolutionWriter:
@@ -37,11 +54,26 @@ class AsyncSolutionWriter:
         self._error: Optional[BaseException] = None
         self._closed = False
         self._thread = threading.Thread(target=self._worker, daemon=True)
+        # a lazy device fetch or flush that hangs on this thread may be
+        # async-interrupted by the watchdog; the worker latches the
+        # WatchdogTimeout like any write error and keeps draining
+        from sartsolver_tpu.resilience import watchdog
+
+        watchdog.register_interruptible(self._thread)
         self._thread.start()
 
     def _worker(self) -> None:
         while True:
-            item = self._queue.get()
+            try:
+                item = self._queue.get()
+            except BaseException as err:
+                # the watchdog's stage-2 sweep can async-interrupt this
+                # thread while it idles in get(); dying here would strand
+                # the queue (close() could never hand over the sentinel) —
+                # latch like any write error and keep draining
+                if self._error is None:
+                    self._error = err
+                continue
             if item is None:
                 return
             if self._error is not None:
@@ -62,8 +94,17 @@ class AsyncSolutionWriter:
         # ever written (a cleared latch would let frames still queued at
         # clearance time be written while drained ones were dropped —
         # non-contiguous output that corrupts a subsequent --resume).
-        if self._error is not None:
-            raise self._error
+        # Raise a FRESH chained wrapper per call: re-raising the latched
+        # object itself would mutate its traceback on every add()/close(),
+        # stacking surfacing-site frames over the original failure point.
+        err = self._error
+        if err is None:
+            return
+        msg = (f"asynchronous write failed earlier: "
+               f"{type(err).__name__}: {err}")
+        if isinstance(err, OutputWriteError):
+            raise OutputWriteError(msg) from err
+        raise DeferredWriteError(msg) from err
 
     def add(
         self,
@@ -106,11 +147,14 @@ class AsyncSolutionWriter:
         if exc and exc[0] is not None:
             self._closed = True
             if issubclass(exc[0], KeyboardInterrupt):
-                # user wants OUT: drop queued frames instead of running
+                # caller wants OUT: drop queued frames instead of running
                 # their lazy device fetches against a possibly wedged
                 # backend (--resume recomputes them); only the in-flight
                 # write finishes (the worker must be done before any
-                # other thread may touch the HDF5 file)
+                # other thread may touch the HDF5 file). The CLI's
+                # shutdown handlers turn the first Ctrl-C into a graceful
+                # drain and the second into death-by-signal, so this
+                # branch serves library/embedded callers.
                 try:
                     while True:
                         self._queue.get_nowait()

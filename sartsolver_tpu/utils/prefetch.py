@@ -21,6 +21,13 @@ run. When retries are exhausted the behavior forks on
   per-camera times come from the in-memory alignment tables, no I/O — and
   the stream continues with the next frame, so one dead frame costs one
   FAILED row instead of the run.
+
+Availability (docs/RESILIENCE.md §6): each read announces itself with a
+``prefetch`` progress beacon, and the worker registers as interruptible
+with the hang watchdog — a read that *hangs* (vs. fails) is asynchronously
+interrupted with ``WatchdogTimeout`` after ``SART_WATCHDOG_TIMEOUT``
+seconds, and escalates exactly like an exhausted retry: a FrameFailure
+item under isolation, a raised error otherwise.
 """
 
 from __future__ import annotations
@@ -32,8 +39,8 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from sartsolver_tpu.io.image import CompositeImage
-from sartsolver_tpu.resilience import faults
-from sartsolver_tpu.resilience.failures import FrameFailure
+from sartsolver_tpu.resilience import faults, watchdog
+from sartsolver_tpu.resilience.failures import FrameFailure, WatchdogTimeout
 from sartsolver_tpu.resilience.retry import (
     RetriesExhausted,
     RetryPolicy,
@@ -72,6 +79,9 @@ class FramePrefetcher:
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
+        # the watchdog may async-interrupt a hung read on this thread;
+        # registered before start so no beacon can outrun the registration
+        watchdog.register_interruptible(self._thread)
         self._thread.start()
 
     def _put(self, item) -> bool:
@@ -105,14 +115,17 @@ class FramePrefetcher:
             for i in range(len(self._composite)):
                 if self._stop.is_set():
                     return
+                watchdog.beacon(watchdog.PHASE_PREFETCH)
                 try:
                     item = self._read_frame(i)
-                except RetriesExhausted as err:
-                    if not self._isolate:
-                        raise
-                    # the frame is unreadable but its composite time is
+                except (RetriesExhausted, WatchdogTimeout) as err:
+                    # RetriesExhausted: the frame is unreadable;
+                    # WatchdogTimeout: the read HUNG and the watchdog
+                    # interrupted it. Either way the composite time is
                     # host memory: emit a typed failure so the consumer
                     # records a FAILED row and the stream survives
+                    if not self._isolate:
+                        raise
                     item = FrameFailure(
                         None, self._composite.frame_time(i),
                         self._composite.camera_frame_time(i), err,
@@ -133,6 +146,7 @@ class FramePrefetcher:
         except queue.Empty:
             pass
         self._thread.join(timeout=5)
+        watchdog.unregister_interruptible(self._thread)
 
     def __enter__(self) -> "FramePrefetcher":
         return self

@@ -1,0 +1,490 @@
+"""Availability layer: graceful shutdown, hang watchdog, OOM degradation
+(docs/RESILIENCE.md §5-§7).
+
+Deterministic CPU drills for the three pressures that dominate fleet
+operation — preemption (SIGTERM → stop flag → exit 4; the full
+subprocess drills live in tests/test_killdrill.py), silent hangs
+(injected ``hang`` faults interrupted by the watchdog's staged
+escalation) and device OOM on dispatch (injected ``oom`` faults driving
+the batch-halving ladder) — plus the unit semantics of each building
+block and the trace-identity proof (the ``guarded_dispatch`` compile
+golden equals ``sharded_batch``'s).
+
+``make drills`` runs this module together with the killdrill.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+from sartsolver_tpu.cli import main
+from sartsolver_tpu.resilience import degrade, faults, shutdown, watchdog
+from sartsolver_tpu.resilience.failures import (
+    EXIT_INFRASTRUCTURE,
+    EXIT_INTERRUPTED,
+    EXIT_PARTIAL,
+    FRAME_FAILED,
+    RECOVERABLE_FRAME_ERRORS,
+    WatchdogTimeout,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh faults/flags, fast retries, and a bounded hang release so a
+    drill whose watchdog misfires fails loudly instead of wedging the
+    suite."""
+    monkeypatch.setenv("SART_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("SART_RETRY_MAX_DELAY", "0.002")
+    monkeypatch.setenv("SART_HANG_RELEASE", "60")
+    monkeypatch.delenv("SART_WATCHDOG_TIMEOUT", raising=False)
+    monkeypatch.delenv("SART_HEARTBEAT_FILE", raising=False)
+    faults.clear_faults()
+    shutdown.reset()
+    yield
+    faults.clear_faults()
+    shutdown.reset()
+
+
+# ---------------------------------------------------------------------------
+# fault-registry extensions: oom + hang kinds
+# ---------------------------------------------------------------------------
+
+def test_oom_fault_kind_raises_resource_exhausted():
+    faults.inject(faults.SITE_SOLVE, "oom", count=1)
+    with pytest.raises(faults.InjectedOOM) as exc:
+        faults.fire(faults.SITE_SOLVE)
+    assert "RESOURCE_EXHAUSTED" in str(exc.value)
+    assert isinstance(exc.value, faults.InjectedFault)  # isolation-absorbable
+    assert isinstance(exc.value, RECOVERABLE_FRAME_ERRORS)
+    faults.fire(faults.SITE_SOLVE)  # capped
+
+
+def test_hang_fault_release_valve(monkeypatch):
+    """An unwatched hang must not deadlock forever: after
+    SART_HANG_RELEASE seconds it raises InjectedFault."""
+    monkeypatch.setenv("SART_HANG_RELEASE", "0.12")
+    faults.inject(faults.SITE_DEVICE_PUT, "hang", count=1)
+    t0 = time.monotonic()
+    with pytest.raises(faults.InjectedFault, match="hang.*released"):
+        faults.fire(faults.SITE_DEVICE_PUT)
+    assert 0.1 <= time.monotonic() - t0 < 5.0
+
+
+def test_is_resource_exhausted_matcher():
+    assert degrade.is_resource_exhausted(faults.InjectedOOM("boom"))
+    assert degrade.is_resource_exhausted(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to "
+                     "allocate 123 bytes"))
+    assert degrade.is_resource_exhausted(RuntimeError("xla: out of memory"))
+    assert not degrade.is_resource_exhausted(RuntimeError("divide by zero"))
+    assert not degrade.is_resource_exhausted(OSError("disk full"))
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+
+def test_ladder_halves_and_sticks():
+    events = []
+    ladder = degrade.GroupSizeLadder(8, on_event=events.append)
+    assert not ladder.degraded and ladder.summary() is None
+    assert ladder.note_oom(RuntimeError("oom"))
+    assert ladder.size == 4 and ladder.degraded
+    assert ladder.note_oom(RuntimeError("oom"))
+    assert ladder.note_oom(RuntimeError("oom"))
+    assert ladder.size == 1
+    # exhausted: the caller falls through to per-frame isolation
+    assert not ladder.note_oom(RuntimeError("oom"))
+    assert ladder.size == 1
+    assert len(events) == 3
+    assert "8 -> 4 -> 2 -> 1" in ladder.summary()
+
+
+def test_ladder_rejects_bad_size():
+    with pytest.raises(ValueError):
+        degrade.GroupSizeLadder(0)
+
+
+# ---------------------------------------------------------------------------
+# shutdown flag semantics (subprocess SIGTERM drills: test_killdrill.py)
+# ---------------------------------------------------------------------------
+
+def test_shutdown_flag_set_by_real_signal():
+    assert not shutdown.stop_requested()
+    with shutdown.installed():
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not shutdown.stop_requested() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert shutdown.stop_requested()
+        assert shutdown.stop_signal() == "SIGTERM"
+    # uninstalled: the flag survives until reset, the handler does not
+    assert shutdown.stop_requested()
+    shutdown.reset()
+    assert not shutdown.stop_requested()
+
+
+def test_shutdown_install_resets_stale_flag():
+    shutdown._state["stop"] = True
+    with shutdown.installed():
+        assert not shutdown.stop_requested()
+
+
+# ---------------------------------------------------------------------------
+# watchdog: beacons, heartbeat, staged escalation
+# ---------------------------------------------------------------------------
+
+def test_beacon_records_phase_and_thread():
+    watchdog.beacon("unit.phase")
+    phase, serial, t, ident = watchdog.last_beacon()
+    assert phase == "unit.phase" and ident == threading.get_ident()
+    watchdog.beacon("unit.phase2")
+    assert watchdog.last_beacon()[1] == serial + 1
+
+
+def test_frame_done_beacon_touches_heartbeat(tmp_path, monkeypatch):
+    hb = str(tmp_path / "heartbeat")
+    monkeypatch.setenv("SART_HEARTBEAT_FILE", hb)
+    watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+    assert os.path.exists(hb)
+    first = os.stat(hb).st_mtime_ns
+    time.sleep(0.05)
+    watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+    assert os.stat(hb).st_mtime_ns >= first
+    # non-frame phases never touch it
+    os.unlink(hb)
+    watchdog.beacon(watchdog.PHASE_DISPATCH)
+    assert not os.path.exists(hb)
+
+
+def test_watchdog_stays_quiet_under_progress():
+    wd = watchdog.Watchdog(timeout=0.3, poll=0.05, hard_exit=False)
+    with wd:
+        for _ in range(12):
+            watchdog.beacon("steady")
+            time.sleep(0.05)
+    assert wd.fired == 0
+
+
+def test_watchdog_interrupts_cooperative_stall():
+    """Stage 1: a Python-level stall on the main thread is interrupted
+    with WatchdogTimeout within timeout + poll."""
+    wd = watchdog.Watchdog(timeout=0.3, grace=30, poll=0.05,
+                           hard_exit=False)
+    watchdog.beacon("stall.start")
+    t0 = time.monotonic()
+    with wd:
+        with pytest.raises(WatchdogTimeout):
+            while time.monotonic() - t0 < 10:
+                time.sleep(0.01)  # cooperative: async exc lands here
+    assert wd.fired == 1
+    assert time.monotonic() - t0 < 5
+
+
+def test_watchdog_revokes_pending_interrupt_after_progress():
+    """A stage-1 interrupt aimed at a thread inside a C call stays
+    PENDING until the call returns. If the stall resolves on its own
+    (progress beacons resume — a slow-but-healthy compile/write), the
+    watchdog must revoke the pending exception: otherwise it would
+    detonate at an arbitrary later bytecode of a healthy run."""
+    wd = watchdog.Watchdog(timeout=0.3, grace=30, poll=0.05,
+                           hard_exit=False)
+    watchdog.beacon("pre.stall")
+    stop_ticker = threading.Event()
+
+    def ticker():
+        time.sleep(0.9)  # let stage 1 fire into the sleeping main first
+        while not stop_ticker.is_set():
+            watchdog.beacon("tick")  # progress resumes -> revocation
+            time.sleep(0.05)
+
+    t = threading.Thread(target=ticker, daemon=True)
+    try:
+        with wd:
+            t.start()
+            # one long C-level sleep: the interrupt cannot be delivered
+            # inside it, only queued as pending
+            time.sleep(2.0)
+            # back at bytecode level: a revoked interrupt must NOT fire
+            for _ in range(50):
+                time.sleep(0.01)
+    finally:
+        stop_ticker.set()
+        t.join(timeout=5)
+    assert wd.fired >= 1  # stage 1 really did interrupt the stall
+
+
+def test_watchdog_from_env(monkeypatch):
+    monkeypatch.delenv("SART_WATCHDOG_TIMEOUT", raising=False)
+    assert watchdog.Watchdog.from_env() is None
+    monkeypatch.setenv("SART_WATCHDOG_TIMEOUT", "0")
+    assert watchdog.Watchdog.from_env() is None
+    monkeypatch.setenv("SART_WATCHDOG_TIMEOUT", "7.5")
+    monkeypatch.setenv("SART_WATCHDOG_GRACE", "2.5")
+    wd = watchdog.Watchdog.from_env()
+    assert wd.timeout == 7.5 and wd.grace == 2.5
+
+
+def test_watchdog_hard_abort_in_subprocess():
+    """Stage 3: a non-cooperative stall (one long C-level sleep — the
+    pending async exception can never fire) must end in os._exit(3),
+    never a deadlocked process."""
+    code = (
+        "import time\n"
+        "from sartsolver_tpu.resilience import watchdog\n"
+        "wd = watchdog.Watchdog(timeout=0.3, grace=0.3, poll=0.05)\n"
+        "wd.start()\n"
+        "time.sleep(60)\n"  # C-level: only the hard abort can end this
+        "print('unreachable')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == EXIT_INFRASTRUCTURE
+    assert "aborting with exit 3" in proc.stderr
+    assert "thread stacks" in proc.stderr
+    assert "unreachable" not in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# CLI drills: hang + oom through the real frame loop
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def world(tmp_path):
+    return fx.write_world(tmp_path, with_laplacian=True)
+
+
+def run_cli(paths, *extra):
+    return main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "300", "-c", "1e-6",
+        *extra,
+    ])
+
+
+def _read_out(paths):
+    with h5py.File(paths["output"], "r") as f:
+        return (f["solution/value"][:], f["solution/status"][:],
+                f["solution/iterations"][:])
+
+
+def _arm_watchdog(monkeypatch, timeout="3", grace="60"):
+    """In-process drills must never reach the hard abort (it would take
+    pytest with it): a generous grace keeps stage 3 unreachable while
+    stage 1/2 still fire fast."""
+    monkeypatch.setenv("SART_WATCHDOG_TIMEOUT", timeout)
+    monkeypatch.setenv("SART_WATCHDOG_GRACE", grace)
+
+
+def test_cli_hang_at_solve_dispatch_escalates_to_failed_row(
+        world, monkeypatch, capsys):
+    """Injected hang at solve.dispatch: stack dump + WatchdogTimeout →
+    the frame becomes a FAILED row within the watchdog timeout and the
+    run continues (exit 2) — never a deadlocked process."""
+    paths, *_ = world
+    assert run_cli(paths, "--chain_frames", "1") == 0  # warm the compiles
+    capsys.readouterr()
+    _arm_watchdog(monkeypatch)
+    faults.inject(faults.SITE_SOLVE, "hang", count=1)
+    t0 = time.monotonic()
+    rc = run_cli(paths, "--chain_frames", "1")
+    elapsed = time.monotonic() - t0
+    assert rc == EXIT_PARTIAL
+    assert elapsed < 30  # interrupted, not released (release is 60s)
+    _, status, iters = _read_out(paths)
+    assert list(status) == [FRAME_FAILED, 0, 0, 0]
+    assert iters[0] == -1
+    err = capsys.readouterr()
+    assert "dumping thread stacks" in err.err
+    assert "WatchdogTimeout" in err.err
+    assert "watchdog" in err.out  # summary records the event
+
+
+def test_cli_hang_at_device_put_escalates_to_failed_row(
+        world, monkeypatch, capsys):
+    """Injected hang at the host->device staging site: same escalation."""
+    paths, *_ = world
+    assert run_cli(paths, "--chain_frames", "2") == 0
+    capsys.readouterr()
+    _arm_watchdog(monkeypatch)
+    faults.inject(faults.SITE_DEVICE_PUT, "hang", count=1)
+    rc = run_cli(paths, "--chain_frames", "2")
+    assert rc == EXIT_PARTIAL
+    _, status, _ = _read_out(paths)
+    # the hang fails its whole chain group, later groups solve
+    assert list(status) == [FRAME_FAILED, FRAME_FAILED, 0, 0]
+    assert "dumping thread stacks" in capsys.readouterr().err
+
+
+def test_cli_hang_during_solver_construction_aborts(world, monkeypatch,
+                                                    capsys):
+    """A hang BEFORE the frame loop exists (here: the Laplacian staging
+    device.put inside DistributedSARTSolver.__init__) has no frame to
+    fail — the watchdog covers the whole expensive body (ingest chunk
+    beacons + staging beacons) and the interrupt aborts with
+    EXIT_INFRASTRUCTURE instead of wedging until the hang release."""
+    paths, *_ = world
+    _arm_watchdog(monkeypatch, timeout="2")
+    faults.inject(faults.SITE_DEVICE_PUT, "hang", count=1)
+    t0 = time.monotonic()
+    rc = run_cli(paths, "-l", paths["laplacian"], "-b", "0.001")
+    assert rc == EXIT_INFRASTRUCTURE
+    assert time.monotonic() - t0 < 30  # interrupted, not released (60s)
+    err = capsys.readouterr().err
+    assert "dumping thread stacks" in err
+    assert "Aborted by the hang watchdog" in err
+
+
+def test_cli_hang_at_prefetch_aborts_resumably(world, monkeypatch, capsys):
+    """Injected hang in the prefetch worker: the main thread is blocked
+    on the frame queue (its stage-1 interrupt stays pending), stage 2
+    interrupts the worker, the pending interrupt then fires — a clean
+    EXIT_INFRASTRUCTURE abort, not a deadlock."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    capsys.readouterr()
+    _arm_watchdog(monkeypatch, timeout="1.5", grace="1.5")
+    faults.inject(faults.SITE_PREFETCH, "hang", count=1)
+    t0 = time.monotonic()
+    rc = run_cli(paths)
+    assert rc == EXIT_INFRASTRUCTURE
+    assert time.monotonic() - t0 < 30
+    assert "dumping thread stacks" in capsys.readouterr().err
+
+
+def test_cli_oom_degrades_group_size_and_completes(world, capsys):
+    """Injected RESOURCE_EXHAUSTED at dispatch: the chain group halves
+    (4 → 2), the same frames re-solve, every frame is written with
+    results identical to the undegraded run, and the summary reports the
+    sticky reduction."""
+    paths, *_ = world
+    assert run_cli(paths, "--chain_frames", "4") == 0
+    clean = _read_out(paths)
+    capsys.readouterr()
+    faults.inject(faults.SITE_SOLVE, "oom", count=1)
+    rc = run_cli(paths, "--chain_frames", "4")
+    assert rc == 0  # every frame solved — degraded, not failed
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    np.testing.assert_array_equal(got[1], clean[1])
+    np.testing.assert_array_equal(got[2], clean[2])
+    out = capsys.readouterr()
+    assert "re-solving the same frames at 2" in out.err
+    assert "oom degradation: frame-group size 4 -> 2" in out.out
+
+
+def test_cli_oom_ladder_reaches_one_then_isolates(world, capsys):
+    """Persistent OOM: 4 → 2 → 1, then per-frame isolation takes over
+    (FAILED rows), the run completes with exit 2."""
+    paths, *_ = world
+    faults.inject(faults.SITE_SOLVE, "oom", count=100)
+    rc = run_cli(paths, "--chain_frames", "4")
+    assert rc == EXIT_PARTIAL
+    _, status, _ = _read_out(paths)
+    assert list(status) == [FRAME_FAILED] * 4
+    out = capsys.readouterr()
+    assert "frame-group size 4 -> 2 -> 1" in out.out
+
+
+def test_cli_oom_recovery_after_two_halvings(world):
+    """OOM twice: 4 → 2 → 1; the remaining dispatches succeed at size 1
+    and every frame is still written successfully."""
+    paths, *_ = world
+    assert run_cli(paths, "--chain_frames", "4") == 0
+    clean = _read_out(paths)
+    faults.inject(faults.SITE_SOLVE, "oom", count=2)
+    rc = run_cli(paths, "--chain_frames", "4")
+    assert rc == 0
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    np.testing.assert_array_equal(got[1], clean[1])
+
+
+def test_cli_multihost_oom_never_halves(world):
+    """The ladder is a per-process decision: a multihost OOM must abort
+    fail-fast (one process re-dispatching a half-sized collective
+    program while its peers run the full size would deadlock the pod),
+    never halve-and-retry. Degenerate single-process multihost run pins
+    the gate."""
+    paths, *_ = world
+    faults.inject(faults.SITE_SOLVE, "oom", count=1)
+    with pytest.raises(faults.InjectedOOM):
+        run_cli(paths, "--multihost", "--chain_frames", "4")
+    # the aborted run wrote nothing: no half-sized re-dispatch ever
+    # produced rows, so the lazily-created output file never appeared
+    # (or, had earlier groups flushed, holds no row past the fault)
+    if os.path.exists(paths["output"]):
+        with h5py.File(paths["output"], "r") as f:
+            assert "solution" not in f or f["solution/value"].shape[0] == 0
+
+
+def test_cli_heartbeat_file_touched(world, tmp_path, monkeypatch):
+    paths, *_ = world
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("SART_HEARTBEAT_FILE", hb)
+    assert run_cli(paths) == 0
+    assert os.path.exists(hb)
+
+
+def test_cli_watchdog_off_path_identical(world, monkeypatch):
+    """With the watchdog armed but never firing, outputs are identical
+    to an unwatched run (the layer is pure observation until a stall)."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    clean = _read_out(paths)
+    _arm_watchdog(monkeypatch, timeout="300")
+    assert run_cli(paths) == 0
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    np.testing.assert_array_equal(got[1], clean[1])
+    np.testing.assert_array_equal(got[2], clean[2])
+
+
+# ---------------------------------------------------------------------------
+# trace identity: the availability layer is off-path by construction
+# ---------------------------------------------------------------------------
+
+def test_guarded_dispatch_registered():
+    from sartsolver_tpu.analysis.registry import load_registered_entries
+
+    entries = load_registered_entries()
+    assert "guarded_dispatch" in entries
+    assert entries["guarded_dispatch"].min_devices == 2
+
+
+def test_guarded_dispatch_golden_equals_sharded_batch():
+    """The checked-in golden of the availability-wrapped dispatch must be
+    byte-equal to the unwrapped sharded_batch golden: the machine-checked
+    form of 'with the layer disabled the traced programs are
+    identical'."""
+    import jax
+
+    from sartsolver_tpu.analysis.audit import GOLDENS_DIR
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("goldens are checked in for the cpu backend")
+    with open(os.path.join(GOLDENS_DIR, "guarded_dispatch.cpu.json")) as fh:
+        guarded = json.load(fh)
+    with open(os.path.join(GOLDENS_DIR, "sharded_batch.cpu.json")) as fh:
+        plain = json.load(fh)
+    assert guarded == plain

@@ -450,6 +450,20 @@ class TestSolutionWriter:
                 f[f"solution/time_{fx.CAM_B}"][:], 0.1 * np.arange(5) + 0.003)
             assert f["solution/value"].maxshape == (None, fx.NVOXEL)
 
+    @pytest.mark.parametrize("kwargs", [
+        {"nvoxel": 0}, {"nvoxel": -1},
+        {"max_cache_size": 0}, {"max_cache_size": -3},
+    ])
+    def test_rejects_non_positive_sizes(self, tmp_path, kwargs):
+        """Regression: the constructor used equality checks (== 0), so a
+        NEGATIVE nvoxel/max_cache_size slipped through — into dataset
+        shapes and a flush cadence that never fires."""
+        full = {"nvoxel": fx.NVOXEL, "max_cache_size": 10, **kwargs}
+        with pytest.raises(ValueError, match="must be positive"):
+            SolutionWriter(str(tmp_path / "bad.h5"), [fx.CAM_A],
+                           full["nvoxel"],
+                           max_cache_size=full["max_cache_size"])
+
     def test_resume_into_pre_iterations_file(self, tmp_path):
         """Resuming into a file written before the `iterations` extension
         (dataset absent) must keep appending without it."""
@@ -520,6 +534,16 @@ class TestAlignmentTieBreaks:
         np.testing.assert_allclose(ci.time, [0.0, 0.2], atol=1e-12)
 
 
+def _wait_for_latch(w, timeout=10.0):
+    """Wait until the async writer's worker latched its first error."""
+    import time as _t
+
+    deadline = _t.monotonic() + timeout
+    while w._error is None and _t.monotonic() < deadline:
+        _t.sleep(0.01)
+    assert w._error is not None
+
+
 class TestAsyncSolutionWriter:
     def test_matches_synchronous_writer(self, tmp_path):
         from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
@@ -569,7 +593,14 @@ class TestAsyncSolutionWriter:
         assert resolved_on and resolved_on[0] != caller
 
     def test_write_error_surfaces(self):
-        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+        """A latched write error surfaces on a later add()/close() as a
+        CHAINED wrapper: the original exception (with its worker-side
+        traceback) is __cause__, and every surfacing site raises a fresh
+        object instead of re-raising — and thereby mutating — the latched
+        one."""
+        from sartsolver_tpu.utils.asyncwriter import (
+            AsyncSolutionWriter, DeferredWriteError,
+        )
 
         class Exploding:
             def add(self, *a):
@@ -580,10 +611,69 @@ class TestAsyncSolutionWriter:
 
         w = AsyncSolutionWriter(Exploding())
         w.add(np.zeros(4), 0, 0.0, [0.0])
-        with pytest.raises(OSError, match="disk full"):
+        with pytest.raises(DeferredWriteError, match="disk full") as exc:
             for _ in range(50):  # error latches on a subsequent add or close
                 w.add(np.zeros(4), 0, 0.0, [0.0])
             w.close()
+        assert isinstance(exc.value.__cause__, OSError)
+
+    def test_latched_error_traceback_not_stacked_across_raises(self):
+        """Regression: _check() used to re-raise the SAME latched object
+        from every call site, growing its traceback by a surfacing-site
+        segment per raise; the wrapper keeps the original traceback
+        pristine and each surfaced error is a distinct object."""
+        import traceback as tb_mod
+
+        from sartsolver_tpu.utils.asyncwriter import (
+            AsyncSolutionWriter, DeferredWriteError,
+        )
+
+        class Exploding:
+            def add(self, *a):
+                raise OSError("disk full")
+
+            def close(self):
+                pass
+
+        w = AsyncSolutionWriter(Exploding())
+        w.add(np.zeros(4), 0, 0.0, [0.0])
+        _wait_for_latch(w)
+
+        def surface():
+            with pytest.raises(DeferredWriteError) as exc:
+                w.add(np.zeros(4), 0, 0.0, [0.0])
+            return exc.value
+
+        first, second = surface(), surface()
+        assert first is not second
+        assert first.__cause__ is second.__cause__  # one original error
+        # the original traceback must not have accumulated surfacing-site
+        # frames between the two raises
+        depth = len(tb_mod.extract_tb(first.__cause__.__traceback__))
+        assert len(
+            tb_mod.extract_tb(second.__cause__.__traceback__)) == depth
+
+    def test_output_write_error_cause_keeps_type(self):
+        """An OutputWriteError latched by the worker must surface AS an
+        OutputWriteError (the CLI's exit-code mapping keys on the type),
+        still chained to the original."""
+        from sartsolver_tpu.resilience.failures import OutputWriteError
+        from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
+
+        class FlushFails:
+            def add(self, *a):
+                raise OutputWriteError("flush of x failed; resumable")
+
+            def close(self):
+                pass
+
+        w = AsyncSolutionWriter(FlushFails())
+        w.add(np.zeros(4), 0, 0.0, [0.0])
+        _wait_for_latch(w)
+        with pytest.raises(OutputWriteError, match="resumable") as exc:
+            w.add(np.zeros(4), 0, 0.0, [0.0])
+        assert isinstance(exc.value.__cause__, OutputWriteError)
+        assert exc.value is not exc.value.__cause__
 
     def test_buffer_copied_before_queueing(self, tmp_path):
         from sartsolver_tpu.utils.asyncwriter import AsyncSolutionWriter
