@@ -36,7 +36,11 @@ Robustness (hardened each round against a real driver failure):
   itself stalls, the watchdog emits the best COMPLETED headline (a real
   value marked ``degraded``), not 0.0.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"},
+wrapped in the shared obs-schema envelope ({"type": "bench", "schema": N};
+sartsolver_tpu/obs/schema.py, loaded by file path so this parent process
+still never imports jax) — BENCH_*.json and --metrics_out artifacts share
+one validated format and `sartsolve metrics` consumes both.
 All human-facing progress goes to stderr. ``detail`` records which sweep
 path each config actually engaged ("fused": compiled/interpret/off) and a
 ``degraded`` marker whenever the headline is not the full-fidelity number
@@ -63,6 +67,77 @@ _METRIC = "sart_iterations_per_sec_dense_rtm"
 _last_progress = time.monotonic()
 _partial: dict = {}  # filled as results land; the watchdog reports them
 _emitted = False
+
+
+_schema_mod = None
+
+
+def _obs_schema():
+    """The shared result-record schema (sartsolver_tpu/obs/schema.py),
+    loaded BY FILE PATH: importing the package would run its __init__,
+    which pulls in jax — and this parent process must never import jax
+    (a hung tunnel backend inside `import jax` was the round-1 failure
+    mode). The module is stdlib-only by contract, so a direct file load
+    is safe. BENCH artifacts and --metrics_out artifacts thereby share
+    one validated format (`sartsolve metrics` consumes both).
+
+    Loaded ONCE and cached — main() preloads it before arming the
+    watchdog, so the emergency-emit path never touches the filesystem
+    (a stalled mount is a plausible cause of the very hang the watchdog
+    handles). A failed load falls back to a schema-less passthrough:
+    the one-JSON-line contract outranks the envelope."""
+    global _schema_mod
+    if _schema_mod is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "sartsolver_tpu", "obs", "schema.py",
+        )
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "_sart_obs_schema", path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as err:
+
+            class _Fallback:
+                SCHEMA_VERSION = 1
+                _err = f"{type(err).__name__}: {err}"
+
+                @staticmethod
+                def make_bench_record(metric, value, unit, vs_baseline,
+                                      detail):
+                    return {
+                        "type": "bench", "schema": 1, "metric": metric,
+                        "value": value, "unit": unit,
+                        "vs_baseline": vs_baseline, "detail": detail,
+                    }
+
+                @staticmethod
+                def validate_record(_rec):
+                    return []
+
+            mod = _Fallback
+        _schema_mod = mod
+    return _schema_mod
+
+
+def _bench_payload(value: float, unit: str, vs_baseline: float,
+                   detail: dict) -> dict:
+    """One BENCH result record through the obs schema: the historical
+    {metric, value, unit, vs_baseline, detail} keys plus the shared
+    type/schema envelope, validated before it is printed."""
+    schema = _obs_schema()
+    payload = schema.make_bench_record(
+        _METRIC, round(float(value), 2), unit,
+        round(float(vs_baseline), 3), detail,
+    )
+    errors = schema.validate_record(payload)
+    if errors:  # never block the one-JSON-line contract on a schema bug
+        payload["detail"] = dict(detail, schema_errors=errors)
+    return payload
 
 
 def _tick() -> None:
@@ -95,25 +170,23 @@ def _watchdog_payload(stall_s: float) -> dict:
     if ok and bar:
         head = _select_headline(ok)
         ctx = _partial.get("unit_ctx", "")
-        return {
-            "metric": _METRIC,
-            "value": round(float(head["loop_iter_s"]), 2),
-            "unit": (f"iter/s ({ctx}{head['rtm_dtype']} RTM, B={head['B']}, "
-                     f"fused={head['fused']}; degraded: partial sweep, "
-                     "watchdog)"),
-            "vs_baseline": round(float(head["loop_iter_s"]) / bar, 3),
-            "detail": {
+        return _bench_payload(
+            head["loop_iter_s"],
+            (f"iter/s ({ctx}{head['rtm_dtype']} RTM, B={head['B']}, "
+             f"fused={head['fused']}; degraded: partial sweep, "
+             "watchdog)"),
+            float(head["loop_iter_s"]) / bar,
+            {
                 "degraded": f"partial sweep (watchdog stall > {stall_s:.0f}s)",
                 **_partial,
             },
-        }
-    return {
-        "metric": _METRIC,
-        "value": 0.0,
-        "unit": f"UNAVAILABLE: stalled > {stall_s:.0f}s (backend hang)",
-        "vs_baseline": 0.0,
-        "detail": {"error": "watchdog timeout", **_partial},
-    }
+        )
+    return _bench_payload(
+        0.0,
+        f"UNAVAILABLE: stalled > {stall_s:.0f}s (backend hang)",
+        0.0,
+        {"error": "watchdog timeout", **_partial},
+    )
 
 
 def _start_watchdog() -> None:
@@ -204,13 +277,7 @@ def _detect_hbm_bw_gbs(platform: str, device_kind: str) -> float:
 def _emit(value: float, unit: str, vs_baseline: float, detail: dict) -> int:
     global _emitted
     _emitted = True
-    print(json.dumps({
-        "metric": _METRIC,
-        "value": round(float(value), 2),
-        "unit": unit,
-        "vs_baseline": round(float(vs_baseline), 3),
-        "detail": detail,
-    }))
+    print(json.dumps(_bench_payload(value, unit, vs_baseline, detail)))
     return 0
 
 
@@ -757,6 +824,7 @@ def _refresh_partials(results: dict, items: list) -> None:
 
 
 def main() -> int:
+    _obs_schema()  # preload+cache BEFORE the watchdog can ever need it
     _start_watchdog()
     t_start = time.perf_counter()
     forced_cpu = os.environ.get("SART_BENCH_FORCED_CPU") == "1"

@@ -30,7 +30,10 @@ def build_parser() -> argparse.ArgumentParser:
         description="Impurity flux reconstruction for ITER: emissivity",
         epilog="subcommands: `sartsolve lint` — static analysis for JAX "
                "hazards (AST rules + compile audit; see `sartsolve lint "
-               "--help` and docs/STATIC_ANALYSIS.md). "
+               "--help` and docs/STATIC_ANALYSIS.md); `sartsolve metrics` "
+               "— validate, summarize and diff --metrics_out artifacts "
+               "(see `sartsolve metrics --help` and "
+               "docs/OBSERVABILITY.md). "
                "exit codes: 0 success; 1 input/flag error; 2 run completed "
                "with FAILED/DIVERGED frames; 3 aborted on an unrecoverable "
                "infrastructure failure after retries or a watchdog hard "
@@ -147,6 +150,19 @@ def build_parser() -> argparse.ArgumentParser:
                           "RTM ingest, per-frame solve — the first frame "
                           "includes XLA compilation — and output writes) at "
                           "the end of the run.")
+    o11y = p.add_argument_group(
+        "observability options",
+        "structured telemetry (docs/OBSERVABILITY.md): host-side only, "
+        "zero-cost when disabled. Environment sinks: SART_METRICS_PROM "
+        "writes a Prometheus textfile at end of run, SART_TRACE_EVENTS "
+        "writes Chrome trace-event JSON (Perfetto) of the pipeline's "
+        "host phases alongside --profile_dir's XLA traces.")
+    o11y.add_argument("--metrics_out", default=None, metavar="FILE",
+                      help="Write the run's telemetry artifact here as "
+                           "JSONL (meta, per-frame solve records, "
+                           "availability events, end-of-run metrics, "
+                           "summary); validate/summarize/diff it with "
+                           "`sartsolve metrics`.")
     res = p.add_argument_group(
         "resilience options",
         "fault handling (docs/RESILIENCE.md): retry/backoff knobs are "
@@ -257,6 +273,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.analysis.cli import lint_main
 
         return lint_main(argv[1:])
+    if argv and argv[0] == "metrics":
+        # artifact tooling subcommand (docs/OBSERVABILITY.md): validate,
+        # summarize and diff --metrics_out JSONL artifacts; dispatched
+        # like `lint`, before the solver parser sees the argv
+        from sartsolver_tpu.obs.cli import metrics_main
+
+        return metrics_main(argv[1:])
     try:
         args = build_parser().parse_args(argv)
     except SystemExit as err:
@@ -300,6 +323,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     # summary, not a process-lifetime total
     reset_retry_stats()
 
+    # Observability (docs/OBSERVABILITY.md): a fresh per-run metrics
+    # registry (--timing's PhaseTimer is a view over it) and, when
+    # --metrics_out / SART_METRICS_PROM / SART_TRACE_EVENTS ask for them,
+    # the artifact sinks. Host-side only; with no sink configured the run
+    # is byte-identical to a build without the layer.
+    from sartsolver_tpu.obs import trace as obs_trace
+    from sartsolver_tpu.obs.run import RunTelemetry
+
+    telem = RunTelemetry.from_cli(args.metrics_out)
+
     # Graceful preemption (docs/RESILIENCE.md §5): SIGTERM/SIGINT sets a
     # stop flag honored at frame-group boundaries (drain, flush, exit 4);
     # a second signal aborts immediately. Installed before the (possibly
@@ -338,17 +371,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     # run (a watchdog fire during solver construction included) land in
     # the end-of-run accounting.
     summary = RunSummary()
+
+    def note_event(message: str) -> None:
+        # availability events land in BOTH accountings: the printed
+        # end-of-run summary and the typed telemetry records
+        summary.record_event(message)
+        telem.record_event(message)
+
     # Hang watchdog (docs/RESILIENCE.md §6): armed by
     # SART_WATCHDOG_TIMEOUT and scoped to the WHOLE expensive body —
     # RTM ingest, solver construction (device staging beacons), frame
     # loop and the writer drain on exit — a hang anywhere must escalate
     # (FRAME_FAILED inside the frame loop, a resumable exit-3 abort
     # elsewhere), never wedge. No-op when disabled.
-    wd = watchdog.Watchdog.from_env(on_event=summary.record_event)
+    wd = watchdog.Watchdog.from_env(on_event=note_event)
     if wd is not None:
         wd.start()
 
-    timer = PhaseTimer()
+    timer = PhaseTimer(registry=telem.registry)
     _t = _time.perf_counter()
 
     def _mark(phase: str) -> None:
@@ -541,6 +581,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"fused_sweep={args.fused_sweep}->{opts.fused_sweep} "
                 f"processes={jax.process_count()}"
             )
+        # artifact provenance: the same decision line, as typed meta
+        telem.set_run_info(
+            backend=jax.default_backend(),
+            mesh=f"{n_pix}x{n_vox}",
+            processes=int(jax.process_count()),
+            rtm_dtype=str(opts.rtm_dtype or opts.dtype),
+            compute_dtype=str(opts.dtype),
+            fused_sweep=str(opts.fused_sweep),
+            logarithmic=bool(args.logarithmic),
+        )
 
         # ---- data model (main.cpp:70-86) ---------------------------------
         # Multi-host: each process reads and caches only its own devices'
@@ -573,25 +623,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.parallel.multihost import read_and_shard_rtm
 
         rtm_scale = None
-        if opts.rtm_dtype == "int8":
-            # two-pass ingest: quantize fp32 chunks host-side into int8
-            # device buffers, so peak device footprint is 1 byte/element —
-            # a matrix that only fits as int8 loads (multihost.py)
-            from sartsolver_tpu.parallel.multihost import read_and_quantize_rtm
+        with obs_trace.span("ingest.rtm", npixel=npixel, nvoxel=nvoxel):
+            if opts.rtm_dtype == "int8":
+                # two-pass ingest: quantize fp32 chunks host-side into
+                # int8 device buffers, so peak device footprint is
+                # 1 byte/element — a matrix that only fits as int8 loads
+                # (multihost.py)
+                from sartsolver_tpu.parallel.multihost import (
+                    read_and_quantize_rtm,
+                )
 
-            rtm, rtm_scale = read_and_quantize_rtm(
-                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                rtm, rtm_scale = read_and_quantize_rtm(
+                    sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                )
+            else:
+                rtm = read_and_shard_rtm(
+                    sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                    dtype=opts.rtm_dtype or opts.dtype,
+                    serialize=args.multihost and not args.parallel_read,
+                )
+            solver = DistributedSARTSolver(
+                rtm, lap, opts=opts, mesh=mesh, npixel=npixel,
+                nvoxel=nvoxel, rtm_scale=rtm_scale,
             )
-        else:
-            rtm = read_and_shard_rtm(
-                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
-                dtype=opts.rtm_dtype or opts.dtype,
-                serialize=args.multihost and not args.parallel_read,
-            )
-        solver = DistributedSARTSolver(
-            rtm, lap, opts=opts, mesh=mesh, npixel=npixel, nvoxel=nvoxel,
-            rtm_scale=rtm_scale,
-        )
         _mark("ingest RTM + upload")
 
         grid = make_voxel_grid(
@@ -675,7 +729,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return local
 
         def degrade_event(message: str) -> None:
-            summary.record_event(message)
+            note_event(message)
             if primary:
                 print(f"sartsolve: {message}", file=sys.stderr)
 
@@ -691,6 +745,11 @@ def main(argv: Optional[List[str]] = None) -> int:
                 writer.add(failed_row(nvoxel), FRAME_FAILED, ftime,
                            cam_times, iterations=-1)
                 summary.record_status(FRAME_FAILED, ftime)
+                # typed telemetry: the failure counter is keyed by the
+                # error class, so injected faults (SART_FAULT) and their
+                # real counterparts increment the same series
+                telem.record_frame(ftime, FRAME_FAILED, -1, None, None,
+                                   "failed", error=type(err).__name__)
                 watchdog.beacon(watchdog.PHASE_FRAME_DONE)
                 if primary:
                     print(f"Frame at t={ftime}: FAILED "
@@ -762,14 +821,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # the interval spans everything since the previous
                     # group finished — staging/dispatching the next group
                     # and any frame-read stall included — so the timer row
-                    # says "pipelined wall", not plain solve time
-                    timer.add(f"solve {label} (pipelined wall)", dt)
+                    # says "pipelined wall", not plain solve time.
+                    # detail=: this interval lies INSIDE the frame-loop
+                    # phase, so it must not also feed the total line
+                    timer.add(f"solve {label} (pipelined wall)", dt,
+                              detail=True)
                     per_frame_ms = dt * 1e3 / len(metas)
                     for b, (_, ftime, cam_times) in enumerate(metas):
                         writer.add(result.solution_fetcher(b),
                                    int(statuses[b]), ftime, cam_times,
                                    iterations=int(result.iterations[b]))
                         summary.record_status(int(statuses[b]), ftime)
+                        telem.record_frame(
+                            ftime, int(statuses[b]),
+                            int(result.iterations[b]),
+                            float(result.convergence[b]),
+                            per_frame_ms, label,
+                        )
                         watchdog.beacon(watchdog.PHASE_FRAME_DONE)
                         if primary:
                             print(f"Processed in: {per_frame_ms} ms "
@@ -982,7 +1050,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                     summary.record_status(status, ftime)
                     watchdog.beacon(watchdog.PHASE_FRAME_DONE)
                     elapsed_ms = (_time.perf_counter() - t0) * 1e3
-                    timer.add("solve frame", elapsed_ms / 1e3)
+                    telem.record_frame(
+                        ftime, status, int(dres.iterations[0]),
+                        float(dres.convergence[0]), elapsed_ms, "frame",
+                    )
+                    # detail=: per-frame rows lie inside the frame-loop
+                    # phase — shown, but excluded from the total line
+                    timer.add("solve frame", elapsed_ms / 1e3, detail=True)
                     if primary:
                         print(f"Processed in: {elapsed_ms} ms")
 
@@ -993,10 +1067,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             # fresh beacon: the voxel-map write gets its own watchdog
             # budget instead of inheriting whatever silence preceded it
             watchdog.beacon(watchdog.PHASE_FLUSH)
-            with h5py.File(args.output_file, "a") as f:
-                has_grid = "voxel_map" in f
-            if not has_grid:  # resumed runs already wrote the grid
-                grid.write_hdf5(args.output_file, "voxel_map")
+            with obs_trace.span("flush.voxel_map"):
+                with h5py.File(args.output_file, "a") as f:
+                    has_grid = "voxel_map" in f
+                if not has_grid:  # resumed runs already wrote the grid
+                    grid.write_hdf5(args.output_file, "voxel_map")
         _mark("write voxel map")
         if args.timing and primary:
             print(timer.summary())
@@ -1022,6 +1097,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         if primary and (summary.n_failed or summary.had_retries()
                         or summary.events or interrupted or args.timing):
             print(summary.format())
+        # Telemetry artifact fan-out: every process reaches this point on
+        # the completed path (interrupted runs included — the stop
+        # boundary is agreed collectively), so the multi-host counter
+        # aggregation — ONE host allgather, and only when a sink is
+        # configured (sink config must be pod-uniform, like the rest of
+        # the command line) — is safe here and only here; exception
+        # paths write a local-only artifact from the finally block
+        # below. With no sink configured this is a true no-op.
+        telem.finalize(summary, multihost=args.multihost, primary=primary)
         if interrupted:
             # graceful preemption stop (docs/RESILIENCE.md §5): the
             # in-flight group drained, the writer flushed, the voxel map
@@ -1078,6 +1162,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         if wd is not None:
             wd.stop()
         shutdown.uninstall()
+        # Best-effort artifact on abort paths (collective-free: a peer
+        # that died never reaches an allgather). No-op when finalize
+        # already ran above or no sink is configured; in multihost only
+        # process 0 writes (the sinks are its paths).
+        try:
+            write_here = (not args.multihost) or mh.is_primary()
+        except Exception:  # a torn runtime must not mask the real error
+            write_here = False
+        if write_here:
+            telem.finalize_local(summary)
 
     return 0
 

@@ -1,0 +1,262 @@
+"""``sartsolve metrics`` — validate, summarize and diff run artifacts.
+
+Dispatched by ``sartsolver_tpu.cli.main`` before the solver's flat
+argument parser runs (like ``sartsolve lint``). Three modes:
+
+- ``sartsolve metrics RUN.jsonl`` — validate against the obs schema and
+  print a human summary (frames by status, solve-ms stats, counters,
+  events);
+- ``sartsolve metrics --check RUN.jsonl`` — validation only (the CI /
+  ``make obs`` gate); exit 1 on any schema violation;
+- ``sartsolve metrics --diff OLD.jsonl NEW.jsonl`` — per-metric deltas
+  between two artifacts (the hook BENCH regression tooling consumes);
+  ``--threshold PCT`` additionally exits 2 on a regression past PCT
+  percent — mean frame solve-ms going UP for run artifacts, the bench
+  headline value going DOWN for BENCH artifacts (it is a rate).
+
+Exit codes: 0 ok; 1 invalid input (unreadable file, schema violations);
+2 ``--diff --threshold`` regression detected.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from sartsolver_tpu.obs import schema
+
+
+def build_metrics_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sartsolve metrics",
+        description="Validate, summarize and diff metrics artifacts "
+                    "(JSONL, docs/OBSERVABILITY.md). BENCH_*.json single-"
+                    "record artifacts validate too (shared schema).",
+    )
+    p.add_argument("artifacts", nargs="*", metavar="FILE",
+                   help="Metrics JSONL artifact(s); one to summarize, "
+                        "two with --diff.")
+    p.add_argument("--check", action="store_true",
+                   help="Validate only (no summary); exit 1 on any "
+                        "schema violation.")
+    p.add_argument("--diff", action="store_true",
+                   help="Compare two artifacts: frame outcomes and "
+                        "per-metric deltas.")
+    p.add_argument("--threshold", type=float, default=None, metavar="PCT",
+                   help="With --diff: exit 2 if mean frame solve-ms "
+                        "regressed by more than PCT percent.")
+    p.add_argument("--json", dest="json_", action="store_true",
+                   help="Machine-readable output.")
+    return p
+
+
+def _load(path: str) -> Tuple[List[dict], List[str]]:
+    """Validate + load one artifact in a single read/parse pass. An
+    artifact that opens with a ``meta`` record claims to be a full run
+    artifact and is held to the run contract (meta first, metrics
+    present, summary consistent); anything else — e.g. a single-record
+    BENCH file — only needs every record individually valid."""
+    try:
+        numbered, errors = schema.load_jsonl(path)
+    except OSError as err:
+        return [], [str(err)]
+    records = [rec for _, rec in numbered if isinstance(rec, dict)]
+    require_run = bool(records) and records[0].get("type") == "meta"
+    errors = errors + schema.validate_records(
+        numbered, require_run=require_run
+    )
+    return records, errors
+
+
+def _stats(values: List[float]) -> Dict[str, float]:
+    if not values:
+        return {}
+    ordered = sorted(values)
+    return {
+        "count": len(values),
+        "mean": sum(values) / len(values),
+        "p50": ordered[len(ordered) // 2],
+        "min": ordered[0],
+        "max": ordered[-1],
+    }
+
+
+def summarize(records: List[dict]) -> dict:
+    frames = [r for r in records if r.get("type") == "frame"]
+    events = [r for r in records if r.get("type") == "event"]
+    metric_recs = [r for r in records if r.get("type") == "metric"]
+    bench = [r for r in records if r.get("type") == "bench"]
+    by_status: Dict[str, int] = {}
+    for fr in frames:
+        by_status[fr["status_name"]] = by_status.get(fr["status_name"], 0) + 1
+    out = {
+        "frames": len(frames),
+        "by_status": by_status,
+        "solve_ms": _stats([f["solve_ms"] for f in frames
+                            if f.get("solve_ms") is not None]),
+        "iterations": _stats([float(f["iterations"]) for f in frames
+                              if f.get("iterations", -1) >= 0]),
+        "events": [e["message"] for e in events],
+        "counters": {
+            _metric_key(m): m["value"] for m in metric_recs
+            if m["kind"] == "counter"
+        },
+        "gauges": {
+            _metric_key(m): m["value"] for m in metric_recs
+            if m["kind"] == "gauge"
+        },
+    }
+    if bench:
+        out["bench"] = {
+            "metric": bench[0]["metric"], "value": bench[0]["value"],
+            "vs_baseline": bench[0]["vs_baseline"],
+        }
+    return out
+
+
+def _metric_key(m: dict) -> str:
+    labels = m.get("labels") or {}
+    if not labels:
+        return m["name"]
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{m['name']}{{{inner}}}"
+
+
+def _print_summary(path: str, summary: dict) -> None:
+    print(f"{path}: {summary['frames']} frame(s)")
+    if summary["by_status"]:
+        parts = ", ".join(f"{n} {s}" for s, n in
+                          sorted(summary["by_status"].items()))
+        print(f"  statuses: {parts}")
+    if summary["solve_ms"]:
+        s = summary["solve_ms"]
+        print(f"  solve ms: mean {s['mean']:.2f}, p50 {s['p50']:.2f}, "
+              f"min {s['min']:.2f}, max {s['max']:.2f}")
+    if summary["iterations"]:
+        s = summary["iterations"]
+        print(f"  iterations: mean {s['mean']:.1f}, max {s['max']:.0f}")
+    for key, value in summary["counters"].items():
+        print(f"  counter {key} = {value:g}")
+    for key, value in summary["gauges"].items():
+        print(f"  gauge {key} = {value:g}")
+    for message in summary["events"]:
+        print(f"  event: {message}")
+    if "bench" in summary:
+        b = summary["bench"]
+        print(f"  bench {b['metric']}: {b['value']:g} "
+              f"(vs_baseline {b['vs_baseline']:g})")
+
+
+def diff(old: dict, new: dict) -> dict:
+    """Structured comparison of two artifact summaries."""
+    out: dict = {"frames": {"old": old["frames"], "new": new["frames"]},
+                 "by_status": {}, "metrics": {}}
+    for status in sorted(set(old["by_status"]) | set(new["by_status"])):
+        a = old["by_status"].get(status, 0)
+        b = new["by_status"].get(status, 0)
+        if a != b:
+            out["by_status"][status] = {"old": a, "new": b}
+    for scope in ("counters", "gauges"):
+        for key in sorted(set(old[scope]) | set(new[scope])):
+            a = old[scope].get(key)
+            b = new[scope].get(key)
+            if a != b:
+                out["metrics"][key] = {"old": a, "new": b}
+    solve_pct = None
+    if old["solve_ms"] and new["solve_ms"] and old["solve_ms"]["mean"] > 0:
+        solve_pct = 100.0 * (new["solve_ms"]["mean"]
+                             / old["solve_ms"]["mean"] - 1.0)
+    out["solve_ms_mean_pct"] = solve_pct
+    # bench headline delta (BENCH_*.json artifacts): value is a rate
+    # (iterations/sec), so a DROP is the regression direction — the
+    # opposite sign convention from solve_ms
+    bench_pct = None
+    if "bench" in old and "bench" in new and old["bench"]["value"] > 0:
+        bench_pct = 100.0 * (new["bench"]["value"]
+                             / old["bench"]["value"] - 1.0)
+        out["bench"] = {"metric": new["bench"]["metric"],
+                        "old": old["bench"]["value"],
+                        "new": new["bench"]["value"]}
+    out["bench_value_pct"] = bench_pct
+    return out
+
+
+def metrics_main(argv: Optional[List[str]] = None) -> int:
+    args = build_metrics_parser().parse_args(argv)
+    expected = 2 if args.diff else 1
+    if len(args.artifacts) != expected:
+        print(f"sartsolve metrics: expected {expected} artifact path(s), "
+              f"got {len(args.artifacts)} (see --help).", file=sys.stderr)
+        return 1
+    if args.threshold is not None and not args.diff:
+        print("sartsolve metrics: --threshold needs --diff.",
+              file=sys.stderr)
+        return 1
+
+    loaded = []
+    ok = True
+    for path in args.artifacts:
+        records, errors = _load(path)
+        for e in errors:
+            print(f"{path}: {e}", file=sys.stderr)
+        if errors:
+            ok = False
+        loaded.append(records)
+    if not ok:
+        return 1
+
+    if args.check:
+        if not args.json_:
+            for path, records in zip(args.artifacts, loaded):
+                print(f"{path}: ok ({len(records)} record(s))")
+        else:
+            print(json.dumps({"ok": True, "records":
+                              [len(r) for r in loaded]}))
+        return 0
+
+    if args.diff:
+        old, new = (summarize(r) for r in loaded)
+        delta = diff(old, new)
+        if args.json_:
+            print(json.dumps(delta, indent=1))
+        else:
+            print(f"frames: {delta['frames']['old']} -> "
+                  f"{delta['frames']['new']}")
+            for status, d in delta["by_status"].items():
+                print(f"  status {status}: {d['old']} -> {d['new']}")
+            for key, d in delta["metrics"].items():
+                print(f"  {key}: {d['old']} -> {d['new']}")
+            if delta["solve_ms_mean_pct"] is not None:
+                print(f"  mean solve ms: {old['solve_ms']['mean']:.2f} -> "
+                      f"{new['solve_ms']['mean']:.2f} "
+                      f"({delta['solve_ms_mean_pct']:+.1f}%)")
+            if delta["bench_value_pct"] is not None:
+                print(f"  bench {delta['bench']['metric']}: "
+                      f"{delta['bench']['old']:g} -> "
+                      f"{delta['bench']['new']:g} "
+                      f"({delta['bench_value_pct']:+.1f}%)")
+        if args.threshold is not None:
+            # regression directions differ by metric: solve_ms is a cost
+            # (up = worse), the bench headline is a rate (down = worse)
+            if (delta["solve_ms_mean_pct"] is not None
+                    and delta["solve_ms_mean_pct"] > args.threshold):
+                print(f"sartsolve metrics: mean solve-ms regression "
+                      f"{delta['solve_ms_mean_pct']:+.1f}% exceeds the "
+                      f"{args.threshold:g}% threshold.", file=sys.stderr)
+                return 2
+            if (delta["bench_value_pct"] is not None
+                    and delta["bench_value_pct"] < -args.threshold):
+                print(f"sartsolve metrics: bench value regression "
+                      f"{delta['bench_value_pct']:+.1f}% exceeds the "
+                      f"{args.threshold:g}% threshold.", file=sys.stderr)
+                return 2
+        return 0
+
+    summary = summarize(loaded[0])
+    if args.json_:
+        print(json.dumps(summary, indent=1))
+    else:
+        _print_summary(args.artifacts[0], summary)
+    return 0

@@ -1,0 +1,228 @@
+"""Metrics registry: counters, gauges, histograms (host-side, stdlib-only).
+
+The vocabulary is deliberately tiny — the three instrument kinds every
+metrics system shares — so one registry can back all of: the ``--timing``
+phase summary (:class:`~sartsolver_tpu.utils.timing.PhaseTimer` is a view
+over ``phase_seconds`` histograms), the ``--metrics_out`` JSONL artifact,
+the ``SART_METRICS_PROM`` Prometheus textfile, and the multi-host
+end-of-run aggregation (:func:`merge_snapshots` defines how each kind
+combines across hosts: counters sum, gauges keep the max, histograms
+merge their moments).
+
+Instruments are identified by ``(name, labels)``; handles are cached, so
+hot callers (the prefetch worker, the async writer) look their instrument
+up once at construction and pay one lock + one float update per event
+afterwards. Registration order is preserved — snapshots list instruments
+first-registered-first, which is what gives the phase summary its stable
+insertion ordering; instruments present only on a *remote* host are
+appended in name order during a merge (insertion-then-name).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Tuple
+
+
+def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Instrument:
+    kind = "instrument"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        self.name = name
+        self.labels = {str(k): str(v) for k, v in labels.items()}
+        self._lock = threading.Lock()
+
+    def snapshot(self) -> dict:
+        raise NotImplementedError
+
+    def merge(self, snap: dict) -> None:
+        raise NotImplementedError
+
+
+class Counter(_Instrument):
+    """Monotonically increasing count (events, bytes, frames)."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        super().__init__(name, labels)
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("Counters only go up; use a Gauge.")
+        with self._lock:
+            self.value += amount
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "name": self.name, "labels": self.labels,
+                "value": self.value}
+
+    def merge(self, snap: dict) -> None:
+        with self._lock:
+            self.value += float(snap["value"])
+
+
+class Gauge(_Instrument):
+    """Last-set value (queue depths, ladder level)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        super().__init__(name, labels)
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def set_max(self, value: float) -> None:
+        """High-water-mark update (queue-depth peaks): only raises the
+        gauge. Submit-side-only ``set`` calls would leave the last
+        enqueue's depth as the reported value — arbitrary, not the
+        peak."""
+        value = float(value)
+        with self._lock:
+            if value > self.value:
+                self.value = value
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "name": self.name, "labels": self.labels,
+                "value": self.value}
+
+    def merge(self, snap: dict) -> None:
+        # cross-host combine: the max is the conservative headline for
+        # every gauge this package exports (deepest queue, highest ladder)
+        with self._lock:
+            self.value = max(self.value, float(snap["value"]))
+
+
+class Histogram(_Instrument):
+    """Distribution summary: count / sum / min / max.
+
+    Moments only (no buckets): enough for the phase summary, the artifact
+    and a Prometheus summary-style export, and moments merge exactly
+    across hosts — bucket layouts would have to agree fleet-wide.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, labels: Dict[str, str]):
+        super().__init__(name, labels)
+        self.count = 0
+        self.sum = 0.0
+        self.min: Optional[float] = None
+        self.max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "name": self.name, "labels": self.labels,
+                "count": self.count, "sum": self.sum,
+                "min": self.min, "max": self.max}
+
+    def merge(self, snap: dict) -> None:
+        with self._lock:
+            self.count += int(snap["count"])
+            self.sum += float(snap["sum"])
+            for attr, pick in (("min", min), ("max", max)):
+                theirs = snap.get(attr)
+                if theirs is None:
+                    continue
+                mine = getattr(self, attr)
+                setattr(self, attr,
+                        theirs if mine is None else pick(mine, theirs))
+
+
+_KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+
+class MetricsRegistry:
+    """Thread-safe, insertion-ordered instrument store."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # dict preserves insertion order — the snapshot/summary ordering
+        self._instruments: Dict[Tuple[str, str, tuple], _Instrument] = {}
+
+    def _get(self, cls, name: str, labels: Dict[str, str]) -> _Instrument:
+        key = (cls.kind, name, _label_key(labels))
+        inst = self._instruments.get(key)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.get(key)
+                if inst is None:
+                    inst = cls(name, labels)
+                    self._instruments[key] = inst
+        elif not isinstance(inst, cls):  # pragma: no cover - keyed by kind
+            raise TypeError(
+                f"{name} already registered as {inst.kind}, not {cls.kind}"
+            )
+        return inst
+
+    def counter(self, name: str, **labels: str) -> Counter:
+        return self._get(Counter, name, labels)
+
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        return self._get(Gauge, name, labels)
+
+    def histogram(self, name: str, **labels: str) -> Histogram:
+        return self._get(Histogram, name, labels)
+
+    def snapshot(self) -> List[dict]:
+        """Instrument states in registration order (JSON-serializable)."""
+        with self._lock:
+            instruments = list(self._instruments.values())
+        return [inst.snapshot() for inst in instruments]
+
+    def merge_snapshot(self, snapshot: Iterable[dict]) -> None:
+        """Fold another registry's snapshot into this one (multi-host
+        aggregation): counters sum, gauges max, histograms merge moments.
+        Instruments unknown locally are appended — in name order, after
+        every locally-registered one (insertion-then-name)."""
+        foreign = [dict(s) for s in snapshot]
+        foreign.sort(key=lambda s: (s["name"], _label_key(s["labels"])))
+        for snap in foreign:
+            cls = _KINDS[snap["kind"]]
+            inst = self._get(cls, snap["name"], snap["labels"])
+            if inst.kind == "gauge" and inst.value == 0:
+                # merging into a never-set gauge: adopt the value (the
+                # max-combine would clamp negatives at the fresh 0);
+                # counter/histogram merges into a fresh instrument are
+                # already identity operations
+                inst.set(float(snap["value"]))
+            else:
+                inst.merge(snap)
+
+
+# Process-wide default registry. The CLI resets it at the start of every
+# run (like reset_retry_stats) so artifacts account one run, not the
+# process lifetime; library modules grab handles from it lazily.
+_default = MetricsRegistry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    return _default
+
+
+def reset_registry() -> MetricsRegistry:
+    """Swap in a fresh default registry (per-run accounting) and return
+    it. Handles cached from the old registry keep working — they just
+    accumulate into an object nothing reads anymore — so a reset can
+    never corrupt a concurrent writer; per-run components cache their
+    handles after the CLI's reset."""
+    global _default
+    with _default_lock:
+        _default = MetricsRegistry()
+    return _default

@@ -1,0 +1,121 @@
+"""Pluggable artifact sinks: JSONL, Prometheus textfile, Chrome trace.
+
+All three write once, at end of run (the frame loop never blocks on a
+sink), and failures are the caller's to map — the CLI treats a sink
+write like any output write (stderr note; a metrics artifact is not
+worth killing a completed run over, see ``RunTelemetry.finalize``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Iterable, List
+
+
+class JsonlSink:
+    """``--metrics_out``: one schema record per line."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, records: Iterable[dict]) -> None:
+        with open(self.path, "w") as f:
+            for rec in records:
+                f.write(json.dumps(rec) + "\n")
+
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+_PROM_LABEL = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str, suffix: str = "") -> str:
+    return "sart_" + _PROM_NAME.sub("_", name) + suffix
+
+
+def _prom_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    def esc(value: str) -> str:
+        return str(value).replace("\\", "\\\\").replace('"', '\\"')
+    items = ",".join(
+        f'{_PROM_LABEL.sub("_", k)}="{esc(v)}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + items + "}"
+
+
+def render_prometheus(snapshot: Iterable[dict]) -> str:
+    """Prometheus text exposition of a registry snapshot.
+
+    Counters/gauges map directly; histograms export summary-style
+    ``_count``/``_sum``/``_min``/``_max`` series (moments, no buckets —
+    obs/metrics.py docstring). Samples are grouped by metric family
+    first (first-registration order), not emitted in raw registry order:
+    label-sets of one family registered at different times (e.g. a
+    ``failed`` status appearing mid-run) must still form one contiguous
+    block under a single ``# TYPE`` line — the exposition-format rule
+    strict scrapers enforce.
+    """
+    families: dict = {}  # name -> [line, ...], insertion-ordered
+    typed: dict = {}
+
+    def emit(name: str, mtype: str, labels: dict, value) -> None:
+        if value is None:
+            return
+        if name not in typed:
+            typed[name] = mtype
+            families[name] = [f"# TYPE {name} {mtype}"]
+        families[name].append(
+            f"{name}{_prom_labels(labels)} {float(value):g}"
+        )
+
+    for snap in snapshot:
+        kind, labels = snap["kind"], snap["labels"]
+        if kind == "counter":
+            emit(_prom_name(snap["name"], "_total")
+                 if not snap["name"].endswith("_total")
+                 else _prom_name(snap["name"]),
+                 "counter", labels, snap["value"])
+        elif kind == "gauge":
+            emit(_prom_name(snap["name"]), "gauge", labels, snap["value"])
+        elif kind == "histogram":
+            base = _prom_name(snap["name"])
+            emit(base + "_count", "counter", labels, snap["count"])
+            emit(base + "_sum", "counter", labels, snap["sum"])
+            emit(base + "_min", "gauge", labels, snap["min"])
+            emit(base + "_max", "gauge", labels, snap["max"])
+    lines: List[str] = [
+        line for family in families.values() for line in family
+    ]
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+class PromSink:
+    """``SART_METRICS_PROM``: Prometheus textfile export.
+
+    Written to a temp file then renamed — the node-exporter textfile
+    collector reads at arbitrary instants, and rename is the one atomic
+    publish primitive it documents.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, snapshot: Iterable[dict]) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(render_prometheus(snapshot))
+        os.replace(tmp, self.path)
+
+
+class ChromeTraceSink:
+    """``SART_TRACE_EVENTS``: Chrome trace-event JSON (Perfetto)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def write(self, buffer) -> None:
+        buffer.close_open_spans()
+        buffer.write_json(self.path)

@@ -1,0 +1,211 @@
+"""Trace spans: the watchdog beacon stream + explicit ``span()`` blocks.
+
+Two event sources, one buffer:
+
+- **Beacons** — resilience/watchdog.py already instruments every host
+  phase of the pipeline (prefetch, device.put, solve.dispatch,
+  result.fetch, io.flush, frame.done) with progress beacons. When a
+  trace sink is active this module taps that stream
+  (:func:`watchdog.set_beacon_tap`): each beacon closes the previous
+  phase span of its thread and opens the next, so the existing
+  instrumentation yields a complete per-thread phase timeline for free.
+- **Spans** — :func:`span` wraps host work that has a natural duration
+  (RTM ingest, a frame-group write, a lazy device fetch) in an explicit
+  begin/end pair, with optional key=value args carried into the event.
+
+The buffer renders to Chrome trace-event JSON (``ph: "X"`` complete
+events, microsecond timestamps) loadable in Perfetto / chrome://tracing
+alongside ``--profile_dir`` XLA traces.
+
+Cost model: with no buffer installed (the default) a beacon pays one
+module-global ``None`` check and ``span()`` returns a shared no-op
+context manager — nothing is recorded, nothing allocated per call. The
+CLI installs a buffer only when ``SART_TRACE_EVENTS`` is set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path."""
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    def __init__(self, buffer: "TraceBuffer", name: str, cat: str,
+                 args: Dict[str, object]):
+        self._buffer = buffer
+        self._name = name
+        self._cat = cat
+        self._args = args
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._buffer.add_complete(
+            self._name, self._cat, self._t0,
+            time.perf_counter() - self._t0,
+            threading.get_ident(), self._args,
+        )
+
+
+class TraceBuffer:
+    """Thread-safe in-memory store of trace events.
+
+    Bounded: a long run emits ~6 beacon events per frame, and an
+    unbounded buffer would turn the trace sink into exactly the host
+    memory pressure the resilience layer guards against. Past
+    ``max_events`` (default 1e6, ~hundreds of MB worst case; env
+    ``SART_TRACE_MAX_EVENTS``) new events are dropped and counted — the
+    trace keeps its *head* (ingest, compile, steady-state onset: the
+    part that attributes a slow run) and the export records how many
+    tail events were dropped.
+    """
+
+    def __init__(self, max_events: Optional[int] = None) -> None:
+        self._lock = threading.Lock()
+        self._events: List[dict] = []
+        self._epoch = time.perf_counter()
+        self._max = max_events if max_events is not None else int(
+            os.environ.get("SART_TRACE_MAX_EVENTS", "1000000")
+        )
+        self._dropped = 0
+        # per-thread open phase span from the beacon stream:
+        # ident -> (phase, perf_counter at its beacon)
+        self._open: Dict[int, Tuple[str, float]] = {}
+
+    def _us(self, t: float) -> float:
+        return (t - self._epoch) * 1e6
+
+    def _append_locked(self, event: dict) -> None:
+        if len(self._events) >= self._max:
+            self._dropped += 1
+            return
+        self._events.append(event)
+
+    def add_complete(self, name: str, cat: str, start: float, dur: float,
+                     tid: int, args: Optional[Dict[str, object]] = None
+                     ) -> None:
+        event = {"name": name, "cat": cat, "ph": "X", "pid": os.getpid(),
+                 "tid": tid, "ts": self._us(start), "dur": dur * 1e6}
+        if args:
+            event["args"] = dict(args)
+        with self._lock:
+            self._append_locked(event)
+
+    def add_instant(self, name: str, cat: str, tid: int,
+                    args: Optional[Dict[str, object]] = None) -> None:
+        event = {"name": name, "cat": cat, "ph": "i", "s": "t",
+                 "pid": os.getpid(), "tid": tid,
+                 "ts": self._us(time.perf_counter())}
+        if args:
+            event["args"] = dict(args)
+        with self._lock:
+            self._append_locked(event)
+
+    def beacon(self, phase: str, serial: int, _t: float, ident: int) -> None:
+        """Beacon-tap target: fold the watchdog's phase stream into
+        per-thread phase spans. The beacon's own monotonic clock is not
+        reused — spans need perf_counter deltas on this buffer's epoch —
+        a beacon marks "phase X starts now", which is also "previous
+        phase of this thread ends now"."""
+        now = time.perf_counter()
+        with self._lock:
+            prev = self._open.get(ident)
+            if prev is not None:
+                name, t0 = prev
+                self._append_locked({
+                    "name": name, "cat": "beacon", "ph": "X",
+                    "pid": os.getpid(), "tid": ident,
+                    "ts": self._us(t0), "dur": (now - t0) * 1e6,
+                })
+            self._open[ident] = (phase, now)
+
+    def close_open_spans(self) -> None:
+        """Flush still-open per-thread phase spans (end-of-run)."""
+        now = time.perf_counter()
+        with self._lock:
+            for ident, (name, t0) in self._open.items():
+                self._append_locked({
+                    "name": name, "cat": "beacon", "ph": "X",
+                    "pid": os.getpid(), "tid": ident,
+                    "ts": self._us(t0), "dur": (now - t0) * 1e6,
+                })
+            self._open.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def to_chrome(self) -> dict:
+        """Chrome trace-event JSON object (Perfetto-loadable)."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        other = {"tool": "sartsolve", "pid": os.getpid()}
+        if dropped:
+            other["dropped_events"] = dropped
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": other,
+        }
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome(), f)
+
+
+# Module-global active buffer; None = tracing disabled (the default).
+_buffer: Optional[TraceBuffer] = None
+
+
+def active_buffer() -> Optional[TraceBuffer]:
+    return _buffer
+
+
+def install(buffer: TraceBuffer) -> TraceBuffer:
+    """Activate ``buffer`` and tap the watchdog beacon stream into it."""
+    global _buffer
+    _buffer = buffer
+    from sartsolver_tpu.resilience import watchdog
+
+    watchdog.set_beacon_tap(buffer.beacon)
+    return buffer
+
+
+def uninstall() -> None:
+    global _buffer
+    _buffer = None
+    from sartsolver_tpu.resilience import watchdog
+
+    watchdog.set_beacon_tap(None)
+
+
+def span(name: str, cat: str = "host", **args):
+    """Context manager recording ``name`` as a complete trace event.
+
+    Returns a shared no-op object when tracing is disabled — safe (and
+    cheap) to leave in production code paths, like the beacons.
+    """
+    buf = _buffer
+    if buf is None:
+        return _NULL_SPAN
+    return _Span(buf, name, cat, args)

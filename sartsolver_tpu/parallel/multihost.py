@@ -231,7 +231,16 @@ def _read_stripe_retried(
             dtype=np.float32, **kwargs,
         )
 
-    return retry_call(attempt, site=faults.SITE_RTM_INGEST)
+    stripe = retry_call(attempt, site=faults.SITE_RTM_INGEST)
+    # telemetry: exactly the bytes this stripe read off the filesystem —
+    # every RTM read (dense or sparse, plain or two-pass int8 ingest)
+    # funnels through here, so no padding and no pass is miscounted
+    from sartsolver_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.get_registry().counter(
+        "bytes_ingested_total", source="rtm"
+    ).inc(stripe.nbytes)
+    return stripe
 
 
 def read_and_shard_rtm(

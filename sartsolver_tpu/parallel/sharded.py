@@ -67,15 +67,17 @@ def _stage(host_array, mesh, spec) -> jax.Array:
     staging failure (device OOM, a preempted/hung device runtime) is a
     per-solve-call hazard the CLI's frame isolation absorbs into FAILED
     frames."""
+    from sartsolver_tpu.obs import trace as obs_trace
     from sartsolver_tpu.resilience import faults, watchdog
 
     watchdog.beacon(watchdog.PHASE_STAGE)  # staging-phase progress beacon
     faults.fire(faults.SITE_DEVICE_PUT)
-    if jax.process_count() == 1:
-        return jax.device_put(host_array, NamedSharding(mesh, spec))
-    from sartsolver_tpu.parallel.multihost import make_global
+    with obs_trace.span("device.put"):
+        if jax.process_count() == 1:
+            return jax.device_put(host_array, NamedSharding(mesh, spec))
+        from sartsolver_tpu.parallel.multihost import make_global
 
-    return make_global(np.asarray(host_array), mesh, spec)
+        return make_global(np.asarray(host_array), mesh, spec)
 
 
 def _fetch(x) -> np.ndarray:
@@ -140,12 +142,14 @@ class DeviceSolveResult:
         as fp32 exactly: status (0/-1) and iterations (<= 2000) are small
         integers; convergence was computed in the device dtype."""
         if self._scalars is None:
+            from sartsolver_tpu.obs import trace as obs_trace
             from sartsolver_tpu.resilience import watchdog
 
             # result-fetch beacon: this D2H blocks until the device work
             # completed — the watchdog's canary for a wedged runtime
             watchdog.beacon(watchdog.PHASE_FETCH)
-            packed = np.asarray(self._packed)
+            with obs_trace.span("result.fetch", what="scalars"):
+                packed = np.asarray(self._packed)
             self._scalars = (
                 packed[0].astype(np.int32),
                 packed[1].astype(np.int32),
@@ -171,10 +175,12 @@ class DeviceSolveResult:
         synchronous path (and the reference's D2H-then-multiply,
         sartsolver_cuda.cpp:264-265)."""
         if self._host is None:
+            from sartsolver_tpu.obs import trace as obs_trace
             from sartsolver_tpu.resilience import watchdog
 
             watchdog.beacon(watchdog.PHASE_FETCH)
-            sol = np.asarray(self._solution_fetch).astype(np.float64)
+            with obs_trace.span("result.fetch", what="solution"):
+                sol = np.asarray(self._solution_fetch).astype(np.float64)
             self._host = (
                 sol[:, : self._solver.nvoxel] * self.norms[:, None]
             )

@@ -69,6 +69,14 @@ class GroupSizeLadder:
         self.size = int(size)
         self.events: List[Tuple[int, int]] = []  # (from, to) per halving
         self._on_event = on_event
+        # telemetry (obs/metrics.py): the current ladder level as a gauge
+        # plus one counter tick per halving — host-side bookkeeping only
+        from sartsolver_tpu.obs import metrics as obs_metrics
+
+        registry = obs_metrics.get_registry()
+        self._size_gauge = registry.gauge("frame_group_size")
+        self._oom_counter = registry.counter("oom_degradations_total")
+        self._size_gauge.set(self.size)
 
     @property
     def degraded(self) -> bool:
@@ -90,6 +98,8 @@ class GroupSizeLadder:
                 f"{new} — the reduction sticks for the rest of the run"
             )
         self.size = new
+        self._size_gauge.set(new)
+        self._oom_counter.inc()
         return True
 
     def summary(self) -> Optional[str]:
@@ -121,11 +131,13 @@ def dispatch_guarded(
     OOM with the ladder exhausted or absent — propagates unchanged, so
     the caller's isolation semantics are exactly the unwrapped ones.
     """
+    from sartsolver_tpu.obs import trace as obs_trace
     from sartsolver_tpu.resilience import watchdog
 
     watchdog.beacon(watchdog.PHASE_DISPATCH)
     try:
-        return dispatch(), None
+        with obs_trace.span("solve.dispatch"):
+            return dispatch(), None
     except Exception as err:
         if (
             ladder is not None

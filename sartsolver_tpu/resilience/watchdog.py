@@ -89,6 +89,18 @@ PHASE_FRAME_DONE = "frame.done"
 # swap keeps readers consistent without a lock.
 _last: Tuple[str, int, float, int] = ("start", 0, 0.0, 0)
 _serial = 0
+# completed frames this run (the heartbeat file's progress counter) and
+# the last *work* phase (any beacon that is not the frame-done tick) — a
+# supervisor reading the heartbeat wants "where is it", and at write time
+# the most recent beacon is always frame.done itself
+_frames_done = 0
+_last_work_phase = "start"
+
+# Observability tap (obs/trace.py): when a trace sink is active, every
+# beacon is mirrored into the trace buffer as a phase span. One global
+# None-check when disabled — beacons stay nanoseconds, and NOTHING here
+# is ever traced (the compile-audit goldens pin that).
+_tap: Optional[Callable[[str, int, float, int], None]] = None
 
 # Threads that volunteered for async interruption (prefetcher / async
 # writer workers — they catch the exception and degrade their stream).
@@ -96,20 +108,45 @@ _serial = 0
 _interruptible: "weakref.WeakSet[threading.Thread]" = weakref.WeakSet()
 
 
+def set_beacon_tap(
+    tap: Optional[Callable[[str, int, float, int], None]]
+) -> None:
+    """Install (or with None remove) the beacon observer. The tap must be
+    cheap and exception-free — it runs inside every beacon."""
+    global _tap
+    _tap = tap
+
+
+def frames_done() -> int:
+    """Frames completed (``frame.done`` beacons) since process start."""
+    return _frames_done
+
+
 def beacon(phase: str) -> None:
     """Announce the start of host-side work in ``phase``.
 
     Called from multiple threads; always recorded (so a watchdog can
     attach mid-run), costs one clock read + tuple assignment when no
-    heartbeat file is configured.
+    heartbeat file or trace tap is configured.
     """
-    global _last, _serial
+    global _last, _serial, _frames_done, _last_work_phase
     _serial += 1
-    _last = (phase, _serial, time.monotonic(), threading.get_ident())
+    now = time.monotonic()
+    ident = threading.get_ident()
+    _last = (phase, _serial, now, ident)
     if phase == PHASE_FRAME_DONE:
+        _frames_done += 1
         path = os.environ.get("SART_HEARTBEAT_FILE")
         if path:
-            _touch(path)
+            _write_heartbeat(path)
+    else:
+        _last_work_phase = phase
+    tap = _tap
+    if tap is not None:
+        try:
+            tap(phase, _serial, now, ident)
+        except Exception:  # observability must never hurt the run
+            pass
 
 
 def last_beacon() -> Tuple[str, int, float, int]:
@@ -117,12 +154,28 @@ def last_beacon() -> Tuple[str, int, float, int]:
     return _last
 
 
-def _touch(path: str) -> None:
-    """Touch the heartbeat file; advisory, so failures never hurt the run."""
+def _write_heartbeat(path: str) -> None:
+    """Write progress state into the heartbeat file (advisory: failures
+    never hurt the run).
+
+    The file carries WHERE the run is, not just that it is alive: the
+    last pipeline phase that ran before this frame completed, the
+    completed-frame counter and the beacon serial, one ``key=value`` line
+    an external supervisor can parse without any schema machinery. The
+    mtime contract is unchanged — still one touch per completed frame —
+    so ``find -mmin``-style liveness probes keep working. Published via
+    temp-file + rename: the supervisor reads at arbitrary instants, and
+    an in-place truncating write would expose an empty/partial file
+    between the truncate and the write.
+    """
     try:
-        with open(path, "a"):
-            pass
-        os.utime(path, None)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(
+                f"phase={_last_work_phase} frames={_frames_done} "
+                f"serial={_serial} unix={time.time():.3f}\n"
+            )
+        os.replace(tmp, path)
     except OSError:
         pass
 

@@ -31,6 +31,8 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience.failures import OutputWriteError
 
 
@@ -51,6 +53,11 @@ class AsyncSolutionWriter:
             raise ValueError("max_pending must be positive.")
         self._writer = writer
         self._queue: "queue.Queue[Optional[tuple]]" = queue.Queue(maxsize=max_pending)
+        # telemetry handles resolved once; one locked update per frame
+        registry = obs_metrics.get_registry()
+        self._depth_gauge = registry.gauge("writer_queue_depth")
+        self._frames_counter = registry.counter("frames_written_total")
+        self._bytes_counter = registry.counter("bytes_written_total")
         self._error: Optional[BaseException] = None
         self._closed = False
         self._thread = threading.Thread(target=self._worker, daemon=True)
@@ -80,12 +87,16 @@ class AsyncSolutionWriter:
                 continue  # latched: drain every later frame, write none
             try:
                 solution, *rest = item
-                if callable(solution):
-                    # lazy solution (e.g. a DeviceSolveResult fetcher): the
-                    # device->host transfer runs HERE, overlapped with the
-                    # main thread's next solve
-                    solution = np.array(solution(), np.float64, copy=True)
-                self._writer.add(solution, *rest)
+                with obs_trace.span("write.frame"):
+                    if callable(solution):
+                        # lazy solution (e.g. a DeviceSolveResult fetcher):
+                        # the device->host transfer runs HERE, overlapped
+                        # with the main thread's next solve
+                        solution = np.array(solution(), np.float64,
+                                            copy=True)
+                    self._writer.add(solution, *rest)
+                self._frames_counter.inc()
+                self._bytes_counter.inc(solution.nbytes)
             except BaseException as err:
                 self._error = err
 
@@ -126,6 +137,9 @@ class AsyncSolutionWriter:
                    else np.array(solution, np.float64, copy=True))
         self._queue.put((payload, int(status), float(time),
                          list(camera_time), int(iterations)))
+        # high-water mark: the peak is the backpressure headline; a
+        # plain set would freeze at the last enqueue's depth
+        self._depth_gauge.set_max(self._queue.qsize())
 
     def close(self) -> None:
         if self._closed:

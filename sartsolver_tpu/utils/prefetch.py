@@ -39,6 +39,8 @@ from typing import Iterator, Optional, Tuple
 import numpy as np
 
 from sartsolver_tpu.io.image import CompositeImage
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience import faults, watchdog
 from sartsolver_tpu.resilience.failures import FrameFailure, WatchdogTimeout
 from sartsolver_tpu.resilience.retry import (
@@ -75,6 +77,14 @@ class FramePrefetcher:
         self._policy = retry_policy
         self._queue: "queue.Queue[Optional[Tuple[np.ndarray, float, list]]]" = (
             queue.Queue(maxsize=depth)
+        )
+        # telemetry handles resolved once (obs/metrics.py): the worker
+        # loop then pays one locked float update per frame
+        registry = obs_metrics.get_registry()
+        self._depth_gauge = registry.gauge("prefetch_queue_depth")
+        self._frames_counter = registry.counter("frames_prefetched_total")
+        self._bytes_counter = registry.counter(
+            "bytes_ingested_total", source="frames"
         )
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
@@ -117,7 +127,10 @@ class FramePrefetcher:
                     return
                 watchdog.beacon(watchdog.PHASE_PREFETCH)
                 try:
-                    item = self._read_frame(i)
+                    with obs_trace.span("prefetch.read", frame=i):
+                        item = self._read_frame(i)
+                    self._frames_counter.inc()
+                    self._bytes_counter.inc(item[0].nbytes)
                 except (RetriesExhausted, WatchdogTimeout) as err:
                     # RetriesExhausted: the frame is unreadable;
                     # WatchdogTimeout: the read HUNG and the watchdog
@@ -132,6 +145,9 @@ class FramePrefetcher:
                     )
                 if not self._put(item):
                     return
+                # high-water mark: the peak is the backpressure headline;
+                # a plain set would freeze at the last enqueue's depth
+                self._depth_gauge.set_max(self._queue.qsize())
         except BaseException as err:  # surfaced on the consumer side
             self._error = err
         finally:

@@ -1,0 +1,45 @@
+"""Generate a real --metrics_out artifact from a synthetic world.
+
+Used by ``make obs``: runs the full CLI over the test fixtures with the
+JSONL sink enabled and leaves the artifact at argv[2] (world files under
+argv[1]), so the drill can then run ``sartsolve metrics --check`` /
+summarize against an artifact produced by the actual pipeline, not a
+hand-built one. Exits with the CLI's exit code (0 expected).
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)  # fixtures.py
+sys.path.insert(0, os.path.dirname(_here))  # the repo checkout itself
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import fixtures as fx  # noqa: E402
+from sartsolver_tpu.cli import main  # noqa: E402
+
+
+def run(world_dir: str, artifact: str) -> int:
+    paths, *_ = fx.write_world(world_dir, with_laplacian=True)
+    return main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "300", "-c", "1e-6",
+        "--metrics_out", artifact,
+    ])
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1], sys.argv[2]))

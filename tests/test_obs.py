@@ -1,0 +1,711 @@
+"""Observability layer (sartsolver_tpu/obs, docs/OBSERVABILITY.md):
+schema round-trip, sink outputs (JSONL / Prometheus / Chrome trace),
+fault-path counters, disabled-path identity, multihost aggregation via
+the fake-collectives path, heartbeat content, PhaseTimer-over-registry.
+
+``make obs`` runs exactly this module plus a generated-artifact
+``sartsolve metrics --check`` drill.
+"""
+
+import json
+import os
+import re
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+from sartsolver_tpu.cli import main
+from sartsolver_tpu.obs import metrics, schema, sinks, trace
+from sartsolver_tpu.obs.cli import metrics_main
+from sartsolver_tpu.obs.run import RunTelemetry, aggregate_snapshots
+from sartsolver_tpu.resilience import faults, watchdog
+from sartsolver_tpu.resilience.retry import reset_retry_stats
+from sartsolver_tpu.utils.timing import PhaseTimer
+
+
+@pytest.fixture
+def world(tmp_path):
+    return fx.write_world(tmp_path, with_laplacian=True)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """No armed faults, fast retries, no env sinks leaking between tests."""
+    monkeypatch.setenv("SART_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("SART_RETRY_MAX_DELAY", "0.002")
+    for var in ("SART_METRICS_PROM", "SART_TRACE_EVENTS",
+                "SART_HEARTBEAT_FILE", "SART_FAULT"):
+        monkeypatch.delenv(var, raising=False)
+    faults.clear_faults()
+    reset_retry_stats()
+    yield
+    faults.clear_faults()
+    reset_retry_stats()
+    trace.uninstall()
+
+
+def run_cli(paths, *extra):
+    return main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "300", "-c", "1e-6",
+        *extra,
+    ])
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_instruments_and_labels():
+    r = metrics.MetricsRegistry()
+    r.counter("c", site="a").inc()
+    r.counter("c", site="a").inc(2)
+    r.counter("c", site="b").inc(5)
+    r.gauge("g").set(3)
+    r.gauge("g").set(1)
+    r.histogram("h").observe(2.0)
+    r.histogram("h").observe(4.0)
+    snap = {(s["name"], tuple(sorted(s["labels"].items()))): s
+            for s in r.snapshot()}
+    assert snap[("c", (("site", "a"),))]["value"] == 3
+    assert snap[("c", (("site", "b"),))]["value"] == 5
+    assert snap[("g", ())]["value"] == 1
+    h = snap[("h", ())]
+    assert (h["count"], h["sum"], h["min"], h["max"]) == (2, 6.0, 2.0, 4.0)
+
+
+def test_registry_counter_rejects_negative():
+    with pytest.raises(ValueError):
+        metrics.MetricsRegistry().counter("c").inc(-1)
+
+
+def test_gauge_set_max_is_high_water():
+    g = metrics.MetricsRegistry().gauge("depth")
+    g.set_max(3)
+    g.set_max(1)  # never lowers
+    assert g.value == 3
+
+
+def test_prometheus_families_are_contiguous():
+    """All samples of one metric family must form one block under its
+    single # TYPE line, whatever order label-sets registered in (strict
+    scrapers reject interleaved families)."""
+    r = metrics.MetricsRegistry()
+    r.counter("frames_total", status="converged").inc(3)
+    r.gauge("depth").set(1)
+    r.counter("frames_total", status="failed").inc(1)  # late label-set
+    text = sinks.render_prometheus(r.snapshot())
+    lines = text.splitlines()
+    fam = [i for i, ln in enumerate(lines) if "sart_frames_total" in ln]
+    assert fam == list(range(fam[0], fam[0] + 3))  # TYPE + 2 samples
+    assert lines.count("# TYPE sart_frames_total counter") == 1
+
+
+def test_registry_merge_semantics():
+    a = metrics.MetricsRegistry()
+    a.counter("frames").inc(3)
+    a.gauge("depth").set(2)
+    a.histogram("ms").observe(10.0)
+    b = metrics.MetricsRegistry()
+    b.counter("frames").inc(4)
+    b.gauge("depth").set(5)
+    b.histogram("ms").observe(30.0)
+    b.counter("only_b").inc(1)
+    a.merge_snapshot(b.snapshot())
+    snap = {s["name"]: s for s in a.snapshot()}
+    assert snap["frames"]["value"] == 7  # counters sum
+    assert snap["depth"]["value"] == 5  # gauges max
+    assert snap["ms"]["count"] == 2 and snap["ms"]["max"] == 30.0
+    assert snap["only_b"]["value"] == 1  # remote-only appended
+
+
+def test_reset_registry_swaps_default():
+    metrics.get_registry().counter("stale").inc()
+    fresh = metrics.reset_registry()
+    assert fresh is metrics.get_registry()
+    assert not [s for s in fresh.snapshot() if s["name"] == "stale"]
+
+
+# ---------------------------------------------------------------------------
+# PhaseTimer as a registry view
+# ---------------------------------------------------------------------------
+
+def test_phase_timer_total_and_order():
+    t = PhaseTimer()
+    t.add("zulu", 0.2)  # insertion order must win over name order
+    t.add("alpha", 0.1)
+    t.add("zulu", 0.2)
+    out = t.summary()
+    lines = out.splitlines()
+    assert lines[0] == "timing summary (wall clock):"
+    assert lines[1].strip().startswith("zulu")
+    assert "avg over 2" in lines[1]
+    assert lines[2].strip().startswith("alpha")
+    assert lines[-1].strip().startswith("total")
+    assert "500.0 ms" in lines[-1]
+
+
+def test_phase_timer_is_registry_view():
+    r = metrics.MetricsRegistry()
+    t = PhaseTimer(registry=r)
+    t.add("ingest", 1.5)
+    snap = [s for s in r.snapshot() if s["name"] == "phase_seconds"]
+    assert snap and snap[0]["labels"]["phase"] == "ingest"
+    assert snap[0]["sum"] == pytest.approx(1.5)
+
+
+def test_phase_timer_empty():
+    assert "no phases" in PhaseTimer().summary()
+
+
+def test_phase_timer_detail_rows_excluded_from_total():
+    """Per-frame solve rows lie INSIDE the frame-loop phase; summing
+    them into the total would fabricate wall clock (review finding)."""
+    t = PhaseTimer()
+    t.add("frame loop", 10.0)
+    t.add("solve frame", 8.0, detail=True)
+    out = t.summary()
+    assert "solve frame" in out  # still printed as a row
+    assert out.splitlines()[-1].strip().startswith("total")
+    assert "10000.0 ms" in out.splitlines()[-1]  # not 18000
+
+
+# ---------------------------------------------------------------------------
+# schema round-trip
+# ---------------------------------------------------------------------------
+
+def test_schema_valid_records_roundtrip(tmp_path):
+    records = [
+        schema.make_meta_record(backend="cpu"),
+        schema.make_frame_record(1.5, 0, "converged", 10, 3.2, 1e-6,
+                                 "chain"),
+        schema.make_frame_record(2.5, -3, "failed", -1, None, None,
+                                 "failed", error="InjectedIOError"),
+        schema.make_event_record("watchdog: fired", 1.0),
+        {"type": "metric", "kind": "counter", "name": "frames_total",
+         "labels": {"status": "converged"}, "value": 1.0},
+        {"type": "metric", "kind": "histogram", "name": "ms",
+         "labels": {}, "count": 1, "sum": 2.0, "min": 2.0, "max": 2.0},
+        schema.make_summary_record(2, {"converged": 1, "failed": 1}),
+    ]
+    for rec in records:
+        assert schema.validate_record(rec) == [], rec
+    path = tmp_path / "run.jsonl"
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+    n, errors = schema.validate_jsonl(str(path), require_run=True)
+    assert n == len(records) and errors == []
+
+
+@pytest.mark.parametrize("rec,needle", [
+    ({"type": "nope"}, "unknown record type"),
+    ({"type": "frame", "time": 1.0}, "missing required key"),
+    ({"type": "frame", "time": "x", "status": 0, "status_name": "s",
+      "iterations": 1, "solve_ms": 1.0, "convergence": 1.0,
+      "group": "g"}, "has type str"),
+    ({"type": "metric", "kind": "counter", "name": "n",
+      "labels": {"a": 1}, "value": 1.0}, "strings"),
+    ({"type": "metric", "kind": "exotic", "name": "n", "labels": {}},
+     "unknown metric kind"),
+    ({"type": "meta", "schema": schema.SCHEMA_VERSION + 1, "tool": "t"},
+     "newer than"),
+    ({"type": "frame", "time": 1.0, "status": 0, "status_name": "s",
+      "iterations": 1, "solve_ms": True, "convergence": 1.0,
+      "group": "g"}, "solve_ms"),
+])
+def test_schema_rejects_malformed(rec, needle):
+    errors = schema.validate_record(rec)
+    assert errors and any(needle in e for e in errors), errors
+
+
+def test_validate_jsonl_flags_bad_lines(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text('{"type": "event", "message": "m", "t": 1.0}\n'
+                    "not json at all\n")
+    _, errors = schema.validate_jsonl(str(path))
+    assert len(errors) == 1 and "line 2" in errors[0]
+
+
+def test_run_contract_checks(tmp_path):
+    # meta-first, metric presence, summary/frames consistency
+    path = tmp_path / "run.jsonl"
+    recs = [
+        schema.make_meta_record(),
+        schema.make_frame_record(1.0, 0, "converged", 5, 1.0, 1e-6, "g"),
+        schema.make_summary_record(2, {"converged": 2}),  # wrong count
+    ]
+    path.write_text("".join(json.dumps(r) + "\n" for r in recs))
+    _, errors = schema.validate_jsonl(str(path), require_run=True)
+    assert any("no metric records" in e for e in errors)
+    assert any("summary counts 2" in e for e in errors)
+
+
+# ---------------------------------------------------------------------------
+# CLI end to end: artifact + sinks
+# ---------------------------------------------------------------------------
+
+def test_cli_metrics_out_artifact(world, tmp_path, capsys):
+    paths, H, f_true, times, scales = world
+    artifact = str(tmp_path / "run.jsonl")
+    prom = str(tmp_path / "run.prom")
+    trace_out = str(tmp_path / "run.trace.json")
+    os.environ["SART_METRICS_PROM"] = prom
+    os.environ["SART_TRACE_EVENTS"] = trace_out
+    try:
+        assert run_cli(paths, "--metrics_out", artifact) == 0
+    finally:
+        os.environ.pop("SART_METRICS_PROM", None)
+        os.environ.pop("SART_TRACE_EVENTS", None)
+    err = capsys.readouterr().err
+    assert artifact in err  # the note goes to stderr, never stdout
+
+    # the acceptance contract: --check validates, and every frame record
+    # carries solve wall-ms, iterations, convergence and status
+    assert metrics_main(["--check", artifact]) == 0
+    records = _records(artifact)
+    assert records[0]["type"] == "meta"
+    assert records[0]["mesh"] == "8x1"
+    frames = [r for r in records if r["type"] == "frame"]
+    assert len(frames) == len(times)
+    for fr in frames:
+        assert fr["solve_ms"] > 0
+        assert fr["iterations"] > 0
+        assert fr["convergence"] is not None
+        assert fr["status"] == 0
+    names = {(r["name"], tuple(sorted((r.get("labels") or {}).items())))
+             for r in records if r["type"] == "metric"}
+    assert ("frames_total", (("status", "converged"),)) in names
+    assert ("frame_solve_ms", ()) in names
+    assert ("writer_queue_depth", ()) in names
+    assert ("prefetch_queue_depth", ()) in names
+    assert any(n == "bytes_ingested_total" for n, _ in names)
+    summary = [r for r in records if r["type"] == "summary"]
+    assert len(summary) == 1 and summary[0]["frames"] == len(times)
+
+    # Prometheus textfile
+    prom_text = open(prom).read()
+    assert '# TYPE sart_frames_total counter' in prom_text
+    assert 'sart_frames_total{status="converged"} 4' in prom_text
+
+    # Chrome trace: beacon-fed phase spans + explicit spans, valid JSON
+    tr = json.load(open(trace_out))
+    names = {e["name"] for e in tr["traceEvents"]}
+    assert "ingest.rtm" in names  # explicit span
+    assert watchdog.PHASE_DISPATCH in names  # beacon-fed span
+    assert all("ts" in e and "pid" in e for e in tr["traceEvents"])
+
+
+def test_cli_metrics_summary_and_diff(world, tmp_path, capsys):
+    paths, *_ = world
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    assert run_cli(paths, "--metrics_out", a) == 0
+    assert run_cli(paths, "--metrics_out", b) == 0
+    capsys.readouterr()
+    assert metrics_main([a]) == 0
+    out = capsys.readouterr().out
+    assert "4 frame(s)" in out and "converged" in out and "solve ms" in out
+    assert metrics_main(["--diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "frames: 4 -> 4" in out
+    # an impossible regression threshold trips exit 2
+    rigged = _records(a)
+    for rec in rigged:
+        if rec["type"] == "frame" and rec["solve_ms"]:
+            rec["solve_ms"] *= 100
+    c = str(tmp_path / "c.jsonl")
+    with open(c, "w") as f:
+        f.writelines(json.dumps(r) + "\n" for r in rigged)
+    assert metrics_main(["--diff", "--threshold", "50", a, c]) == 2
+
+
+def test_metrics_check_rejects_corrupt(world, tmp_path, capsys):
+    paths, *_ = world
+    artifact = str(tmp_path / "run.jsonl")
+    assert run_cli(paths, "--metrics_out", artifact) == 0
+    lines = open(artifact).read().splitlines()
+    frame_idx = next(i for i, ln in enumerate(lines) if '"frame"' in ln)
+    broken = json.loads(lines[frame_idx])
+    del broken["iterations"]
+    lines[frame_idx] = json.dumps(broken)
+    with open(artifact, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    assert metrics_main(["--check", artifact]) == 1
+    assert "iterations" in capsys.readouterr().err
+
+
+def test_abort_artifact_is_partial_and_validates(world, tmp_path, capsys):
+    """A run that dies before any metric exists still writes a --check-
+    clean artifact: finalize_local marks it partial, and the validator
+    exempts partial artifacts from the metric-presence requirement."""
+    paths, *_ = world
+    artifact = str(tmp_path / "abort.jsonl")
+    missing = str(tmp_path / "missing.h5")
+    assert main(["-o", str(tmp_path / "out.h5"), missing, paths["img_a"],
+                 "--metrics_out", artifact]) == 1
+    capsys.readouterr()
+    assert metrics_main(["--check", artifact]) == 0
+    records = _records(artifact)
+    assert records[0]["type"] == "meta" and records[0]["partial"] is True
+
+
+def test_diff_bench_artifacts_threshold(tmp_path, capsys):
+    """BENCH artifacts diff on the headline value — a rate, so a DROP
+    past the threshold is the regression (review finding: the advertised
+    BENCH hook previously compared nothing)."""
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    old.write_text(json.dumps(schema.make_bench_record(
+        "sart_iter_s", 100.0, "iter/s", 1.0, {})) + "\n")
+    new.write_text(json.dumps(schema.make_bench_record(
+        "sart_iter_s", 50.0, "iter/s", 0.5, {})) + "\n")
+    assert metrics_main(["--diff", "--threshold", "5",
+                         str(old), str(new)]) == 2
+    out = capsys.readouterr()
+    assert "bench sart_iter_s: 100 -> 50" in out.out
+    assert "regression" in out.err
+    # improvement direction never trips
+    assert metrics_main(["--diff", "--threshold", "5",
+                         str(new), str(old)]) == 0
+
+
+def test_record_buffers_skipped_when_disabled():
+    """With no sink configured the typed record lists must not grow
+    (unbounded host memory on long runs); the registry aggregates the
+    --timing/summary paths read stay live."""
+    telem = RunTelemetry(metrics.MetricsRegistry())
+    for i in range(10):
+        telem.record_frame(float(i), 0, 5, 1e-6, 2.0, "frame")
+        telem.record_event(f"event {i}")
+    assert telem._frames == [] and telem._events == []
+    snap = {s["name"]: s for s in telem.registry.snapshot()}
+    assert snap["frames_total"]["value"] == 10
+    assert snap["availability_events_total"]["value"] == 10
+
+
+def test_metrics_subcommand_usage_errors(capsys):
+    assert metrics_main([]) == 1
+    assert metrics_main(["--diff", "one.jsonl"]) == 1
+    assert metrics_main(["/does/not/exist.jsonl"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# faults increment the matching failure counters; exit codes unchanged
+# ---------------------------------------------------------------------------
+
+def test_artifact_under_injected_faults(world, tmp_path, monkeypatch):
+    paths, H, f_true, times, scales = world
+    artifact = str(tmp_path / "run.jsonl")
+    # every frame read fails permanently -> all frames FAILED, exit 2
+    monkeypatch.setenv("SART_FAULT", "hdf5.frame_read:io:1")
+    faults.reset()
+    try:
+        assert run_cli(paths, "--metrics_out", artifact) == 2
+    finally:
+        monkeypatch.delenv("SART_FAULT")
+        faults.reset()
+    assert metrics_main(["--check", artifact]) == 0
+    records = _records(artifact)
+    frames = [r for r in records if r["type"] == "frame"]
+    assert frames and all(f["status"] == -3 for f in frames)
+    assert all(f["solve_ms"] is None for f in frames)
+    counters = {(r["name"], tuple(sorted(r["labels"].items()))): r["value"]
+                for r in records if r["type"] == "metric"
+                and r["kind"] == "counter"}
+    assert counters[("frames_total", (("status", "failed"),))] == len(frames)
+    # the isolation path absorbs RetriesExhausted — that class is the
+    # failure counter's key, and the armed site shows in fault_trips
+    assert counters[("frame_failures_total",
+                     (("error", "RetriesExhausted"),))] == len(frames)
+    assert counters[("fault_trips_total",
+                     (("site", "hdf5.frame_read"),))] > 0
+    assert counters[("retry_exhausted_total",
+                     (("site", "prefetch.next"),))] == len(frames)
+
+
+# ---------------------------------------------------------------------------
+# disabled-path identity
+# ---------------------------------------------------------------------------
+
+def _normalized_stdout(raw: str) -> str:
+    return re.sub(r"\d+\.\d+ ms", "X ms", raw)
+
+
+def _solution_state(path):
+    with h5py.File(path, "r") as f:
+        return (f["solution/value"][:], f["solution/status"][:],
+                f["solution/iterations"][:], f["solution/time"][:])
+
+
+def test_disabled_path_identity(world, tmp_path, capsys):
+    """Enabling the sinks changes NOTHING user-visible: stdout is
+    line-identical (modulo wall-clock digits) and the solution file's
+    datasets are byte-identical — the artifact note rides stderr."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    plain_out = capsys.readouterr().out
+    plain = _solution_state(paths["output"])
+    artifact = str(tmp_path / "run.jsonl")
+    assert run_cli(paths, "--metrics_out", artifact) == 0
+    captured = capsys.readouterr()
+    assert _normalized_stdout(captured.out) == _normalized_stdout(plain_out)
+    assert artifact not in captured.out
+    got = _solution_state(paths["output"])
+    for a, b in zip(plain, got):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_span_disabled_is_noop():
+    assert trace.active_buffer() is None
+    with trace.span("anything", key="value"):
+        pass  # no buffer installed: shared null context, records nothing
+    assert trace.active_buffer() is None
+
+
+# ---------------------------------------------------------------------------
+# multihost aggregation (fake-collectives path)
+# ---------------------------------------------------------------------------
+
+def _fake_allgather_for(snapshots, max_bytes):
+    """Build an allgather stub presenting ``snapshots`` as the pod."""
+    from sartsolver_tpu.obs.run import _encode_snapshot
+
+    rows = [_encode_snapshot(s, max_bytes)[0] for s in snapshots]
+
+    def allgather(local):
+        assert any(bytes(local.tobytes()) == r.tobytes() for r in rows)
+        return np.stack(rows)
+
+    return allgather
+
+
+def test_multihost_aggregation_merges_counters():
+    host0 = metrics.MetricsRegistry()
+    host0.counter("frames_total", status="converged").inc(3)
+    host0.gauge("prefetch_queue_depth").set(1)
+    host0.histogram("frame_solve_ms").observe(10.0)
+    host1 = metrics.MetricsRegistry()
+    host1.counter("frames_total", status="converged").inc(5)
+    host1.counter("retry_exhausted_total", site="hdf5.rtm_ingest").inc(1)
+    host1.gauge("prefetch_queue_depth").set(4)
+    host1.histogram("frame_solve_ms").observe(30.0)
+    snaps = [host0.snapshot(), host1.snapshot()]
+    merged = aggregate_snapshots(
+        snaps[0], allgather=_fake_allgather_for(snaps, 4096),
+        max_bytes=4096,
+    )
+    by = {(s["name"], tuple(sorted(s["labels"].items()))): s
+          for s in merged}
+    assert by[("frames_total", (("status", "converged"),))]["value"] == 8
+    assert by[("prefetch_queue_depth", ())]["value"] == 4
+    h = by[("frame_solve_ms", ())]
+    assert h["count"] == 2 and h["min"] == 10.0 and h["max"] == 30.0
+    assert by[("retry_exhausted_total",
+               (("site", "hdf5.rtm_ingest"),))]["value"] == 1
+
+
+def test_aggregation_truncation_keeps_counters():
+    r = metrics.MetricsRegistry()
+    r.counter("important_total").inc(7)
+    for i in range(50):
+        r.histogram("bulk", idx=str(i)).observe(1.0)
+    snap = r.snapshot()
+    merged = aggregate_snapshots(
+        snap, allgather=_fake_allgather_for([snap], 512), max_bytes=512,
+    )
+    by = {s["name"]: s for s in merged}
+    assert by["important_total"]["value"] == 7
+    assert by["aggregation_truncated"]["value"] == 1
+
+
+def test_telemetry_finalize_multihost_fake(tmp_path):
+    """RunTelemetry.finalize drives the aggregation through the same
+    injectable allgather and only the primary writes."""
+    reg = metrics.MetricsRegistry()
+    telem = RunTelemetry(reg, jsonl_path=str(tmp_path / "agg.jsonl"))
+    telem.record_frame(1.0, 0, 5, 1e-6, 2.0, "frame")
+    peer = metrics.MetricsRegistry()
+    peer.counter("frames_total", status="converged").inc(9)
+
+    def allgather(local):
+        from sartsolver_tpu.obs.run import _encode_snapshot
+
+        peer_buf, _ = _encode_snapshot(peer.snapshot(),
+                                       len(local) - 8)
+        return np.stack([np.asarray(local), peer_buf])
+
+    telem.finalize(multihost=True, primary=True, allgather=allgather)
+    records = _records(str(tmp_path / "agg.jsonl"))
+    counters = {(r["name"], tuple(sorted(r["labels"].items()))): r["value"]
+                for r in records
+                if r["type"] == "metric" and r["kind"] == "counter"}
+    assert counters[("frames_total", (("status", "converged"),))] == 10
+
+
+def test_finalize_without_sinks_runs_no_collective():
+    """The disabled path must stay collective-free: a --multihost run
+    with no sink configured never pays the end-of-run allgather (the
+    gate is part of the pod's collective schedule)."""
+    telem = RunTelemetry(metrics.MetricsRegistry())
+    assert not telem.enabled
+
+    def explode(_buf):
+        raise AssertionError("allgather must not run with no sinks")
+
+    telem.finalize(multihost=True, primary=True, allgather=explode)
+
+
+def test_encode_snapshot_truncation_is_valid_json():
+    """Over-cap snapshots shrink by re-encoding (counters prefix +
+    in-payload flag), never by byte-slicing — a sliced payload would
+    decode to nothing on every peer."""
+    import json as _json
+
+    from sartsolver_tpu.obs.run import _encode_snapshot
+
+    r = metrics.MetricsRegistry()
+    for i in range(200):
+        r.counter("c", idx=str(i)).inc(1)
+    buf, truncated = _encode_snapshot(r.snapshot(), 2048)
+    assert truncated
+    raw = buf.tobytes()
+    length = int.from_bytes(raw[:8], "little")
+    decoded = _json.loads(raw[8:8 + length].decode())  # must not raise
+    assert any(s["name"] == "aggregation_truncated" for s in decoded)
+    assert any(s["name"] == "c" for s in decoded)  # a counter prefix kept
+
+
+def test_telemetry_secondary_writes_nothing(tmp_path):
+    path = tmp_path / "secondary.jsonl"
+    telem = RunTelemetry(metrics.MetricsRegistry(), jsonl_path=str(path))
+    telem.record_frame(1.0, 0, 5, 1e-6, 2.0, "frame")
+    telem.finalize(primary=False)
+    assert not path.exists()
+
+
+# ---------------------------------------------------------------------------
+# heartbeat content (satellite): phase + frame counter, not just mtime
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_carries_phase_and_frame_counter(tmp_path, monkeypatch):
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("SART_HEARTBEAT_FILE", hb)
+    base = watchdog.frames_done()
+    watchdog.beacon(watchdog.PHASE_DISPATCH)
+    watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+    content = open(hb).read()
+    assert f"phase={watchdog.PHASE_DISPATCH}" in content
+    assert f"frames={base + 1}" in content
+    assert "unix=" in content
+    watchdog.beacon(watchdog.PHASE_FLUSH)
+    watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+    content = open(hb).read()
+    assert f"phase={watchdog.PHASE_FLUSH}" in content
+    assert f"frames={base + 2}" in content
+
+
+def test_cli_heartbeat_content(world, tmp_path, monkeypatch):
+    paths, H, f_true, times, scales = world
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("SART_HEARTBEAT_FILE", hb)
+    base = watchdog.frames_done()
+    assert run_cli(paths) == 0
+    content = open(hb).read()
+    assert f"frames={base + len(times)}" in content
+    assert content.startswith("phase=")
+
+
+# ---------------------------------------------------------------------------
+# bench schema sharing
+# ---------------------------------------------------------------------------
+
+def test_bench_payload_validates_and_keeps_driver_keys():
+    import importlib.util
+    import sys as _sys
+
+    bench_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("_bench_mod", bench_path)
+    bench = importlib.util.module_from_spec(spec)
+    # bench installs no hooks at import; safe to exec in-process
+    spec.loader.exec_module(bench)
+    payload = bench._bench_payload(12.5, "iter/s (unit test)", 1.25,
+                                   {"sweep": []})
+    assert schema.validate_record(payload) == []
+    # the historical driver contract: these exact keys, top-level
+    for key in ("metric", "value", "unit", "vs_baseline", "detail"):
+        assert key in payload
+    assert payload["type"] == "bench"
+    assert payload["value"] == 12.5 and payload["vs_baseline"] == 1.25
+    _sys.modules.pop("_bench_mod", None)
+
+
+def test_bench_watchdog_payload_validates():
+    import importlib.util
+
+    bench_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    spec = importlib.util.spec_from_file_location("_bench_mod2", bench_path)
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    payload = bench._watchdog_payload(600.0)
+    assert schema.validate_record(payload) == []
+    assert payload["value"] == 0.0  # no partial results in this process
+
+
+# ---------------------------------------------------------------------------
+# trace buffer details
+# ---------------------------------------------------------------------------
+
+def test_trace_span_and_beacon_tap():
+    buf = trace.TraceBuffer()
+    trace.install(buf)
+    try:
+        with trace.span("unit.work", cat="test", frame=3):
+            pass
+        watchdog.beacon("unit.phase_a")
+        watchdog.beacon("unit.phase_b")  # closes phase_a's span
+    finally:
+        trace.uninstall()
+    chrome = buf.to_chrome()
+    events = chrome["traceEvents"]
+    spans = [e for e in events if e["name"] == "unit.work"]
+    assert spans and spans[0]["ph"] == "X" and spans[0]["args"]["frame"] == 3
+    assert any(e["name"] == "unit.phase_a" and e["ph"] == "X"
+               for e in events)
+    buf.close_open_spans()
+    assert any(e["name"] == "unit.phase_b"
+               for e in buf.to_chrome()["traceEvents"])
+    # after uninstall the watchdog tap is cleared
+    watchdog.beacon("unit.phase_c")
+    assert not any(e["name"] == "unit.phase_c"
+                   for e in buf.to_chrome()["traceEvents"])
+
+
+def test_trace_buffer_is_bounded():
+    buf = trace.TraceBuffer(max_events=3)
+    for i in range(10):
+        buf.add_instant(f"e{i}", "test", 1)
+    chrome = buf.to_chrome()
+    assert len(chrome["traceEvents"]) == 3
+    assert chrome["otherData"]["dropped_events"] == 7
+    # the head survives (the part that attributes a slow run)
+    assert chrome["traceEvents"][0]["name"] == "e0"
+
+
+def test_heartbeat_write_is_atomic(tmp_path, monkeypatch):
+    """Published via rename — a supervisor reading at an arbitrary
+    instant must never see a truncated file; no temp litter remains."""
+    hb = tmp_path / "hb"
+    monkeypatch.setenv("SART_HEARTBEAT_FILE", str(hb))
+    watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+    assert hb.read_text().startswith("phase=")
+    assert list(tmp_path.glob("hb.*")) == []  # no .tmp left behind
