@@ -493,6 +493,28 @@ def _worker_main() -> int:
             "status": int(res.status[0]),
         }
 
+    def run_sharded(rtm_dtype: str, timed_reps: int) -> dict:
+        """Pixel-sharded (row-block, the reference's MPI layout) fused
+        panel sweep vs the unfused two-psum path on ALL local devices —
+        the ISSUE 5 pod path. Explicit fused_sweep='on' engages the
+        panel-psum scan on any backend (it is plain XLA, no Pallas), so
+        the CPU smoke mesh measures the same program structure the pod
+        runs; the measurement + parity gate is the shared
+        utils.fused_parity protocol (same gate as dryrun_multichip's
+        MULTICHIP artifact — one definition of what passes)."""
+        from sartsolver_tpu.parallel.mesh import make_mesh
+        from sartsolver_tpu.utils.fused_parity import measure_fused_vs_unfused
+
+        ndev = len(jax.devices())
+        if ndev < 2:
+            raise ValueError(f"needs >= 2 devices, {ndev} visible")
+        out = measure_fused_vs_unfused(
+            H32, G[:1], make_mesh(ndev, 1), iters=iters, reps=timed_reps,
+            rtm_dtype=None if rtm_dtype == "float32" else rtm_dtype,
+        )
+        out["ndev"] = ndev
+        return out
+
     def run_probe() -> dict:
         """~0.35 s fixed-shape bandwidth probe (VERDICT r4 next #5): a
         50-step power iteration over the staged fp32 matrix using the
@@ -648,6 +670,8 @@ def _worker_main() -> int:
                 have_ok = True
             elif item["kind"] == "chain":
                 data = run_chain(item["rtm_dtype"])
+            elif item["kind"] == "sharded":
+                data = run_sharded(item["rtm_dtype"], item["reps"])
             elif item["kind"] == "probe":
                 data = run_probe()
             else:
@@ -927,6 +951,18 @@ def main() -> int:
                   for dt in ("bfloat16", "int8")]
         items += [sweep_item("off", dt, 1, 2, budget_s)
                   for dt in ("bfloat16", "float32")]
+    if ndev >= 2:
+        # multichip section (ISSUE 5): the pixel-sharded fused panel
+        # sweep vs the unfused path over all local devices — the pod
+        # path's loop structure, measured (and parity-gated) wherever a
+        # multi-device mesh exists (TPU pods; CPU smoke runs under
+        # --xla_force_host_platform_device_count). int8 rides along to
+        # prove quantized storage on the row-sharded layout.
+        sharded_dtypes = ["float32"] if quick else ["float32", "int8"]
+        items += [{"kind": "sharded", "id": f"sharded:{dt}",
+                   "rtm_dtype": dt, "reps": 2,
+                   "deadline": budget_s + 240, "timeout": cfg_timeout}
+                  for dt in sharded_dtypes]
     # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
@@ -984,6 +1020,14 @@ def main() -> int:
               if f"chain:warm_loop:{dt}" in results}
     if chains:
         detail["warm_frame_loop"] = chains
+    sharded = {dt: results[f"sharded:{dt}"]
+               for dt in ("float32", "int8")
+               if f"sharded:{dt}" in results}
+    if sharded:
+        # the pod path's fused-vs-unfused measurement (panel-psum scan,
+        # parallel/sharded.py) — detail-only, tracked run-over-run by
+        # `make bench-smoke` / MULTICHIP artifacts
+        detail["multichip_sharded"] = sharded
     probes = {end: results[f"probe:{end}"] for end in ("start", "end")
               if f"probe:{end}" in results}
     if probes:

@@ -130,16 +130,19 @@ def build_parser() -> argparse.ArgumentParser:
                           "HBM traffic of the two dominant sweeps; int8 "
                           "quarters it via per-voxel-scaled quantized codes "
                           "(opt-in: solves the quantized system; needs the "
-                          "fused sweep, so a voxel-major mesh).")
+                          "fused sweep — available on pixel- and voxel-"
+                          "sharded meshes alike).")
     tpu.add_argument("--profile_dir", default=None,
                      help="Write a jax.profiler trace of the frame loop here.")
     tpu.add_argument("--fused_sweep", default="auto",
                      choices=["auto", "on", "off", "interpret"],
-                     help="Fused Pallas iteration sweep: one HBM read of the "
-                          "RTM per iteration instead of two (applies when "
-                          "the pixel axis is not sharded). 'interpret' runs "
-                          "the kernel in the Pallas interpreter (works "
-                          "off-TPU; slow, for validation).")
+                     help="Fused iteration sweep: one HBM read of the RTM "
+                          "per iteration instead of two — the Pallas kernel "
+                          "when the pixel axis is whole per device, the "
+                          "panel-psum scan when it is sharded (see "
+                          "SART_FUSED_PANEL_BYTES). 'interpret' runs the "
+                          "kernel in the Pallas interpreter (works off-TPU; "
+                          "slow, for validation).")
     tpu.add_argument("--debug_nans", action="store_true",
                      help="Enable jax debug-NaN checking: abort with a "
                           "traceback at the first NaN-producing op instead "
@@ -491,7 +494,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             if args.pixel_shards is not None:
                 n_pix = args.pixel_shards
             elif args.rtm_dtype == "int8":
-                # int8 needs the fused sweep, which pixel sharding breaks:
+                # int8 fuses on either layout now, but voxel-major stays
+                # the better default for it (one psum per iteration vs one
+                # per panel, and int8's fatter panels favor fewer shards):
                 # --voxel_shards alone means a voxel-major mesh, not
                 # fill-the-devices-with-pixel-shards
                 n_pix = 1
@@ -504,50 +509,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         # surfaces compile errors instead of degrading. Resolved *before*
         # the auto mesh choice so a broken kernel demotes the auto mesh to
         # the row-block layout instead of picking voxel-major for nothing.
+        kernel_demoted = False
         if not args.use_cpu:
             from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
 
             resolved = resolve_fused_auto(
                 opts, pixel_sharded=explicit_mesh and n_pix > 1
             )
-            if resolved is not opts:
-                print("Warning: fused Pallas sweep failed its self-test on "
-                      "this backend; using the two-matmul path.",
-                      file=sys.stderr)
+            kernel_demoted = resolved is not opts
             opts = resolved
-            if opts.rtm_dtype == "int8":
-                # preflight BEFORE the (possibly tens-of-GB, two-pass)
-                # ingest: everything here is knowable from sizes + flags
-                from sartsolver_tpu.models.sart import INT8_MAX_CONTRACTION
-                from sartsolver_tpu.parallel.mesh import fused_would_engage
-
-                if explicit_mesh and n_pix > 1:
-                    raise SartInputError(
-                        "Argument rtm_dtype='int8' needs a voxel-major "
-                        f"mesh, but --pixel_shards gives {n_pix} pixel "
-                        "shards; use --voxel_shards N (pixels=1) or "
-                        "fp32/bfloat16 storage."
-                    )
-                if max(npixel, nvoxel) > INT8_MAX_CONTRACTION:
-                    raise SartInputError(
-                        f"Argument rtm_dtype='int8': RTM extent "
-                        f"{max(npixel, nvoxel)} exceeds the int32-"
-                        f"accumulation bound {INT8_MAX_CONTRACTION}; use "
-                        "fp32/bfloat16 storage."
-                    )
-                n_vox_probe = max(n_vox if explicit_mesh else len(devices), 1)
-                if not fused_would_engage(
-                    opts, npixel, nvoxel, n_vox_probe,
-                    args.batch_frames or 1,
-                ):
-                    raise SartInputError(
-                        "Argument rtm_dtype='int8' needs the fused sweep, "
-                        "which cannot engage here (fused_sweep="
-                        f"'{opts.fused_sweep}', backend "
-                        f"'{jax.default_backend()}', or shape ineligible); "
-                        "pass --fused_sweep interpret (slow, any backend) "
-                        "or use fp32/bfloat16 storage."
-                    )
 
         if not explicit_mesh:
             from sartsolver_tpu.parallel.mesh import choose_mesh_shape
@@ -555,6 +525,58 @@ def main(argv: Optional[List[str]] = None) -> int:
             n_pix, n_vox = choose_mesh_shape(
                 len(devices), npixel, nvoxel, opts, args.batch_frames
             )
+        if kernel_demoted:
+            # the self-test guards only the Pallas KERNEL; the demotion to
+            # 'off' correctly drove choose_mesh_shape to the row-block
+            # fallback, but on a pixel-sharded mesh the fused path is the
+            # plain-XLA panel scan — unaffected by a broken kernel — so
+            # restore 'auto' there instead of foreclosing fusion (and
+            # int8) with a misleading fused_sweep='off' refusal.
+            if n_pix > 1:
+                import dataclasses
+
+                opts = dataclasses.replace(opts, fused_sweep="auto")
+                print("Warning: fused Pallas sweep failed its self-test on "
+                      "this backend; the pixel-sharded panel scan is "
+                      "unaffected and stays enabled.", file=sys.stderr)
+            else:
+                print("Warning: fused Pallas sweep failed its self-test on "
+                      "this backend; using the two-matmul path.",
+                      file=sys.stderr)
+
+        if not args.use_cpu and opts.rtm_dtype == "int8":
+            # preflight BEFORE the (possibly tens-of-GB, two-pass) ingest:
+            # everything here is knowable from sizes + flags. Pixel-sharded
+            # meshes are no longer refused — the panel-psum scan fuses
+            # there too — and the probe runs AFTER the auto mesh choice so
+            # it checks the per-shard block of the mesh the run will
+            # actually build (choose_mesh_shape's pixel-major fallback
+            # included), not a hypothetical voxel-major layout.
+            from sartsolver_tpu.models.sart import INT8_MAX_CONTRACTION
+            from sartsolver_tpu.parallel.mesh import (
+                sharded_fused_would_engage,
+            )
+
+            if max(npixel, nvoxel) > INT8_MAX_CONTRACTION:
+                raise SartInputError(
+                    f"Argument rtm_dtype='int8': RTM extent "
+                    f"{max(npixel, nvoxel)} exceeds the int32-"
+                    f"accumulation bound {INT8_MAX_CONTRACTION}; use "
+                    "fp32/bfloat16 storage."
+                )
+            if not sharded_fused_would_engage(
+                opts, npixel, nvoxel, n_pix, max(n_vox, 1),
+                args.batch_frames or 1,
+            ):
+                raise SartInputError(
+                    "Argument rtm_dtype='int8' needs the fused sweep, "
+                    "which cannot engage here (fused_sweep="
+                    f"'{opts.fused_sweep}', backend "
+                    f"'{jax.default_backend()}', or shape ineligible "
+                    f"on the {n_pix}x{max(n_vox, 1)} mesh); pass "
+                    "--fused_sweep interpret (slow, any backend) or "
+                    "use fp32/bfloat16 storage."
+                )
         if n_pix * n_vox < len(devices) and args.pixel_shards is None:
             print(
                 f"Warning: {len(devices)} devices visible but the "

@@ -201,6 +201,13 @@ class SolverOptions:
     # problem is not pixel-sharded and shapes are tile-aligned; "interpret"
     # runs the kernel in the Pallas interpreter (CPU testing).
     fused_sweep: str = "auto"
+    # Explicit voxel-panel width for the PIXEL-SHARDED fused panel sweep
+    # (ops/fused_sweep.py:sharded_panel_sweep). None (default) derives the
+    # width from the SART_FUSED_PANEL_BYTES target; an explicit value must
+    # be a positive multiple of 128 that divides the padded per-shard voxel
+    # extent, and pins the per-iteration psum count (= nvoxel_local/width)
+    # — the compile audit uses it to hold a deterministic collective count.
+    fused_panel_voxels: int | None = None
     # In-solve divergence recovery (resilience layer, docs/RESILIENCE.md):
     # the iteration body watches the residual metric for non-finite or
     # exploding values; a tripped frame rolls back to its last good
@@ -281,6 +288,13 @@ class SolverOptions:
             raise ValueError("rtm_dtype='int8' requires dtype='float32'.")
         if self.fused_sweep not in ("auto", "on", "off", "interpret"):
             raise ValueError("fused_sweep must be 'auto', 'on', 'off' or 'interpret'.")
+        if self.fused_panel_voxels is not None and (
+            self.fused_panel_voxels <= 0 or self.fused_panel_voxels % 128
+        ):
+            raise ValueError(
+                "Attribute fused_panel_voxels must be a positive multiple "
+                "of 128 (or None to derive from SART_FUSED_PANEL_BYTES)."
+            )
         if self.divergence_recovery < 0:
             raise ValueError(
                 "Attribute divergence_recovery must be >= 0 (0 disables "

@@ -38,7 +38,11 @@ from sartsolver_tpu.config import (
     SUCCESS,
     SolverOptions,
 )
-from sartsolver_tpu.ops.fused_sweep import fused_available, fused_sweep
+from sartsolver_tpu.ops.fused_sweep import (
+    fused_available,
+    fused_sweep,
+    sharded_panel_sweep,
+)
 from sartsolver_tpu.ops.laplacian import (
     LaplacianCOO,
     ShardedLaplacian,
@@ -83,20 +87,24 @@ def _psum(x, axis_name):
 def _resolve_fused(
     opts: SolverOptions, axis_name, rtm, batch: int, *, vmem_raised: bool = False
 ) -> Optional[str]:
-    """Trace-time decision for the fused Pallas sweep (ops/fused_sweep.py).
+    """Trace-time decision for the fused sweep (ops/fused_sweep.py).
 
-    Returns None (two-matmul path), "compiled", or "interpret". Fusion needs
-    the full pixel extent on-device (no pixel-axis sharding: the
-    back-projection psum would fall between the two MXU uses of the panel)
-    and fp32 compute; "auto" additionally requires a TPU backend and
-    tile-aligned shapes. An explicitly requested mode that cannot be
-    honoured raises instead of silently degrading.
+    Returns None (two-matmul path), "compiled"/"interpret" (the Pallas
+    kernel — full pixel extent on-device, i.e. no pixel-axis sharding), or
+    "panel" (the pixel-sharded voxel-panel scan with per-panel psum,
+    :func:`~sartsolver_tpu.ops.fused_sweep.sharded_panel_sweep`). All
+    variants need fp32 compute; "auto" additionally requires a TPU backend
+    and tile-aligned shapes. An explicitly requested mode that cannot be
+    honoured raises instead of silently degrading. Under pixel sharding
+    "on" and "interpret" both select the panel scan — it is plain XLA, so
+    there is no interpreter to choose.
 
     ``vmem_raised`` says the caller attached the raised scoped-VMEM
     compiler limit (fused_compile_options) to the jit that will compile
     this trace. Without it, "auto" declines shapes that only compile at
     the raised limit — e.g. under a user's own outer jit, where nothing
-    can attach compiler options — instead of failing the compile.
+    can attach compiler options — instead of failing the compile. Only the
+    Pallas kernel is affected; the panel scan needs no compiler options.
     """
     mode = opts.fused_sweep
     if mode == "off":
@@ -106,7 +114,9 @@ def _resolve_fused(
         # the guard's per-frame relaxation scale enters the LOG update as
         # a traced exponent, which the fused kernel's literal-constant
         # closure cannot carry (the LINEAR update folds the scale into the
-        # pixel weights, so it fuses fine)
+        # pixel weights, so it fuses fine). The panel scan shares the
+        # update closures, so the restriction is kept uniform across both
+        # fused variants.
         if explicit:
             raise ValueError(
                 f"fused_sweep='{mode}' requested but divergence_recovery "
@@ -114,14 +124,6 @@ def _resolve_fused(
                 "relaxation scale cannot enter the fused kernel's literal "
                 "exponent. Use fused_sweep='auto'/'off' or the linear "
                 "solver."
-            )
-        return None
-    if axis_name is not None:
-        if explicit:
-            raise ValueError(
-                f"fused_sweep='{mode}' requested but the pixel axis is "
-                "sharded; the back-projection psum cannot run inside the "
-                "fused panel sweep. Use voxel sharding or fused_sweep='auto'."
             )
         return None
     if jnp.dtype(opts.dtype) != jnp.float32 or rtm.dtype not in (
@@ -134,6 +136,33 @@ def _resolve_fused(
                 "(fp32, bfloat16 or quantized int8 RTM storage)."
             )
         return None
+    if axis_name is not None:
+        # Pixel-sharded: the voxel-panel scan with a per-panel psum keeps
+        # the one-HBM-read structure on the row-sharded layout. No Pallas
+        # involved, so no self-test/VMEM gating — just tile alignment,
+        # which the sharded driver's padding guarantees.
+        from sartsolver_tpu.ops.fused_sweep import panel_available
+
+        pv = opts.fused_panel_voxels
+        # an explicit panel width must divide the per-shard voxel extent,
+        # or the sweep would raise mid-trace — after the driver staged the
+        # (possibly tens-of-GB) RTM; check it here where "auto declines,
+        # explicit raises with the actual reason" still holds
+        ok = panel_available(
+            rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, batch
+        ) and (pv is None or rtm.shape[1] % pv == 0)
+        if mode == "auto":
+            return "panel" if ok and jax.default_backend() == "tpu" else None
+        if not ok:
+            raise ValueError(
+                f"fused_sweep='{mode}' requested but the per-shard RTM "
+                f"block {tuple(rtm.shape)} is not tile-aligned "
+                "(pixels % 8 == 0, voxels % 128 == 0"
+                + (f", voxels % fused_panel_voxels={pv} == 0"
+                   if pv is not None else "")
+                + ") for the pixel-sharded panel sweep."
+            )
+        return "panel"
     ok = fused_available(rtm.shape[0], rtm.shape[1], rtm.dtype.itemsize, batch)
     if mode == "auto":
         if ok and not vmem_raised:
@@ -153,8 +182,8 @@ def _resolve_fused(
 
 
 # Trace-time record of the sweep path the most recently traced solver core
-# selected in this process ("compiled" / "interpret" / "off"; None before
-# any trace). Observability only — lets the CLI's --timing summary and
+# selected in this process ("compiled" / "interpret" / "panel" / "off";
+# None before any trace). Observability only — lets the CLI's --timing summary and
 # bench artifacts state which path actually engaged instead of inferring it
 # (VERDICT r3 next #4); a cached jit does not re-trace, so this reflects
 # the last *compilation*, which is what provenance needs.
@@ -733,21 +762,27 @@ def _solve_normalized_batch_impl(
         )
         obs = jnp.where(vmask[None, :], obs, 0)
 
-    # Fused Pallas sweep: one HBM pass over the RTM per iteration instead of
-    # two (ops/fused_sweep.py). The elementwise update closures use Python
-    # float constants (Pallas kernels cannot capture traced values).
+    # Fused sweep: one HBM pass over the RTM per iteration instead of two
+    # (ops/fused_sweep.py) — the Pallas kernel when the pixel extent is
+    # whole on-device, the per-panel-psum scan ("panel") when the pixel
+    # axis is sharded. The elementwise update closures use Python float
+    # constants (Pallas kernels cannot capture traced values; the panel
+    # scan shares the closures for exact path parity).
     fused = _resolve_fused(opts, axis_name, rtm, B, vmem_raised=_vmem_raised)
     FUSED_ENGAGEMENT["last"] = fused or "off"
     if is_int8 and fused is None:
         # The two-matmul loop would have to re-quantize w/f every iteration
         # (extra error) or dequantize the matrix (4x the memory the user
         # chose int8 to avoid) — int8 storage is a fused-sweep feature.
+        # Both sharding layouts fuse (Pallas kernel on unsharded/voxel-
+        # sharded pixels, panel scan on sharded pixels), so resolving off
+        # here means the mode/backend/shape gates declined, not the mesh.
         raise ValueError(
             "rtm_dtype='int8' requires the fused sweep, but it resolved "
-            f"off (fused_sweep='{opts.fused_sweep}', pixel axis "
-            f"{'sharded' if axis_name is not None else 'unsharded'}). Use "
-            "fused_sweep='on'/'interpret' (or 'auto' on TPU with "
-            "tile-aligned shapes), or fp32/bfloat16 storage."
+            f"off (fused_sweep='{opts.fused_sweep}'). Use fused_sweep="
+            "'on'/'interpret' (or 'auto' on TPU with tile-aligned shapes) "
+            "— pixel- and voxel-sharded meshes both fuse — or "
+            "fp32/bfloat16 storage."
         )
     has_pen = problem.laplacian is not None
     # Geometric relaxation schedule alpha_k = alpha * decay^k. decay is a
@@ -809,6 +844,16 @@ def _solve_normalized_batch_impl(
     def run_fused(w, f, aux):
         if is_int8:
             aux = [scale[None, :]] + aux
+        if fused == "panel":
+            # pixel-sharded voxel-panel scan: same update closures, but the
+            # back-projection panel arrives already psummed over the pixel
+            # axis and the returned fitted holds this device's local rows
+            return sharded_panel_sweep(
+                rtm, w, f, aux, update_fn,
+                axis_name=axis_name,
+                fwd_scale=0 if is_int8 else None,
+                panel_voxels=opts.fused_panel_voxels,
+            )
         return fused_sweep(rtm, w, f, aux, update_fn,
                            fwd_scale=0 if is_int8 else None,
                            interpret=fused == "interpret")

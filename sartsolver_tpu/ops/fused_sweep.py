@@ -24,10 +24,16 @@ trace-time closure, so the linear (Eq. 2) and logarithmic (Eq. 3) variants
 specialize the same kernel the way the reference specializes
 UpdateSolutionKernel / UpdateLogSolutionKernel (sart_kernels.cu:205-224).
 
-Fusion requires the full pixel extent of the panel on this device, i.e. no
-pixel-axis sharding (the back-projection psum would have to run between the
-two MXU ops). Voxel-axis sharding composes fine: each device fuses over its
-column block and the forward-projection psum runs on the kernel's output.
+The Pallas kernel requires the full pixel extent of the panel on this
+device (the back-projection psum would have to run between the two MXU
+ops). Voxel-axis sharding composes fine: each device fuses over its column
+block and the forward-projection psum runs on the kernel's output.
+Pixel-axis sharding gets the same one-HBM-read structure from
+:func:`sharded_panel_sweep` instead: a plain-XLA voxel-panel scan that
+psums each panel's back-projection over the pixel axis *between* the
+panel's two dots — the per-panel ICI reduction overlaps with the next
+panel's MXU work instead of a whole-vector psum serializing two full HBM
+sweeps.
 
 Layout note (measured on TPU v5e, 2026-07-29): the column panels of the
 row-major [P, V] RTM are strided in HBM (P short bursts per panel), but a
@@ -156,6 +162,21 @@ def fused_compile_options(
     return raised_vmem_options()
 
 
+def _seed_panel_width(
+    npixel: int, nvoxel: int, itemsize: int, batch: int
+) -> int:
+    """Initial voxel-panel width for both pickers: the largest multiple of
+    128 under the ``SART_FUSED_PANEL_BYTES`` target (``_INT8`` variant for
+    1-byte storage) for one RTM panel plus the batch-scaled operand
+    panels, clamped to [128, nvoxel]. The single source of the byte-target
+    math — the Pallas and panel-scan pickers differ only in the predicate
+    their divisor walk applies."""
+    target = _PANEL_BYTES_TARGET_INT8 if itemsize == 1 else _PANEL_BYTES_TARGET
+    per_voxel = npixel * itemsize + _VOXEL_PANEL_OPERANDS * batch * 4
+    bs = (target // max(per_voxel, 1)) // 128 * 128
+    return min(max(bs, _MIN_BLOCK_VOXELS), nvoxel)
+
+
 def pick_block_voxels(
     npixel: int, nvoxel: int, itemsize: int, batch: int = 1
 ) -> int:
@@ -171,10 +192,7 @@ def pick_block_voxels(
     128)."""
     if nvoxel % _MIN_BLOCK_VOXELS:
         return 0
-    target = _PANEL_BYTES_TARGET_INT8 if itemsize == 1 else _PANEL_BYTES_TARGET
-    per_voxel = npixel * itemsize + _VOXEL_PANEL_OPERANDS * batch * 4
-    bs = (target // max(per_voxel, 1)) // 128 * 128
-    bs = min(max(bs, _MIN_BLOCK_VOXELS), nvoxel)
+    bs = _seed_panel_width(npixel, nvoxel, itemsize, batch)
     while bs >= _MIN_BLOCK_VOXELS:
         if nvoxel % bs == 0 and (
             _scoped_vmem_estimate(npixel, nvoxel, bs, itemsize, batch)
@@ -195,6 +213,145 @@ def fused_available(npixel: int, nvoxel: int, rtm_itemsize: int, batch: int = 1)
     # the picker already enforces the scoped-VMEM raise cap on its result,
     # so a positive width IS eligibility
     return pick_block_voxels(npixel, nvoxel, rtm_itemsize, batch) > 0
+
+
+# --------------------------------------------------------------------------
+# Pixel-sharded variant: voxel-panel scan with a per-panel collective.
+#
+# With the pixel axis sharded, each device owns a row stripe H_r and the
+# back-projection needs a psum over the pixel shards. Running that psum on
+# the whole [B, V] vector between two full-matrix matmuls (the unfused
+# sharded path) costs a second HBM read of the stripe AND serializes the
+# collective against both sweeps. Here the stripe is streamed through once
+# in voxel panels: each panel's local back-projection contribution is
+# psummed over the pixel axis *while the panel is still resident*, the
+# elementwise update runs on the reduced panel, and the locally-complete
+# forward-projection contribution accumulates with no collective (each
+# device owns its own pixel rows of `fitted`). The panel loop is unrolled
+# at trace time, so XLA's latency-hiding scheduler can overlap panel j's
+# all-reduce with panel j+1's MXU work — and the compile audit can count
+# one dot pair + one all-reduce per panel in the HLO
+# (parallel/sharded.py: sharded_fused_batch).
+
+
+def pick_panel_voxels(
+    npixel: int, nvoxel: int, itemsize: int, batch: int = 1
+) -> int:
+    """Voxel-panel width for :func:`sharded_panel_sweep` — the largest
+    multiple of 128 dividing ``nvoxel`` whose RTM panel (plus batch-scaled
+    operand panels) stays under the ``SART_FUSED_PANEL_BYTES`` target
+    (``_INT8`` variant for 1-byte storage). Unlike :func:`pick_block_voxels`
+    there is no scoped-VMEM cap: the panels are plain XLA dot operands, not
+    a Pallas kernel's blocks. The byte target doubles as the psum
+    granularity knob: ``nvoxel / width`` panels means that many per-
+    iteration all-reduces, each overlappable with the next panel's compute
+    (docs/MANUAL.md §mesh choice). 0 when ``nvoxel % 128 != 0``."""
+    if nvoxel % _MIN_BLOCK_VOXELS:
+        return 0
+    bs = _seed_panel_width(npixel, nvoxel, itemsize, batch)
+    while nvoxel % bs:
+        bs -= _MIN_BLOCK_VOXELS
+    return bs
+
+
+def panel_available(
+    npixel: int, nvoxel: int, rtm_itemsize: int, batch: int = 1
+) -> bool:
+    """Shapes aligned for the pixel-sharded panel sweep (per-device block
+    sizes): pixel rows fill fp32 sublanes, voxel extent tiles into 128-wide
+    panels. The sharded driver's padding (parallel/mesh.py ROW_ALIGN/
+    COL_ALIGN) guarantees both on every mesh, so this only declines
+    hand-built unpadded blocks."""
+    return npixel % _SUBLANE == 0 and pick_panel_voxels(
+        npixel, nvoxel, rtm_itemsize, batch
+    ) > 0
+
+
+def sharded_panel_sweep(
+    rtm: Array,  # [P_local, V_local] — this device's RTM block
+    w: Array,  # [B, P_local] fp32 — local back-projection pixel weights
+    f: Array,  # [B, V_local] fp32 — current solution (this voxel block)
+    aux: Sequence[Array],  # each [b_i, V_local] (b_i in {1, B}) fp32
+    update_fn: Callable[..., Array],
+    *,
+    axis_name,
+    fwd_scale: Optional[int] = None,
+    panel_voxels: Optional[int] = None,
+):
+    """One SART sweep on a pixel-sharded RTM block with ONE local HBM read.
+
+    Returns ``(f_new [B, V_local], fitted [B, P_local])``. ``fitted`` holds
+    this device's own pixel rows and is complete as returned — the forward
+    projection needs no pixel-axis collective (each device owns its rows);
+    a voxel-axis psum, if the mesh also column-shards, is the caller's.
+
+    ``update_fn`` / ``fwd_scale`` follow the :func:`fused_sweep` contract
+    exactly (the same linear/log/int8 closures specialize both), except the
+    back-projection panel handed to ``update_fn`` is already psummed over
+    ``axis_name`` — globally reduced, like the unfused path's ``bp``.
+    ``panel_voxels`` overrides the picker (the compile audit pins a
+    deterministic panel count with it; None derives from the
+    ``SART_FUSED_PANEL_BYTES`` target).
+    """
+    P, V = rtm.shape
+    B = w.shape[0]
+    bs = panel_voxels or pick_panel_voxels(P, V, rtm.dtype.itemsize, B)
+    if bs <= 0 or V % bs or not panel_available(P, V, rtm.dtype.itemsize, B):
+        raise ValueError(
+            f"sharded_panel_sweep: shapes [{P}, {V}] (batch {B}, panel "
+            f"{bs}) not tile-aligned; gate calls with panel_available()"
+            + (" and a panel_voxels override dividing the voxel extent"
+               if panel_voxels else "")
+            + "."
+        )
+    n_panels = V // bs
+    # Observability (host-side, trace-time — runs once per compilation):
+    # the panel/collective plan behind this compiled sweep, so the per-
+    # panel psum granularity is visible in --metrics_out / trace sinks
+    # without parsing HLO (docs/OBSERVABILITY.md §collective).
+    from sartsolver_tpu.obs import metrics as _obs_metrics
+    from sartsolver_tpu.obs import trace as _obs_trace
+
+    reg = _obs_metrics.get_registry()
+    reg.gauge("fused_panel_count", path="sharded_panel").set(n_panels)
+    reg.gauge("fused_panel_voxels", path="sharded_panel").set(bs)
+    reg.counter(
+        "collectives_planned_total", collective="psum", site="panel_bp"
+    ).inc(n_panels)
+    with _obs_trace.span(
+        "collective", what="panel_bp_psum_plan", panels=n_panels,
+        panel_voxels=bs,
+    ):
+        pass
+
+    fitted = None
+    f_new_parts = []
+    for j in range(n_panels):
+        panel = jax.lax.slice_in_dim(rtm, j * bs, (j + 1) * bs, axis=1)
+        if panel.dtype == jnp.int8:
+            # same in-flight dequantization as the Pallas kernel: exact
+            # (|codes| <= 127 in bf16), panel-sized — never a full-matrix
+            # convert (the audit's loop_convert_threshold pins this)
+            panel = panel.astype(jnp.bfloat16)
+        bp = jax.lax.psum(
+            jax.lax.dot_general(
+                w, panel,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ),
+            axis_name,
+        )  # [B, bs] — globally reduced back-projection of this panel
+        aux_p = [a[:, j * bs:(j + 1) * bs] for a in aux]
+        f_new_p = update_fn(f[:, j * bs:(j + 1) * bs], bp, *aux_p)
+        f_new_parts.append(f_new_p)
+        fwd = f_new_p if fwd_scale is None else f_new_p * aux_p[fwd_scale]
+        contrib = jax.lax.dot_general(
+            fwd, panel,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [B, P_local] — local rows, no collective
+        fitted = contrib if fitted is None else fitted + contrib
+    return jnp.concatenate(f_new_parts, axis=1), fitted
 
 
 _selftest_result: dict = {}
@@ -237,11 +394,13 @@ def fused_selftest() -> bool:
 def resolve_fused_auto(opts, *, pixel_sharded: bool = False):
     """Driver-level resolution of ``fused_sweep='auto'``.
 
-    Returns ``opts`` unchanged when auto-fusion is ineligible (non-TPU
-    backend, pixel-axis sharding — the solver declines those without
-    compiling anything) or when the self-test passes; returns a copy with
-    ``fused_sweep='off'`` when the kernel fails to compile on this backend.
-    Callers can warn when the returned object differs (``is not opts``).
+    Returns ``opts`` unchanged when the Pallas kernel is not what auto
+    would engage (non-TPU backend — the solver declines without compiling
+    anything; pixel-axis sharding — auto engages the plain-XLA
+    :func:`sharded_panel_sweep` there, which needs no kernel self-test) or
+    when the self-test passes; returns a copy with ``fused_sweep='off'``
+    when the kernel fails to compile on this backend. Callers can warn
+    when the returned object differs (``is not opts``).
     """
     if opts.fused_sweep != "auto":
         return opts

@@ -75,16 +75,19 @@ def choose_mesh_shape(
 ) -> Tuple[int, int]:
     """Pick ``(n_pixel_shards, n_voxel_shards)`` for an auto-configured mesh.
 
-    Heuristic: the fused Pallas sweep needs the full pixel extent on each
-    device (ops/fused_sweep.py module docstring), so when it would engage on
-    the per-device block, prefer a **voxel-major** mesh ``(1, N)``: every
-    chip runs the single-HBM-read panel sweep over its column block and only
-    the forward-projection psum crosses ICI. Per-device RTM bytes are
-    identical either way (``P*V/N``); what changes is which reduction runs
-    per iteration and whether fusion stays eligible. When fusion cannot
-    engage (explicitly off, non-fp32 compute, fp64 RTM, non-TPU backend for
-    ``'auto'``, or per-shard shapes that don't tile), fall back to the
-    reference's row-block layout ``(N, 1)`` (main.cpp:67-68).
+    Heuristic: both layouts now run a one-HBM-read fused sweep (the Pallas
+    kernel on voxel-major meshes, the per-panel-psum scan on pixel-sharded
+    ones — ops/fused_sweep.py), so per-device RTM bytes AND HBM reads per
+    iteration are identical either way. What still differs is the loop's
+    collective bill: voxel-major pays ONE forward-projection psum per
+    iteration, pixel-sharded pays one back-projection psum per voxel panel
+    (overlappable, but panel-count many) — so auto keeps preferring the
+    **voxel-major** mesh ``(1, N)`` whenever the Pallas kernel would engage
+    on the per-device block. When fusion cannot engage at all (explicitly
+    off, non-fp32 compute, fp64 RTM, non-TPU backend for ``'auto'``, or
+    per-shard shapes that don't tile), fall back to the reference's
+    row-block layout ``(N, 1)`` (main.cpp:67-68), where the panel scan
+    keeps the explicitly-pixel-sharded configurations fused anyway.
 
     ``opts`` is a :class:`sartsolver_tpu.config.SolverOptions`; only its
     dtype/fusion fields are read.
@@ -101,30 +104,70 @@ def choose_mesh_shape(
     return n_devices, 1
 
 
-def fused_would_engage(
-    opts, npixel: int, nvoxel: int, n_vox: int, batch: int = 1
-) -> bool:
-    """Would the fused sweep engage on a voxel-major mesh of ``n_vox``
-    column shards at these logical sizes? Single source of the engagement
-    rule (mode/backend/dtype gates + padded per-shard shape eligibility),
-    shared by :func:`choose_mesh_shape` and the CLI's int8 preflight."""
+def _fused_mode_dtype_eligible(opts) -> bool:
+    """Mode/backend/dtype gates shared by every fused-engagement probe
+    (mirrors models/sart._resolve_fused's trace-time gates — including
+    the log+divergence-guard decline, so the CLI's pre-ingest int8
+    preflight can never pass a configuration the solver will refuse at
+    trace time, AFTER the tens-of-GB ingest)."""
     mode = opts.fused_sweep
     if not (
         mode in ("on", "interpret")
         or (mode == "auto" and jax.default_backend() == "tpu")
     ):
         return False
+    if opts.divergence_recovery and opts.logarithmic:
+        # the guard's per-frame relaxation scale cannot enter the LOG
+        # update's fused exponent (models/sart._resolve_fused)
+        return False
     rtm_name = opts.rtm_dtype or opts.dtype
-    if opts.dtype != "float32" or rtm_name not in (
+    return opts.dtype == "float32" and rtm_name in (
         "float32", "bfloat16", "int8"
-    ):
+    )
+
+
+def _rtm_itemsize(opts) -> int:
+    return {"bfloat16": 2, "int8": 1}.get(opts.rtm_dtype or opts.dtype, 4)
+
+
+def fused_would_engage(
+    opts, npixel: int, nvoxel: int, n_vox: int, batch: int = 1
+) -> bool:
+    """Would the fused Pallas sweep engage on a voxel-major mesh of
+    ``n_vox`` column shards at these logical sizes? Single source of the
+    engagement rule (mode/backend/dtype gates + padded per-shard shape
+    eligibility), shared by :func:`choose_mesh_shape` and the CLI's int8
+    preflight. Pixel-sharded meshes have their own fused path — probe
+    those with :func:`sharded_fused_would_engage`."""
+    if not _fused_mode_dtype_eligible(opts):
         return False
     from sartsolver_tpu.ops.fused_sweep import fused_available
 
-    itemsize = {"bfloat16": 2, "int8": 1}.get(rtm_name, 4)
     rows = padded_size(npixel, ROW_ALIGN)
     cols = padded_size(nvoxel, n_vox * COL_ALIGN)
-    return fused_available(rows, cols // n_vox, itemsize, batch)
+    return fused_available(rows, cols // n_vox, _rtm_itemsize(opts), batch)
+
+
+def sharded_fused_would_engage(
+    opts, npixel: int, nvoxel: int, n_pix: int, n_vox: int, batch: int = 1
+) -> bool:
+    """Would the fused sweep engage on an ``(n_pix, n_vox)`` mesh at these
+    logical sizes? With ``n_pix > 1`` this probes the pixel-sharded panel
+    scan (ops/fused_sweep.py:sharded_panel_sweep) on the padded per-shard
+    block; otherwise it defers to the Pallas-kernel probe
+    (:func:`fused_would_engage`). Used by the CLI's int8 preflight, which
+    must reject ineligible configurations BEFORE a tens-of-GB ingest."""
+    if n_pix <= 1:
+        return fused_would_engage(opts, npixel, nvoxel, n_vox, batch)
+    if not _fused_mode_dtype_eligible(opts):
+        return False
+    from sartsolver_tpu.ops.fused_sweep import panel_available
+
+    rows = padded_size(npixel, n_pix * ROW_ALIGN)
+    cols = padded_size(nvoxel, n_vox * COL_ALIGN)
+    return panel_available(
+        rows // n_pix, cols // n_vox, _rtm_itemsize(opts), batch
+    )
 
 
 def make_mesh(n_pixel_shards: int | None = None, n_voxel_shards: int = 1, devices=None) -> Mesh:

@@ -231,14 +231,20 @@ class DistributedSARTSolver:
 
         dtype = jnp.dtype(opts.dtype)
         is_int8 = opts.rtm_dtype == "int8"
-        if is_int8 and self.mesh.shape[PIXEL_AXIS] > 1:
+        if is_int8 and opts.fused_sweep == "off":
             from sartsolver_tpu.config import SartInputError
 
-            # reachable from CLI flags -> polite exit(1), not a traceback
+            # reachable from CLI flags -> polite exit(1), not a traceback.
+            # This is a MODE refusal, not a mesh refusal: pixel- and
+            # voxel-sharded meshes both run the fused sweep now (the panel-
+            # psum scan on sharded pixels, the Pallas kernel otherwise) —
+            # only fused_sweep='off' leaves int8 with no loop that can
+            # dequantize in-flight.
             raise SartInputError(
-                "rtm_dtype='int8' needs the fused sweep, which the pixel-"
-                "sharded layout cannot run; use a voxel-major mesh "
-                "(--voxel_shards N, pixels=1) or fp32/bfloat16 storage."
+                "rtm_dtype='int8' requires the fused sweep on any mesh "
+                "(fused_sweep='auto'/'on'/'interpret'), but fused_sweep="
+                "'off' was requested; drop --fused_sweep off or use "
+                "fp32/bfloat16 storage."
             )
         if not is_int8 and rtm_scale is not None:
             raise ValueError("rtm_scale is only valid with rtm_dtype='int8'.")
@@ -513,7 +519,11 @@ class DistributedSARTSolver:
         limit (ops/fused_sweep.py); the option must sit on the outer jit
         (the solver core is inlined under shard_map). Attaching the raised
         limit when fusion is merely possible is harmless — it is a bound,
-        not an allocation (measured throughput unchanged)."""
+        not an allocation (measured throughput unchanged). Pixel-sharded
+        meshes need no options: their fused path is the plain-XLA panel
+        scan (sharded_panel_sweep), whose operands XLA buffers itself —
+        only the Pallas kernel (pixel axis whole on-device) is charged
+        against the scoped-VMEM limit."""
         if (
             self._pixel_axis is None
             and self.opts.fused_sweep != "off"
@@ -993,11 +1003,12 @@ class DistributedSARTSolver:
 # --------------------------------------------------------------------------
 # compile-audit self-registration (analysis/registry.py). The sharded
 # batch step is where a collective creeping into the iteration body costs
-# ICI latency every iteration: the pixel-sharded loop is budgeted at its
-# two designed all-reduces (back-projection psum + convergence-metric
-# psum) and zero gathers, and the per-shard thresholds forbid any
-# local-block-sized copy/convert inside the loop — the sharded twin of
-# the "sweep" entry's guarantees, plus a golden signature.
+# ICI latency every iteration: the UNFUSED pixel-sharded loop is budgeted
+# at its two designed all-reduces (back-projection psum + convergence-
+# metric psum) and zero gathers; the FUSED panel-scan loop at exactly
+# panel-count + 1 (one bp psum per voxel panel + the metric psum). Both
+# forbid any local-block-sized copy/convert inside the loop — the sharded
+# twins of the "sweep" entry's guarantees, plus golden signatures.
 
 from sartsolver_tpu.analysis.registry import (  # noqa: E402
     AUDIT_P as _AUDIT_P,
@@ -1006,6 +1017,33 @@ from sartsolver_tpu.analysis.registry import (  # noqa: E402
 )
 
 _AUDIT_SHARDS = 2
+# Deterministic panel width for the fused panel-scan entry: pins the
+# per-iteration collective count at a known value (independent of the
+# SART_FUSED_PANEL_BYTES env, which would otherwise leak into the golden).
+_AUDIT_PANEL_VOXELS = 256
+_AUDIT_PANELS = _AUDIT_V // _AUDIT_PANEL_VOXELS
+
+
+def _audit_sharded_lowering(opts: SolverOptions):
+    """Shared fixture: lower the batched solve step of a 2x1 pixel-sharded
+    mesh under the given options (the unfused and fused-panel entries
+    differ only in their SolverOptions)."""
+    rng = np.random.default_rng(7)
+    H = rng.random((_AUDIT_P, _AUDIT_V)).astype(np.float32)
+    solver = DistributedSARTSolver(
+        H, opts=opts, mesh=make_mesh(_AUDIT_SHARDS, 1)
+    )
+    g = jax.device_put(
+        np.ones((1, solver.padded_npixel), np.float32),
+        NamedSharding(solver.mesh, P(None, PIXEL_AXIS)),
+    )
+    f0 = jax.device_put(
+        np.zeros((1, solver.padded_nvoxel), np.float32),
+        NamedSharding(solver.mesh, P(None, VOXEL_AXIS)),
+    )
+    return solver._batch_fn(True).lower(
+        solver.problem, g, jnp.ones(1, jnp.float32), f0
+    )
 
 
 @_register_audit_entry(
@@ -1021,22 +1059,33 @@ _AUDIT_SHARDS = 2
     min_devices=_AUDIT_SHARDS,
 )
 def _audit_sharded_batch():
-    rng = np.random.default_rng(7)
-    H = rng.random((_AUDIT_P, _AUDIT_V)).astype(np.float32)
-    opts = SolverOptions(
+    return _audit_sharded_lowering(SolverOptions(
         max_iterations=8, conv_tolerance=1e-30, fused_sweep="off"
-    )
-    solver = DistributedSARTSolver(
-        H, opts=opts, mesh=make_mesh(_AUDIT_SHARDS, 1)
-    )
-    g = jax.device_put(
-        np.ones((1, solver.padded_npixel), np.float32),
-        NamedSharding(solver.mesh, P(None, PIXEL_AXIS)),
-    )
-    f0 = jax.device_put(
-        np.zeros((1, solver.padded_nvoxel), np.float32),
-        NamedSharding(solver.mesh, P(None, VOXEL_AXIS)),
-    )
-    return solver._batch_fn(True).lower(
-        solver.problem, g, jnp.ones(1, jnp.float32), f0
-    )
+    ))
+
+
+@_register_audit_entry(
+    "sharded_fused_batch",
+    description=f"pixel-sharded FUSED panel-scan solve step "
+                f"({_AUDIT_SHARDS}x1 mesh, fp32, {_AUDIT_PANELS} panels): "
+                "one RTM read per iteration, one psum per panel",
+    # per-shard thresholds: a whole-block copy/convert in the loop would be
+    # the second HBM sweep the panel scan exists to remove; panel-sized
+    # slices (1/_AUDIT_PANELS of the block) stay legal
+    loop_copy_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    loop_convert_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    # collective budget parameterized by the panel count: one back-
+    # projection psum PER PANEL plus the convergence-metric psum — the
+    # golden's exact-match histogram additionally pins equality, proving
+    # the per-panel reduction structure (ISSUE 5 acceptance)
+    loop_collective_budget={
+        "all-reduce": _AUDIT_PANELS + 1, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+    min_devices=_AUDIT_SHARDS,
+)
+def _audit_sharded_fused_batch():
+    return _audit_sharded_lowering(SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="on",
+        fused_panel_voxels=_AUDIT_PANEL_VOXELS,
+    ))

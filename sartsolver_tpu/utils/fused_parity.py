@@ -1,0 +1,91 @@
+"""Fused-vs-unfused measurement + parity gate for sharded meshes.
+
+One definition of the load-bearing acceptance protocol shared by the
+driver's multichip dry run (``__graft_entry__.dryrun_multichip`` — the
+MULTICHIP_r*.json artifact) and the benchmark worker (``bench.py``
+``sharded:*`` items): warm-compile both sweep paths on the SAME mesh,
+time fixed-iteration solves best-of-N, require the fused path to have
+engaged the panel scan, and require numerical parity between the paths.
+Keeping the tolerance and the engagement check here means the two
+artifacts can never disagree about what passes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+# fp32 reassociation bound for fused-vs-unfused parity: the panel scan
+# only regroups the back-projection reduction, so anything past this is a
+# math regression, not noise (matches the solver suites' fp32 tolerance).
+PARITY_RTOL = 2e-4
+
+
+def measure_fused_vs_unfused(
+    H,
+    measurements,
+    mesh,
+    *,
+    iters: int,
+    reps: int = 3,
+    rtm_dtype: Optional[str] = None,
+    fused_panel_voxels: Optional[int] = None,
+) -> dict:
+    """Time (and parity-gate) the fused panel scan against the unfused
+    path on ``mesh``; returns a flat result dict for artifacts.
+
+    ``measurements`` is ``[B, npixel]`` raw (un-normalized) frames.
+    int8 storage has no unfused variant (it requires the fused sweep), so
+    it reports the fused rate only. Raises ``ValueError`` when the fused
+    path did not engage the panel scan or parity fails — both callers
+    treat that as a failed gate, not a missing number.
+    """
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import FUSED_ENGAGEMENT
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    out: dict = {"rtm_dtype": rtm_dtype or "float32"}
+    sols: dict = {}
+    for mode in ("on", "off"):
+        if rtm_dtype == "int8" and mode == "off":
+            continue
+        opts = SolverOptions(
+            max_iterations=iters, conv_tolerance=0.0, fused_sweep=mode,
+            rtm_dtype=rtm_dtype,
+            fused_panel_voxels=(
+                fused_panel_voxels if mode == "on" else None),
+        )
+        solver = DistributedSARTSolver(H, opts=opts, mesh=mesh)
+        res = solver.solve_batch(measurements)  # compile + warm
+        engaged = FUSED_ENGAGEMENT["last"]
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            res = solver.solve_batch(measurements)
+            np.asarray(res.solution)  # synchronize via the host fetch
+            best = min(best, time.perf_counter() - t0)
+        key = "fused" if mode == "on" else "unfused"
+        out[f"{key}_iter_s"] = round(iters / best, 2)
+        out[f"{key}_engaged"] = engaged
+        sols[mode] = np.asarray(res.solution[0], np.float64)
+        solver.close()
+    if out.get("fused_engaged") != "panel":
+        raise ValueError(
+            "pixel-sharded fused sweep did not engage the panel scan: "
+            f"{out.get('fused_engaged')}"
+        )
+    if "off" in sols:
+        d = float(np.max(np.abs(sols["on"] - sols["off"])))
+        scale = float(np.max(np.abs(sols["off"])))
+        out["parity_max_abs_diff"] = round(d, 9)
+        if not d <= PARITY_RTOL * max(scale, 1.0):
+            raise ValueError(
+                f"fused-vs-unfused parity failed on the "
+                f"{dict(mesh.shape)} mesh: max|d|={d:.3e} vs scale "
+                f"{scale:.3e}"
+            )
+        out["fused_vs_unfused"] = round(
+            out["fused_iter_s"] / max(out["unfused_iter_s"], 1e-9), 3)
+    return out

@@ -137,22 +137,37 @@ def test_int8_sharded_voxel_major_matches_single():
     )
 
 
-def test_int8_pixel_sharded_rejected():
+def test_int8_pixel_sharded_matches_single():
+    """int8 on a PIXEL-sharded (2, 1) mesh — the configuration PR 5's
+    panel-psum scan unlocked (the driver used to refuse it outright). With
+    a shared f0 seed the loop's exact per-panel dequantization must track
+    the single-device int8 solve; the remaining refusal (fused_sweep='off')
+    is pinned in tests/test_sharded_fused.py."""
     import jax
 
-    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.models.sart import make_problem, solve
     from sartsolver_tpu.parallel.mesh import make_mesh
     from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
 
     if len(jax.devices()) < 2:
         pytest.skip("needs >= 2 devices (virtual CPU mesh)")
-    H, _ = _case()
-    with pytest.raises(SartInputError, match="voxel-major"):
-        DistributedSARTSolver(
-            H, None,
-            opts=SolverOptions(rtm_dtype="int8", fused_sweep="interpret"),
-            mesh=make_mesh(2, 1, devices=jax.devices()[:2]),
-        )
+    H, g = _case()
+    opts = SolverOptions(
+        max_iterations=40, conv_tolerance=0.0,
+        rtm_dtype="int8", fused_sweep="interpret",
+    )
+    f0 = np.full(V, 0.5)
+    single = solve(make_problem(H, None, opts=opts), g, f0=f0, opts=opts)
+    solver = DistributedSARTSolver(
+        H, None, opts=opts,
+        mesh=make_mesh(2, 1, devices=jax.devices()[:2]),
+    )
+    sharded = solver.solve(g, f0=f0)
+    assert int(sharded.status) == int(single.status)
+    np.testing.assert_allclose(
+        np.asarray(sharded.solution), np.asarray(single.solution),
+        rtol=1e-5, atol=1e-7,
+    )
 
 
 def test_two_pass_ingest_matches_device_quantization(tmp_path):
