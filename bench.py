@@ -515,6 +515,127 @@ def _worker_main() -> int:
         out["ndev"] = ndev
         return out
 
+    def run_straggler(B: int, timed_reps: int) -> dict:
+        """Continuous batching vs run-to-slowest on a mixed-convergence
+        frame set (ISSUE 6, docs/PERFORMANCE.md §8): N = 6B frames on the
+        banded response whose noise levels span two decades, spreading
+        iterations-to-converge several-fold. The run-to-slowest baseline
+        dispatches them in frame-order groups of B (cli.py's classic
+        grouped loop); the scheduler runs B lanes with convergence-aware
+        retirement/backfill over the SAME frame order. Both are parity-
+        gated (per-frame solutions byte-identical, iteration counts
+        equal — same useful work), so the ratio of their occupancy-
+        weighted frame throughputs (useful frame-iterations per second)
+        is pure straggler-padding recovery."""
+        from sartsolver_tpu.parallel.mesh import make_mesh
+        from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+        from sartsolver_tpu.sched import ContinuousBatcher
+
+        N = 6 * B
+        # banded+background response (run_converge's realistic coupling:
+        # a uniform random dense H converges in ~5 iterations flat —
+        # no stragglers to schedule around)
+        ii = np.arange(P, dtype=np.float32)[:, None] / P
+        jj = np.arange(V, dtype=np.float32)[None, :] / V
+        H_c = (H32 * (np.exp(-((ii - jj) ** 2) * 200.0) + 0.02)
+               ).astype(np.float32)
+        # Iteration variance driver: SART converges low spatial
+        # frequencies first, so the high-frequency content of the truth
+        # sets iterations-to-converge. Sweeping the rough component's
+        # amplitude over three decades spreads counts ~4x (measured
+        # 25..108 at the smoke shapes) — the per-frame variance arxiv
+        # 1705.07497 documents, in controllable form.
+        rng_s = np.random.default_rng(7)
+        x = np.arange(V) / V
+        base_f = 1.0 + 0.5 * np.sin(2 * np.pi * x)
+        rough = np.sin(2 * np.pi * 40 * x) * np.exp(np.cos(7 * np.pi * x))
+        # ~1/5 of frames are stragglers (a disruption-event frame with
+        # strong fine structure, ~3-4x the iterations), the rest spread
+        # over a decade — so nearly every run-to-slowest group of B
+        # contains one straggler that pads the other lanes
+        amps = 10.0 ** rng_s.uniform(-3.0, -1.0, N)
+        amps[rng_s.random(N) < 0.2] = 2.0
+        frames = []
+        for i in range(N):
+            f_i = np.maximum(base_f + amps[i] * rough, 1e-3)
+            g_i = H_c.astype(np.float64) @ f_i
+            g_i = g_i * (1.0 + 2e-3 * rng_s.standard_normal(P))
+            frames.append(np.maximum(g_i, 0.0))
+        stride = int(os.environ.get("SART_SCHEDULE_STRIDE", 8))
+        opts = SolverOptions(max_iterations=600, conv_tolerance=1e-5,
+                             schedule_stride=stride)
+        solver = DistributedSARTSolver(H_c, opts=opts, mesh=make_mesh(1, 1))
+        try:
+            def run_baseline():
+                sols = np.zeros((N, V))
+                its = np.zeros(N, np.int64)
+                cap = 0  # lane-iterations the device executed
+                t0 = time.perf_counter()
+                for s in range(0, N, B):
+                    stack = np.stack(frames[s:s + B])
+                    n = stack.shape[0]
+                    if n < B:  # dark-frame tail padding, like cli.py
+                        stack = np.concatenate(
+                            [stack, np.zeros((B - n, P))], axis=0)
+                    res = solver.solve_batch(stack, device_result=True)
+                    group_its = res.iterations
+                    sols[s:s + n] = res.fetch_solutions()[:n]
+                    its[s:s + n] = group_its[:n]
+                    cap += int(group_its.max()) * B
+                return sols, its, cap, time.perf_counter() - t0
+
+            def run_sched():
+                got = {}
+
+                def on_result(ftime, _ct, status, iterations, _conv,
+                              fetcher, _ms):
+                    got[int(ftime)] = (status, iterations, fetcher)
+
+                def on_failed(ftime, _ct, err):
+                    raise RuntimeError(f"frame {ftime} failed: {err}")
+
+                batcher = ContinuousBatcher(
+                    solver, lanes=B, on_result=on_result,
+                    on_failed=on_failed)
+                t0 = time.perf_counter()
+                stats = batcher.run(
+                    (frames[i], float(i), ()) for i in range(N))
+                sols = np.stack([got[i][2]() for i in range(N)])
+                wall = time.perf_counter() - t0
+                its = np.asarray([got[i][1] for i in range(N)], np.int64)
+                return sols, its, stats, wall
+
+            run_baseline()  # compile + warm both programs
+            run_sched()
+            base_wall = sched_wall = float("inf")
+            for _ in range(timed_reps):
+                b_sols, b_its, cap, w = run_baseline()
+                base_wall = min(base_wall, w)
+                s_sols, s_its, stats, w = run_sched()
+                sched_wall = min(sched_wall, w)
+            parity = (np.array_equal(b_sols, s_sols)
+                      and np.array_equal(b_its, s_its))
+            useful = int(b_its.sum())
+            out = {
+                "B": B, "frames": N, "schedule_stride": stride,
+                "iters_min": int(b_its.min()), "iters_max": int(b_its.max()),
+                "iters_mean": round(float(b_its.mean()), 1),
+                "occupancy": round(stats.occupancy, 3),
+                "occupancy_baseline": round(useful / cap, 3),
+                "occ_frame_iter_s": round(useful / sched_wall, 1),
+                "occ_frame_iter_s_baseline": round(useful / base_wall, 1),
+                "speedup_vs_run_to_slowest": round(base_wall / sched_wall, 2),
+                "strides": stats.strides,
+                "parity": parity,
+            }
+            if not parity:
+                out["error"] = ("parity FAILED: scheduled solutions/"
+                                "iterations differ from the run-to-slowest "
+                                "baseline on the same frame order")
+            return out
+        finally:
+            solver.close()
+
     def run_probe() -> dict:
         """~0.35 s fixed-shape bandwidth probe (VERDICT r4 next #5): a
         50-step power iteration over the staged fp32 matrix using the
@@ -672,6 +793,8 @@ def _worker_main() -> int:
                 data = run_chain(item["rtm_dtype"])
             elif item["kind"] == "sharded":
                 data = run_sharded(item["rtm_dtype"], item["reps"])
+            elif item["kind"] == "straggler":
+                data = run_straggler(item["B"], item["reps"])
             elif item["kind"] == "probe":
                 data = run_probe()
             else:
@@ -963,6 +1086,15 @@ def main() -> int:
                    "rtm_dtype": dt, "reps": 2,
                    "deadline": budget_s + 240, "timeout": cfg_timeout}
                   for dt in sharded_dtypes]
+    # continuous-batching straggler section (ISSUE 6): scheduler vs
+    # run-to-slowest on a mixed-convergence frame set, parity-gated; the
+    # occupancy-weighted frame throughput it records is gated run-over-
+    # run by `make bench-smoke` (`sartsolve metrics --diff`). Runs in
+    # quick mode too (smaller B) so the smoke artifact carries it.
+    strag_B = 32 if (on_accel and not quick) else 8
+    items.append({"kind": "straggler", "id": f"straggler:B{strag_B}",
+                  "B": strag_B, "reps": 2, "deadline": budget_s + 240,
+                  "timeout": conv_timeout})
     # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
@@ -1028,6 +1160,11 @@ def main() -> int:
         # parallel/sharded.py) — detail-only, tracked run-over-run by
         # `make bench-smoke` / MULTICHIP artifacts
         detail["multichip_sharded"] = sharded
+    strag = results.get(f"straggler:B{strag_B}")
+    if strag is not None:
+        # the occupancy-weighted headline `sartsolve metrics --diff`
+        # gates on (detail.straggler.occ_frame_iter_s)
+        detail["straggler"] = strag
     probes = {end: results[f"probe:{end}"] for end in ("start", "end")
               if f"probe:{end}" in results}
     if probes:

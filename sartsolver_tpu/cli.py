@@ -114,7 +114,24 @@ def build_parser() -> argparse.ArgumentParser:
                           "(gemv->gemm on the MXU; the RTM is read once per "
                           "iteration for the whole batch). Requires "
                           "--no_guess, since batched frames carry no "
-                          "warm-start dependency.")
+                          "warm-start dependency. Single-host runs use N "
+                          "continuously-batched lanes by default (see "
+                          "--no_continuous_batching).")
+    tpu.add_argument("--schedule_stride", type=int, default=None,
+                     help="Continuous batching: iterations between "
+                          "scheduler control returns — converged lanes "
+                          "retire and backfill from the frame queue every "
+                          "N iterations (docs/PERFORMANCE.md §8: larger "
+                          "strides amortize the per-stride host sync, "
+                          "smaller strides track convergence tighter). "
+                          "Default: SART_SCHEDULE_STRIDE env, else 16.")
+    tpu.add_argument("--no_continuous_batching", action="store_true",
+                     help="Disable the convergence-aware lane scheduler "
+                          "for --batch_frames > 1 and run the classic "
+                          "run-to-slowest group loop (each batch waits "
+                          "for its slowest frame; converged lanes pad "
+                          "the device until the batch drains). Multihost "
+                          "runs always use the classic loop.")
     tpu.add_argument("--chain_frames", type=int, default=8,
                      help="Warm-started frames dispatched per device "
                           "program (lax.scan carrying the previous "
@@ -254,6 +271,9 @@ def _validate(args) -> None:
              "have no warm-start dependency).")
     if args.chain_frames < 1:
         fail(f"Argument chain_frames must be >= 1, {args.chain_frames} given.")
+    if args.schedule_stride is not None and args.schedule_stride < 1:
+        fail(f"Argument schedule_stride must be >= 1, "
+             f"{args.schedule_stride} given.")
     if args.divergence_recovery < 0:
         fail("Argument divergence_recovery must be >= 0, "
              f"{args.divergence_recovery} given.")
@@ -448,6 +468,30 @@ def main(argv: Optional[List[str]] = None) -> int:
 
         _mark("validate + index inputs")
 
+        # Continuous-batching stride: flag > SART_SCHEDULE_STRIDE env >
+        # the SolverOptions default (16). Resolved here (not in the
+        # dataclass) so the env override is CLI policy, like the other
+        # SART_* knobs; validation is the dataclass's.
+        import os as _os_stride
+
+        if args.schedule_stride is not None:
+            schedule_stride = args.schedule_stride
+        else:
+            _stride_env = _os_stride.environ.get("SART_SCHEDULE_STRIDE", "16")
+            try:
+                schedule_stride = int(_stride_env)
+            except ValueError:
+                # fail loudly like --schedule_stride would — a silently
+                # ignored operator typo on a perf knob is worse than exit 1
+                raise SartInputError(
+                    f"SART_SCHEDULE_STRIDE must be an integer >= 1, "
+                    f"{_stride_env!r} given."
+                )
+        if schedule_stride < 1:
+            raise SartInputError(
+                f"SART_SCHEDULE_STRIDE must be >= 1, "
+                f"{schedule_stride} given."
+            )
         if args.use_cpu:
             opts = SolverOptions.cpu_parity(
                 logarithmic=args.logarithmic,
@@ -459,6 +503,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 relaxation_decay=args.relaxation_decay,
                 max_iterations=args.max_iterations,
                 divergence_recovery=args.divergence_recovery,
+                schedule_stride=schedule_stride,
                 # forwarded so an explicit --fused_sweep on fails loudly
                 # (the fused sweep is fp32-only) instead of silently
                 # degrading to the unfused path
@@ -477,6 +522,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 relaxation_decay=args.relaxation_decay,
                 max_iterations=args.max_iterations,
                 divergence_recovery=args.divergence_recovery,
+                schedule_stride=schedule_stride,
                 rtm_dtype=args.rtm_dtype,
                 fused_sweep=args.fused_sweep,
             )
@@ -787,7 +833,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # asynchronously all-gathered for process 0's writer; all
             # collectives stay on the main thread.
 
-            def run_grouped(K, pad_tail, solve_group, label):
+            def run_grouped(K, pad_tail, solve_group, label, items=None):
                 """Shared frame-group protocol for the batch and chain
                 loops: accumulate K frames, pad the final partial group
                 (so the already-compiled K-program is reused instead of
@@ -931,7 +977,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                             write_group(*to_write)
 
                 try:
-                    for item in frames:
+                    for item in (frames if items is None else items):
                         if not pending and stop_now():
                             # frame-group boundary stop: no new group is
                             # started; the in-flight group drains below
@@ -986,15 +1032,79 @@ def main(argv: Optional[List[str]] = None) -> int:
                     if ladder_line:
                         summary.record_event(ladder_line)
 
-            if args.batch_frames > 1:
+            def run_batch_grouped(K, items=None):
                 run_grouped(
-                    args.batch_frames,
+                    K,
                     # inert dark frames (independent solves, no carry)
                     lambda stack, n: np.zeros((n, stack.shape[1])),
                     lambda stack: solver.solve_batch(
                         stack, local=use_local, device_result=True),
                     "batch",
+                    items=items,
                 )
+
+            def run_scheduled(K):
+                """Continuous batching (docs/PERFORMANCE.md §8): K lanes,
+                convergence-aware retirement + backfill every
+                schedule_stride iterations — sustained occupancy at the
+                fixed batch shape instead of run-to-slowest padding. On a
+                device OOM the scheduler hands its un-emitted frames back
+                and the classic grouped loop (whose halving ladder CAN
+                shrink the batch — the scheduler's fixed lane count
+                cannot without recompiling) finishes the run at half
+                size."""
+                from sartsolver_tpu.sched import ContinuousBatcher
+
+                def sched_result(ftime, cam_times, status, iterations,
+                                 convergence, fetcher, per_frame_ms):
+                    writer.add(fetcher, status, ftime, cam_times,
+                               iterations=iterations)
+                    summary.record_status(status, ftime)
+                    telem.record_frame(ftime, status, iterations,
+                                       convergence, per_frame_ms, "sched")
+                    watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+                    # detail=: inside the frame-loop phase, like the
+                    # grouped loop's pipelined-wall rows
+                    timer.add("solve sched (pipelined wall)",
+                              per_frame_ms / 1e3, detail=True)
+                    if primary:
+                        print(f"Processed in: {per_frame_ms} ms "
+                              f"(continuous batch of {K} lanes; "
+                              f"{iterations} iterations)")
+
+                batcher = ContinuousBatcher(
+                    solver, lanes=K,
+                    on_result=sched_result, on_failed=record_failed,
+                    stop_check=stop_now, on_event=degrade_event,
+                    isolate=isolate,
+                )
+                # ONE shared iterator: the OOM fallback must continue the
+                # same stream the batcher was draining, not re-iterate the
+                # prefetcher — a fresh FramePrefetcher generator would
+                # block forever on the already-consumed end sentinel
+                frames_iter = iter(frames)
+                stats = batcher.run(frames_iter)
+                if stats.interrupted:
+                    stop_state["interrupted"] = True
+                if stats.leftover is not None:
+                    import itertools
+
+                    run_batch_grouped(
+                        max(K // 2, 1),
+                        items=itertools.chain(stats.leftover, frames_iter),
+                    )
+
+            if args.batch_frames > 1:
+                if args.no_continuous_batching or args.multihost:
+                    # classic run-to-slowest grouping; multihost keeps it
+                    # because the scheduler's per-stride retire/backfill
+                    # decisions would have to be replicated across
+                    # processes in lockstep with per-process prefetch
+                    # streams — the same desynchronization hazard that
+                    # forces frame-level fail-fast there
+                    run_batch_grouped(args.batch_frames)
+                else:
+                    run_scheduled(args.batch_frames)
             elif args.chain_frames > 1 and not args.no_guess:
                 # Warm-start loop chained on device: K frames per program
                 # (lax.scan carrying the previous solution), ONE packed

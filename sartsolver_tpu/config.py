@@ -221,6 +221,16 @@ class SolverOptions:
     # A frame counts as exploding when its ||Hf||^2 exceeds this multiple
     # of max(||g||^2, 1) (both normalized); non-finite metrics always trip.
     divergence_threshold: float = 1.0e4
+    # Continuous batching (sartsolver_tpu/sched/, docs/PERFORMANCE.md §8):
+    # the masked-lane stepped solver core returns control to the host every
+    # this many iterations so the scheduler can retire converged lanes and
+    # backfill them from the frame queue. Larger strides amortize the
+    # per-stride host round trip (one packed scalar fetch) but leave
+    # converged lanes padding the MXU for up to stride-1 dead iterations;
+    # smaller strides track convergence tighter at more host syncs. Only
+    # read by the scheduler path — the classic batch/chain programs are
+    # untouched by this value.
+    schedule_stride: int = 16
     # Accumulate the convergence metric's ||Hf||^2 in fp64 (emulated as
     # float32 pairs on TPU) even when the compute dtype is fp32, so the
     # |dC| < tol stall crossing (Eq. 5, sartsolver.cpp:224-228) stops
@@ -304,4 +314,9 @@ class SolverOptions:
             raise ValueError(
                 "Attribute divergence_threshold must be > 1 (a multiple "
                 "of the measurement norm)."
+            )
+        if self.schedule_stride < 1:
+            raise ValueError(
+                "Attribute schedule_stride must be >= 1 (iterations "
+                "between scheduler control returns)."
             )

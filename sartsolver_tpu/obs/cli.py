@@ -107,12 +107,32 @@ def summarize(records: List[dict]) -> dict:
             _metric_key(m): m["value"] for m in metric_recs
             if m["kind"] == "gauge"
         },
+        # moments histograms (count/sum/min/max); mean derived here so
+        # the diff below can gate on distribution drift (in particular
+        # iterations_to_converge — convergence behavior)
+        "histograms": {
+            _metric_key(m): {
+                "count": m["count"], "mean": m["sum"] / m["count"],
+                "min": m["min"], "max": m["max"],
+            }
+            for m in metric_recs
+            if m["kind"] == "histogram" and m.get("count")
+        },
     }
     if bench:
         out["bench"] = {
             "metric": bench[0]["metric"], "value": bench[0]["value"],
             "vs_baseline": bench[0]["vs_baseline"],
         }
+        # continuous-batching straggler section (bench.py): the
+        # occupancy-weighted frame throughput is its own gated headline —
+        # a rate, like the bench value
+        strag = (bench[0].get("detail") or {}).get("straggler")
+        if isinstance(strag, dict) and "occ_frame_iter_s" in strag:
+            out["straggler"] = {
+                "occ_frame_iter_s": strag["occ_frame_iter_s"],
+                "occupancy": strag.get("occupancy"),
+            }
     return out
 
 
@@ -137,6 +157,9 @@ def _print_summary(path: str, summary: dict) -> None:
     if summary["iterations"]:
         s = summary["iterations"]
         print(f"  iterations: mean {s['mean']:.1f}, max {s['max']:.0f}")
+    for key, h in summary["histograms"].items():
+        print(f"  histogram {key}: count {h['count']:g}, "
+              f"mean {h['mean']:.2f}, min {h['min']:g}, max {h['max']:g}")
     for key, value in summary["counters"].items():
         print(f"  counter {key} = {value:g}")
     for key, value in summary["gauges"].items():
@@ -169,6 +192,18 @@ def diff(old: dict, new: dict) -> dict:
         solve_pct = 100.0 * (new["solve_ms"]["mean"]
                              / old["solve_ms"]["mean"] - 1.0)
     out["solve_ms_mean_pct"] = solve_pct
+    # convergence-behavior drift: mean iterations_to_converge (SUCCESS
+    # frames only, obs/run.py). Drift in EITHER direction is gated —
+    # more iterations is slower convergence, but suddenly fewer is just
+    # as suspicious (a broken stall test converges "instantly")
+    conv_pct = None
+    key = "iterations_to_converge"
+    a = old.get("histograms", {}).get(key)
+    b = new.get("histograms", {}).get(key)
+    if a and b and a["mean"] > 0:
+        conv_pct = 100.0 * (b["mean"] / a["mean"] - 1.0)
+        out[key] = {"old": a["mean"], "new": b["mean"]}
+    out["iterations_to_converge_mean_pct"] = conv_pct
     # bench headline delta (BENCH_*.json artifacts): value is a rate
     # (iterations/sec), so a DROP is the regression direction — the
     # opposite sign convention from solve_ms
@@ -180,6 +215,16 @@ def diff(old: dict, new: dict) -> dict:
                         "old": old["bench"]["value"],
                         "new": new["bench"]["value"]}
     out["bench_value_pct"] = bench_pct
+    # occupancy-weighted straggler headline (continuous batching,
+    # docs/PERFORMANCE.md §8): a rate, gated like the bench value
+    strag_pct = None
+    if ("straggler" in old and "straggler" in new
+            and old["straggler"]["occ_frame_iter_s"] > 0):
+        strag_pct = 100.0 * (new["straggler"]["occ_frame_iter_s"]
+                             / old["straggler"]["occ_frame_iter_s"] - 1.0)
+        out["straggler"] = {"old": old["straggler"]["occ_frame_iter_s"],
+                            "new": new["straggler"]["occ_frame_iter_s"]}
+    out["straggler_value_pct"] = strag_pct
     return out
 
 
@@ -232,11 +277,21 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                 print(f"  mean solve ms: {old['solve_ms']['mean']:.2f} -> "
                       f"{new['solve_ms']['mean']:.2f} "
                       f"({delta['solve_ms_mean_pct']:+.1f}%)")
+            if delta["iterations_to_converge_mean_pct"] is not None:
+                d = delta["iterations_to_converge"]
+                print(f"  mean iterations_to_converge: {d['old']:.2f} -> "
+                      f"{d['new']:.2f} "
+                      f"({delta['iterations_to_converge_mean_pct']:+.1f}%)")
             if delta["bench_value_pct"] is not None:
                 print(f"  bench {delta['bench']['metric']}: "
                       f"{delta['bench']['old']:g} -> "
                       f"{delta['bench']['new']:g} "
                       f"({delta['bench_value_pct']:+.1f}%)")
+            if delta["straggler_value_pct"] is not None:
+                print(f"  straggler occ frame-iter/s: "
+                      f"{delta['straggler']['old']:g} -> "
+                      f"{delta['straggler']['new']:g} "
+                      f"({delta['straggler_value_pct']:+.1f}%)")
         if args.threshold is not None:
             # regression directions differ by metric: solve_ms is a cost
             # (up = worse), the bench headline is a rate (down = worse)
@@ -246,10 +301,25 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['solve_ms_mean_pct']:+.1f}% exceeds the "
                       f"{args.threshold:g}% threshold.", file=sys.stderr)
                 return 2
+            if (delta["iterations_to_converge_mean_pct"] is not None
+                    and abs(delta["iterations_to_converge_mean_pct"])
+                    > args.threshold):
+                print(f"sartsolve metrics: convergence-behavior drift "
+                      f"{delta['iterations_to_converge_mean_pct']:+.1f}% "
+                      f"(mean iterations_to_converge) exceeds the "
+                      f"{args.threshold:g}% threshold.", file=sys.stderr)
+                return 2
             if (delta["bench_value_pct"] is not None
                     and delta["bench_value_pct"] < -args.threshold):
                 print(f"sartsolve metrics: bench value regression "
                       f"{delta['bench_value_pct']:+.1f}% exceeds the "
+                      f"{args.threshold:g}% threshold.", file=sys.stderr)
+                return 2
+            if (delta["straggler_value_pct"] is not None
+                    and delta["straggler_value_pct"] < -args.threshold):
+                print(f"sartsolve metrics: straggler occupancy-weighted "
+                      f"throughput regression "
+                      f"{delta['straggler_value_pct']:+.1f}% exceeds the "
                       f"{args.threshold:g}% threshold.", file=sys.stderr)
                 return 2
         return 0

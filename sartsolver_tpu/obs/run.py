@@ -185,6 +185,16 @@ class RunTelemetry:
             self.registry.histogram("frame_solve_ms").observe(solve_ms)
         if iterations >= 0:
             self.registry.histogram("frame_iterations").observe(iterations)
+        if status == 0 and iterations >= 0:
+            # converged frames only (SUCCESS) — frame_iterations above
+            # mixes in capped/diverged frames, whose counts say nothing
+            # about convergence BEHAVIOR. `sartsolve metrics --diff`
+            # gates on this histogram's mean: a solver change that
+            # shifts how fast frames converge trips the threshold even
+            # when wall-clock throughput hides it.
+            self.registry.histogram("iterations_to_converge").observe(
+                iterations
+            )
         if convergence is not None:
             self.registry.gauge("last_convergence").set(convergence)
         if error:

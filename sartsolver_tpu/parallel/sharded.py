@@ -35,12 +35,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from sartsolver_tpu.config import SolverOptions
+from sartsolver_tpu.config import MAX_ITERATIONS_EXCEEDED, SolverOptions
 from sartsolver_tpu.models.sart import (
     SARTProblem,
+    SchedState,
     SolveResult,
     compute_ray_stats,
     prepare_measurement,
+    sched_step_normalized,
     solve_chain_normalized,
     solve_normalized_batch,
 )
@@ -191,6 +193,85 @@ class DeviceSolveResult:
         AsyncSolutionWriter so the device fetch runs on the writer thread,
         overlapped with the next frame's solve."""
         return lambda: self.fetch_solutions()[b]
+
+
+class SchedLaneState:
+    """Host handle for the continuous-batching lane state
+    (:class:`~sartsolver_tpu.models.sart.SchedState` on device, plus the
+    per-lane host bookkeeping the device cannot carry: each occupant's
+    fp64 measurement norm for denormalization at fetch time).
+
+    Produced by :meth:`DistributedSARTSolver.sched_lanes`, advanced by
+    :meth:`DistributedSARTSolver.sched_step`; the scheduler
+    (sartsolver_tpu/sched/) owns the retire/backfill policy on top.
+    """
+
+    def __init__(self, solver: "DistributedSARTSolver", state: SchedState,
+                 lanes: int):
+        self._solver = solver
+        self.state = state
+        self.lanes = int(lanes)
+        self.norms = np.ones(lanes, np.float64)  # per-lane occupant norm
+        self._packed = None
+        self._scalars = None
+        self._drain_args = None  # cached no-refill operands (sched_step)
+
+    def _repack(self) -> None:
+        """Asynchronously dispatch the packed per-lane scalar array
+        (done/status/iters/conv/it as one replicated [5, B] fp32 — all
+        exact: see DeviceSolveResult._fetch_scalars). Called by
+        sched_step after each stride; the host fetch stays lazy."""
+        st = self.state
+        self._packed = self._solver._sched_pack_fn()(
+            st.done, st.status, st.iters, st.conv, st.it
+        )
+        self._scalars = None
+
+    def scalars(self):
+        """(done bool[B], status int32[B], iters int32[B], conv f64[B],
+        it int32[B]) — ONE D2H per stride, cached until the next step;
+        blocks until the stride's device work completed."""
+        if self._scalars is None:
+            from sartsolver_tpu.obs import trace as obs_trace
+            from sartsolver_tpu.resilience import watchdog
+
+            watchdog.beacon(watchdog.PHASE_FETCH)
+            with obs_trace.span("result.fetch", what="sched_scalars"):
+                packed = np.asarray(self._packed)
+            self._scalars = (
+                packed[0] > 0.5,
+                packed[1].astype(np.int32),
+                packed[2].astype(np.int32),
+                packed[3].astype(np.float64),
+                packed[4].astype(np.int32),
+            )
+        return self._scalars
+
+    def lane_solution_fetcher(self, b: int):
+        """Zero-arg callable resolving lane ``b``'s denormalized solution
+        row — the async writer's contract (solution_fetcher twin).
+
+        The ``[1, padded_nvoxel]`` slice program is DISPATCHED NOW (the
+        lane's buffer will be overwritten by the next backfill; the slice
+        result is an independent replicated array, safe to fetch lazily
+        on the writer thread — a local D2H on any process of a
+        multi-host run), and the occupant's norm is snapshotted now for
+        the same reason."""
+        solver = self._solver
+        row_dev = solver._sched_lane_fn()(self.state.f, jnp.asarray(b, jnp.int32))
+        norm = float(self.norms[b])
+        nvoxel = solver.nvoxel
+
+        def fetch() -> np.ndarray:
+            from sartsolver_tpu.obs import trace as obs_trace
+            from sartsolver_tpu.resilience import watchdog
+
+            watchdog.beacon(watchdog.PHASE_FETCH)
+            with obs_trace.span("result.fetch", what="sched_lane"):
+                row = np.asarray(row_dev).astype(np.float64)
+            return row[0, :nvoxel] * norm
+
+        return fetch
 
 
 class DistributedSARTSolver:
@@ -978,6 +1059,209 @@ class DistributedSARTSolver:
             _fetch(res.convergence).astype(np.float64),
         )
 
+    # ---- continuous batching (sartsolver_tpu/sched/) ---------------------
+
+    def _sched_state_spec(self) -> SchedState:
+        return SchedState(
+            g=P(None, PIXEL_AXIS), msq=P(), f=P(None, VOXEL_AXIS),
+            fitted=P(None, PIXEL_AXIS), conv=P(), it=P(), done=P(),
+            status=P(), iters=P(), ascale=P(), recov=P(),
+            obs=P(None, VOXEL_AXIS) if self.opts.logarithmic else None,
+        )
+
+    def _sched_state_sharding(self) -> SchedState:
+        spec = self._sched_state_spec()
+        return SchedState(*(
+            None if s is None else NamedSharding(self.mesh, s)
+            for s in spec
+        ))
+
+    def _sched_fn(self):
+        """Compiled scheduler stride over the mesh — ONE program for every
+        lane occupancy (the fixed batch shape is the whole point:
+        continuous batching must never recompile as lanes retire and
+        backfill; tests/test_sched.py pins the cache size at 1)."""
+        key = "sched"
+        if key not in self._solve_fns:
+            opts = self.opts
+            pixel_axis = self._pixel_axis
+            voxel_axis = self._voxel_axis
+            options = self._compiler_options()
+            vmem_raised = options is not None
+
+            def run(problem, state, g_new, msq_new, refill):
+                return sched_step_normalized(
+                    self._drop_lap_shard_dim(problem), state, g_new,
+                    msq_new, refill,
+                    opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
+                    use_guess=True, _vmem_raised=vmem_raised,
+                )
+
+            state_spec = self._sched_state_spec()
+            fn = shard_map(
+                run,
+                mesh=self.mesh,
+                in_specs=(
+                    self._problem_spec(), state_spec,
+                    P(None, PIXEL_AXIS), P(), P(),
+                ),
+                out_specs=state_spec,
+                check_vma=False,
+            )
+            # out_shardings pinned to the exact NamedShardings sched_lanes
+            # stages: the returned state feeds the NEXT stride's call, and
+            # any spec normalization drift (GSPMD rewrites trivial axes)
+            # between fresh and cycled state would key a SECOND jit cache
+            # entry — the one-compiled-program contract forbids that
+            # (pinned by tests/test_sched.py's cache-size assertion)
+            self._solve_fns[key] = jax.jit(
+                fn, out_shardings=self._sched_state_sharding(),
+                compiler_options=options,
+            )
+        return self._solve_fns[key]
+
+    def _sched_pack_fn(self):
+        key = "sched_pack"
+        if key not in self._solve_fns:
+            self._solve_fns[key] = jax.jit(
+                lambda d, s, i, c, it: jnp.stack([
+                    d.astype(jnp.float32), s.astype(jnp.float32),
+                    i.astype(jnp.float32), c.astype(jnp.float32),
+                    it.astype(jnp.float32)]),
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+        return self._solve_fns[key]
+
+    def _sched_lane_fn(self):
+        """[1, padded_nvoxel] replicated slice of one lane's solution —
+        the lane index is a TRACED scalar, so every lane shares one
+        compiled program."""
+        key = "sched_lane"
+        if key not in self._solve_fns:
+            self._solve_fns[key] = jax.jit(
+                lambda f, b: jax.lax.dynamic_slice_in_dim(f, b, 1, axis=0),
+                out_shardings=NamedSharding(self.mesh, P()),
+            )
+        return self._solve_fns[key]
+
+    def sched_lanes(self, lanes: int) -> SchedLaneState:
+        """Fresh all-inert lane state for :meth:`sched_step`.
+
+        Inert lanes hold ``g = -1`` (every pixel saturated — masked by
+        Eq. 6 everywhere), ``f = 1`` (log-safe: the log variant's
+        ``log f`` penalty needs a positive iterate even on dead lanes,
+        whose updates are discarded by the ``done`` freeze anyway) and
+        ``msq = 1`` (the convergence ratio stays finite)."""
+        if self.problem is None:
+            raise ValueError(
+                "This solver has been closed (close() released its device "
+                "memory); build a new DistributedSARTSolver."
+            )
+        B = int(lanes)
+        if B < 1:
+            raise ValueError("Lane count must be positive.")
+        dtype = jnp.dtype(self.opts.dtype)
+        pix = P(None, PIXEL_AXIS)
+        vox = P(None, VOXEL_AXIS)
+        # every component is staged with its state-spec sharding UP FRONT
+        # (the replicated scalars included): an uncommitted first-call
+        # operand would key a second jit cache entry once the stride's
+        # own committed outputs come back around — exactly the
+        # per-occupancy recompile the fixed shape exists to avoid
+        rep = P()
+        state = SchedState(
+            g=_stage(np.full((B, self.padded_npixel), -1.0, dtype),
+                     self.mesh, pix),
+            msq=_stage(np.ones(B, dtype), self.mesh, rep),
+            f=_stage(np.ones((B, self.padded_nvoxel), dtype),
+                     self.mesh, vox),
+            fitted=_stage(np.zeros((B, self.padded_npixel), dtype),
+                          self.mesh, pix),
+            conv=_stage(np.zeros(B, dtype), self.mesh, rep),
+            it=_stage(np.zeros(B, np.int32), self.mesh, rep),
+            done=_stage(np.ones(B, bool), self.mesh, rep),
+            status=_stage(np.full(B, MAX_ITERATIONS_EXCEEDED, np.int32),
+                          self.mesh, rep),
+            iters=_stage(np.zeros(B, np.int32), self.mesh, rep),
+            ascale=_stage(np.ones(B, dtype), self.mesh, rep),
+            recov=_stage(np.zeros(B, np.int32), self.mesh, rep),
+            obs=(_stage(np.zeros((B, self.padded_nvoxel), dtype),
+                        self.mesh, vox)
+                 if self.opts.logarithmic else None),
+        )
+        return SchedLaneState(self, state, B)
+
+    def sched_step(self, lane_state: SchedLaneState, refills) -> None:
+        """Advance the lanes one scheduler stride.
+
+        ``refills`` is a list of ``(lane_index, measurement)`` pairs —
+        full physical-unit frames (``[npixel]``); each is normalized host-
+        side exactly like :meth:`solve_batch`'s staging
+        (prepare_measurement + padding) and loaded into its lane before
+        the stride runs. An empty list is a pure drain stride. Updates
+        ``lane_state`` in place (state swap on success only — a failed
+        dispatch leaves the previous stride's state intact for the
+        caller's failure policy)."""
+        from sartsolver_tpu.resilience import faults, watchdog
+
+        watchdog.beacon(watchdog.PHASE_DISPATCH)
+        faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
+        if self.problem is None:
+            raise ValueError(
+                "This solver has been closed (close() released its device "
+                "memory); build a new DistributedSARTSolver."
+            )
+        opts = self.opts
+        dtype = jnp.dtype(opts.dtype)
+        B = lane_state.lanes
+        norms = lane_state.norms.copy()
+        if refills:
+            refill = np.zeros(B, bool)
+            g_new = np.full((B, self.padded_npixel), -1.0, dtype)
+            msq_new = np.ones(B)
+            for b, meas in refills:
+                meas = np.asarray(meas, np.float64)
+                if meas.shape != (self.npixel,):
+                    raise ValueError(
+                        f"Refill measurement for lane {b} has shape "
+                        f"{meas.shape}, expected ({self.npixel},)."
+                    )
+                if refill[b]:
+                    raise ValueError(
+                        f"Lane {b} refilled twice in one stride.")
+                g64, msq, norm = prepare_measurement(meas, opts)
+                g_new[b] = pad_measurement(
+                    g64, self.n_pixel_shards, target=self.padded_npixel
+                )
+                msq_new[b] = msq
+                norms[b] = norm
+                refill[b] = True
+            g_dev = _stage(g_new, self.mesh, P(None, PIXEL_AXIS))
+            msq_dev = _stage(msq_new.astype(dtype), self.mesh, P())
+            refill_dev = _stage(refill, self.mesh, P())
+        else:
+            # pure drain stride (queue exhausted, in-flight lanes running
+            # out): reuse one cached device-resident no-refill operand
+            # set instead of staging [B, P] of inert rows every stride —
+            # the tail of every run is drain strides, and the refill
+            # merge is skipped on device anyway (cond on any(refill))
+            if lane_state._drain_args is None:
+                lane_state._drain_args = (
+                    _stage(np.full((B, self.padded_npixel), -1.0, dtype),
+                           self.mesh, P(None, PIXEL_AXIS)),
+                    _stage(np.ones(B, dtype), self.mesh, P()),
+                    _stage(np.zeros(B, bool), self.mesh, P()),
+                )
+            g_dev, msq_dev, refill_dev = lane_state._drain_args
+        new_state = self._sched_fn()(
+            self.problem, lane_state.state, g_dev, msq_dev, refill_dev,
+        )
+        # commit only after a successful dispatch: an OOM/fault above must
+        # leave the previous stride's state intact for the caller
+        lane_state.state = new_state
+        lane_state.norms = norms
+        lane_state._repack()
+
     def solve(self, measurement, f0=None, *, local: bool = False) -> SolveResult:
         """Solve one frame — the B=1 case of :meth:`solve_batch`."""
         if local:
@@ -1089,3 +1373,42 @@ def _audit_sharded_fused_batch():
         max_iterations=8, conv_tolerance=1e-30, fused_sweep="on",
         fused_panel_voxels=_AUDIT_PANEL_VOXELS,
     ))
+
+
+@_register_audit_entry(
+    "sharded_sched_step",
+    description=f"continuous-batching scheduler stride "
+                f"({_AUDIT_SHARDS}x1 mesh, fp32, 2 lanes): masked-lane "
+                "stepped sweep + refill branch — THE one compiled program "
+                "serving every lane occupancy",
+    loop_copy_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    loop_convert_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    # the stepped while body carries per-lane bookkeeping but must issue
+    # exactly the batched loop's two designed all-reduces (back-projection
+    # psum + convergence-metric psum); the refill branch's guess psums sit
+    # OUTSIDE the loop, amortized over schedule_stride iterations
+    loop_collective_budget={
+        "all-reduce": 2, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+    min_devices=_AUDIT_SHARDS,
+)
+def _audit_sched_step():
+    rng = np.random.default_rng(11)
+    H = rng.random((_AUDIT_P, _AUDIT_V)).astype(np.float32)
+    solver = DistributedSARTSolver(
+        H,
+        opts=SolverOptions(max_iterations=8, conv_tolerance=1e-30,
+                           fused_sweep="off", schedule_stride=4),
+        mesh=make_mesh(_AUDIT_SHARDS, 1),
+    )
+    lanes = solver.sched_lanes(2)
+    g_new = jax.device_put(
+        np.ones((2, solver.padded_npixel), np.float32),
+        NamedSharding(solver.mesh, P(None, PIXEL_AXIS)),
+    )
+    return solver._sched_fn().lower(
+        solver.problem, lanes.state, g_new,
+        jnp.ones(2, jnp.float32),
+        jnp.asarray(np.asarray([True, False])),
+    )

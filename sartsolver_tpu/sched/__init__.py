@@ -1,0 +1,15 @@
+"""Continuous batching: convergence-aware lane retirement and backfill.
+
+The batched solve loop runs a frame group until its slowest frame
+converges; this package keeps the compiled batch shape FULL instead —
+converged lanes retire every ``schedule_stride`` iterations and are
+backfilled from the frame queue, so one fixed-shape compiled program
+serves all traffic at sustained occupancy (docs/PERFORMANCE.md §8).
+"""
+
+from sartsolver_tpu.sched.scheduler import (
+    ContinuousBatcher,
+    SchedRunStats,
+)
+
+__all__ = ["ContinuousBatcher", "SchedRunStats"]

@@ -1,0 +1,367 @@
+"""Convergence-aware batch scheduler for the grouped solve loop.
+
+The run-to-slowest batch loop (cli.py ``run_grouped``) dispatches K
+frames and waits for the SLOWEST to converge — BENCH_r05 measured the
+cost: per-lane loop-iter/s at int8 B=32 drops to ~556 against ~824 at
+B=1 because converged lanes pad the MXU with dead work until the last
+straggler stalls. Per-frame iteration counts genuinely vary (the
+optimization-based-CT literature documents the variance; arxiv
+1705.07497), so the padding is structural, not a tuning artifact.
+
+:class:`ContinuousBatcher` borrows the LLM-serving continuous-batching
+idea: the compiled batch is a set of B persistent *lanes*
+(models/sart.py ``SchedState``), the device program runs at most
+``SolverOptions.schedule_stride`` iterations per dispatch, and between
+strides the host retires converged/diverged/capped lanes and backfills
+them from the frame queue — ONE fixed-shape compiled program serves
+every occupancy, and the queue drains its tail through the same
+program with the leftover lanes inert.
+
+Contracts kept from the dense grouped loop:
+
+- **Parity** — a retired lane's solution/status/iteration count is
+  byte-identical to the same frame solved by the non-scheduled batch
+  path (the stepped core shares the batched loop's ``_SweepContext``
+  closures; pinned by tests/test_sched.py and the straggler bench's
+  parity gate).
+- **Row order** — results are emitted to the writer in FRAME ORDER via
+  a reorder buffer (retirement order is convergence order; the solution
+  file's ``--resume`` contract assumes appended rows are the run's
+  prefix in time order).
+- **Failure isolation** — prefetcher :class:`FrameFailure` items flow
+  through as ordered FAILED rows without occupying a lane; a
+  recoverable dispatch failure fails the in-flight lanes (the dense
+  loop's "the group produced nothing" semantics) and continues on fresh
+  lanes; a device OOM hands the un-emitted frames back to the caller
+  for the classic loop's halving ladder (``SchedRunStats.leftover``) —
+  the scheduler cannot halve its own lane count without recompiling,
+  which would break the one-program contract.
+- **Graceful stop** — ``stop_check`` is polled at stride boundaries: a
+  stop request ends backfilling and the in-flight lanes drain to
+  completion, exactly like the dense loop draining its dispatched
+  group.
+
+Observability (docs/OBSERVABILITY.md): ``sched_lane_occupancy`` gauge
+(useful lane-iterations / lane capacity over the run — THE number
+continuous batching exists to raise), ``sched_lanes_retired_total`` /
+``sched_lanes_backfilled_total`` / ``sched_strides_total`` counters,
+and per-stride occupancy samples in the ``sched_stride_occupancy``
+histogram.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.resilience.degrade import (
+    dispatch_guarded,
+    is_resource_exhausted,
+)
+from sartsolver_tpu.resilience.failures import (
+    RECOVERABLE_FRAME_ERRORS,
+    FrameFailure,
+)
+
+
+@dataclass
+class SchedRunStats:
+    """End-of-run scheduler accounting (plus the OOM fallback payload)."""
+
+    frames: int = 0  # results emitted (FAILED rows included)
+    solved: int = 0  # lanes retired with a solver status
+    failed: int = 0  # FrameFailure rows + isolation-failed lanes
+    backfilled: int = 0  # lane loads (initial fill included)
+    strides: int = 0  # device dispatches
+    loop_steps: int = 0  # solver iterations the device executed
+    useful_iters: int = 0  # per-frame iterations summed over retirees
+    interrupted: bool = False  # a stop request truncated the queue
+    # un-emitted frames (in frame order, FrameFailure items included)
+    # after a device OOM: the caller re-solves them on the classic
+    # grouped loop at a halved group size; None on every other path
+    leftover: Optional[List] = None
+    oom_error: Optional[BaseException] = None
+    events: List[str] = field(default_factory=list)
+
+    @property
+    def occupancy(self) -> float:
+        """Useful lane-iterations / lane capacity actually dispatched."""
+        cap = self.loop_steps and self._capacity
+        return (self.useful_iters / cap) if cap else 0.0
+
+    _capacity: int = 0
+
+
+class _Slot:
+    """One occupied lane's host-side bookkeeping."""
+
+    __slots__ = ("seq", "frame", "ftime", "cam_times", "it_prev")
+
+    def __init__(self, seq, frame, ftime, cam_times):
+        self.seq = seq
+        self.frame = frame  # kept for OOM requeue (one [npixel] fp64 row)
+        self.ftime = ftime
+        self.cam_times = cam_times
+        self.it_prev = 0
+
+
+class ContinuousBatcher:
+    """Drive a :class:`DistributedSARTSolver`'s lane state over a frame
+    stream with convergence-aware retirement and backfill.
+
+    ``on_result(ftime, cam_times, status, iterations, convergence,
+    fetcher, per_frame_ms)`` receives each retired frame in FRAME ORDER
+    (``fetcher`` is a zero-arg callable resolving the denormalized
+    solution row — the async-writer contract);
+    ``on_failed(ftime, cam_times, error)`` receives FAILED frames in the
+    same ordered stream. ``stop_check`` is polled at stride boundaries;
+    ``isolate`` mirrors the CLI's per-frame isolation flag (False:
+    recoverable dispatch errors raise instead of failing the in-flight
+    lanes).
+    """
+
+    def __init__(
+        self,
+        solver,
+        *,
+        lanes: int,
+        on_result: Callable,
+        on_failed: Callable,
+        stop_check: Optional[Callable[[], bool]] = None,
+        on_event: Optional[Callable[[str], None]] = None,
+        isolate: bool = True,
+        refill_quantum: Optional[int] = None,
+    ):
+        if lanes < 1:
+            raise ValueError("Lane count must be positive.")
+        self._solver = solver
+        self._lanes = int(lanes)
+        # A refill stride pays the Eq. 4 guess branch — two extra RTM
+        # sweeps — however many lanes it loads, so refilling lanes one
+        # by one as they trickle out costs ~2B lane-iteration-equivalents
+        # PER FRAME. Waiting until a quarter of the lanes are free
+        # amortizes the guess 4x+ for, at worst, a quantum of briefly
+        # idle lanes (comparable padding to one retirement's stride
+        # rounding). The tail still drains: an empty batch always
+        # refills immediately.
+        if refill_quantum is None:
+            refill_quantum = max(1, self._lanes // 4)
+        self._refill_quantum = max(1, min(int(refill_quantum), self._lanes))
+        self._on_result = on_result
+        self._on_failed = on_failed
+        self._stop_check = stop_check
+        self._on_event = on_event
+        self._isolate = isolate
+        registry = obs_metrics.get_registry()
+        self._occ_gauge = registry.gauge("sched_lane_occupancy")
+        self._occ_hist = registry.histogram("sched_stride_occupancy")
+        self._retired_ctr = registry.counter("sched_lanes_retired_total")
+        self._backfill_ctr = registry.counter("sched_lanes_backfilled_total")
+        self._stride_ctr = registry.counter("sched_strides_total")
+
+    # ---- ordered emission ------------------------------------------------
+
+    def _emit_ready(self) -> None:
+        """Flush the reorder buffer's contiguous prefix to the callbacks
+        (frame order, never retirement order)."""
+        while self._next_emit in self._emit_buf:
+            kind, payload, _frame = self._emit_buf.pop(self._next_emit)
+            self._next_emit += 1
+            if kind == "failed":
+                ftime, cam_times, err = payload
+                self._stats.failed += 1
+                self._stats.frames += 1
+                self._on_failed(ftime, cam_times, err)
+            else:
+                self._stats.frames += 1
+                self._on_result(*payload)
+
+    def _event(self, message: str) -> None:
+        self._stats.events.append(message)
+        if self._on_event is not None:
+            self._on_event(message)
+
+    # ---- main loop -------------------------------------------------------
+
+    def run(self, items) -> SchedRunStats:
+        """Consume the ``(frame, time, camera_times) | FrameFailure``
+        stream until it is drained (or a stop request truncates it).
+        Returns the run stats; ``stats.leftover`` is non-None exactly
+        when a device OOM forced the classic-loop fallback."""
+        solver = self._solver
+        B = self._lanes
+        stats = self._stats = SchedRunStats()
+        self._emit_buf = {}
+        self._next_emit = 0
+        lane_state = solver.sched_lanes(B)
+        it = iter(items)
+        exhausted = False
+        free = deque(range(B))
+        occupied = {}  # lane index -> _Slot
+        seq = 0
+        t_last = time.perf_counter()
+
+        def intake():
+            """Fill free lanes from the stream; FrameFailure items take a
+            sequence slot and go straight to the reorder buffer. Below
+            the refill quantum (and with work still in flight) the free
+            lanes ride empty one more stride instead of paying the
+            guess branch for a single lane."""
+            nonlocal exhausted, seq
+            refills = []
+            if occupied and len(free) < self._refill_quantum:
+                return refills
+            while free and not exhausted and not stats.interrupted:
+                try:
+                    item = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                if isinstance(item, FrameFailure):
+                    self._emit_buf[seq] = (
+                        "failed", (item.time, item.camera_times,
+                                   item.error), None,
+                    )
+                    seq += 1
+                    continue
+                frame, ftime, cam_times = item
+                lane = free.popleft()
+                occupied[lane] = _Slot(seq, np.asarray(frame), ftime,
+                                       cam_times)
+                refills.append((lane, occupied[lane].frame))
+                seq += 1
+            return refills
+
+        while True:
+            if (self._stop_check is not None and not stats.interrupted
+                    and not exhausted and self._stop_check()):
+                # stride-boundary stop: no new frames enter; the in-flight
+                # lanes drain to completion below (the dense loop's
+                # drain-the-dispatched-group semantics). Once the queue is
+                # exhausted a stop cannot truncate anything — the drain
+                # completes every frame, and reporting THAT as interrupted
+                # (exit 4) would make a supervisor requeue a finished job
+                stats.interrupted = True
+            refills = intake()
+            if not occupied and not refills:
+                self._emit_ready()  # trailing FrameFailure rows
+                break
+            try:
+                # the availability wrappers the classic loop gets from
+                # cli.py's dispatch_guarded call: dispatch-phase beacon +
+                # solve.dispatch trace span (ladder=None — the fixed lane
+                # count cannot halve, OOM handling is the leftover path)
+                dispatch_guarded(
+                    lambda: solver.sched_step(lane_state, refills),
+                    ladder=None,
+                )
+            except RECOVERABLE_FRAME_ERRORS as err:
+                if is_resource_exhausted(err):
+                    # the one failure the scheduler cannot absorb at a
+                    # fixed lane count: hand every un-emitted frame back
+                    # (frame order) for the classic loop's halving ladder
+                    self._emit_ready()
+                    stats.leftover = self._requeue(occupied)
+                    stats.oom_error = err
+                    self._event(
+                        f"device OOM in the continuous-batching scheduler "
+                        f"({type(err).__name__}); handing "
+                        f"{len(stats.leftover)} in-flight/buffered "
+                        "frame(s) back to the fixed-group loop"
+                    )
+                    self._finalize()
+                    return stats
+                if not self._isolate:
+                    raise
+                # dispatch failed with no result: every in-flight lane's
+                # frame fails, in order (the dense loop's "the group
+                # produced nothing"), and the run continues on fresh lanes
+                for lane in sorted(occupied, key=lambda b: occupied[b].seq):
+                    slot = occupied[lane]
+                    self._emit_buf[slot.seq] = (
+                        "failed", (slot.ftime, slot.cam_times, err), None,
+                    )
+                occupied.clear()
+                free = deque(range(B))
+                lane_state = solver.sched_lanes(B)
+                self._emit_ready()
+                continue
+            stats.strides += 1
+            self._stride_ctr.inc()
+            stats.backfilled += len(refills)
+            self._backfill_ctr.inc(len(refills))
+            done, status, iters, conv, itv = lane_state.scalars()
+            # device-side stride length: the while loop exits early once
+            # every lane is done, so measure what actually ran
+            steps = 0
+            useful = 0
+            for lane, slot in occupied.items():
+                delta = int(itv[lane]) - slot.it_prev
+                slot.it_prev = int(itv[lane])
+                steps = max(steps, delta)
+                useful += delta
+            stats.loop_steps += steps
+            stats._capacity += steps * B
+            stats.useful_iters += useful
+            if steps:
+                self._occ_hist.observe(useful / (steps * B))
+            # retire: convergence order on device, frame order out
+            now = time.perf_counter()
+            retired_now = [
+                lane for lane in occupied if done[lane]
+            ]
+            for lane in sorted(retired_now,
+                               key=lambda b: occupied[b].seq):
+                slot = occupied.pop(lane)
+                fetcher = lane_state.lane_solution_fetcher(lane)
+                stats.solved += 1
+                self._retired_ctr.inc()
+                per_frame_ms = ((now - t_last) * 1e3
+                                / max(len(retired_now), 1))
+                self._emit_buf[slot.seq] = (
+                    "result",
+                    (slot.ftime, slot.cam_times, int(status[lane]),
+                     int(iters[lane]), float(conv[lane]), fetcher,
+                     per_frame_ms),
+                    # the raw frame rides along until emission: an OOM
+                    # requeue must be able to re-solve an out-of-order
+                    # completion stuck behind a still-in-flight lane
+                    slot.frame,
+                )
+                free.append(lane)
+            if retired_now:
+                t_last = now
+            self._emit_ready()
+        self._finalize()
+        return stats
+
+    def _requeue(self, occupied) -> List:
+        """Un-emitted frames in frame order for the classic-loop
+        fallback. Completed-but-unemitted results (out-of-order
+        completions stuck behind a still-in-flight lane) are discarded
+        and RE-SOLVED from their buffered raw frames — emitting a
+        device result after the fallback re-solves an earlier frame
+        would break row order; OOM is rare, row order is the
+        contract."""
+        entries = []
+        for seq_i, (kind, payload, frame) in self._emit_buf.items():
+            if kind == "failed":
+                ftime, cam_times, err = payload
+                entries.append((seq_i, FrameFailure(None, ftime,
+                                                    cam_times, err)))
+            else:
+                ftime, cam_times = payload[0], payload[1]
+                entries.append((seq_i, (frame, ftime, cam_times)))
+        for lane, slot in occupied.items():
+            entries.append((slot.seq, (slot.frame, slot.ftime,
+                                       slot.cam_times)))
+        self._emit_buf.clear()
+        return [item for _, item in sorted(entries, key=lambda e: e[0])]
+
+    def _finalize(self) -> None:
+        self._occ_gauge.set(round(self._stats.occupancy, 6))

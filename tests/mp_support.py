@@ -1,0 +1,90 @@
+"""Environment gate for REAL multi-process (multi-controller) tests.
+
+jaxlib's CPU backend only gained multiprocess collectives in later
+releases; on builds without them (e.g. jaxlib 0.4.37) every computation
+spanning processes dies with ``INVALID_ARGUMENT: Multiprocess
+computations aren't implemented on the CPU backend`` — an *environment*
+limitation, not a regression in this repo. The 13 multiprocess tests and
+the two-process killdrill used to FAIL on such builds, burying real
+regressions in a known-red tier-1; they now consult this probe and SKIP
+with an explicit reason instead, so tier-1 is green wherever the code is
+actually testable and the multi-controller paths light back up
+automatically on a capable jaxlib.
+
+The probe is full-fidelity: two real processes initialize the JAX
+distributed runtime on a free local port and run one cross-process
+allgather — exactly the first collective every gated test would issue.
+Result is cached per session (one ~10 s probe when unsupported, then
+free). Overrides for CI hygiene:
+
+- ``SART_MP_TESTS=1`` — skip the probe, force the tests to RUN (a build
+  that claims support must prove it);
+- ``SART_MP_TESTS=0`` — skip the probe, force the tests to SKIP.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import socket
+import subprocess
+import sys
+
+SKIP_REASON = (
+    "jaxlib CPU backend lacks multiprocess collectives in this "
+    "environment (probe failed: cross-process computations are "
+    "unimplemented); set SART_MP_TESTS=1 to force-run"
+)
+
+_PROBE_SRC = """
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=sys.argv[1],
+    num_processes=2,
+    process_id=int(sys.argv[2]),
+)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+out = multihost_utils.process_allgather(jnp.ones((1,), jnp.float32))
+assert out.shape[0] == 2, out.shape
+print("MP_COLLECTIVES_OK")
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@functools.lru_cache(maxsize=None)
+def multiprocess_collectives_supported() -> bool:
+    """True when a real 2-process CPU collective works here (cached)."""
+    forced = os.environ.get("SART_MP_TESTS", "")
+    if forced == "1":
+        return True
+    if forced == "0":
+        return False
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no tunnel plugin in children
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC, coordinator, str(rank)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=120)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        return False
+    return all(p.returncode == 0 for p in procs) and all(
+        "MP_COLLECTIVES_OK" in out for out in outs
+    )

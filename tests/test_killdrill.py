@@ -32,6 +32,7 @@ import numpy as np
 import pytest
 
 import fixtures as fx
+import mp_support
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_HERE)
@@ -388,6 +389,10 @@ def test_two_process_kill_then_resume(drill_world):
     marker (only process 0 writes output) — then resumed by a fresh
     2-process run; the final file equals an uninterrupted 2-process
     run's."""
+    # environment gate, checked lazily so the single-process drills in
+    # this module never pay the two-process probe (tests/mp_support.py)
+    if not mp_support.multiprocess_collectives_supported():
+        pytest.skip(mp_support.SKIP_REASON)
     paths, _, _, td = drill_world
     ref_out = str(td / "mp_reference.h5")
     _run_mp_pair(paths, ref_out)

@@ -22,6 +22,17 @@ import numpy as np
 import pytest
 
 import fixtures as fx
+import mp_support
+
+# Environment gate (tests/mp_support.py): on jaxlib builds whose CPU
+# backend has no multiprocess collectives every test here would fail on
+# "Multiprocess computations aren't implemented on the CPU backend" —
+# an environment limitation, so skip (not fail) with the reason visible;
+# SART_MP_TESTS=1 force-runs on a capable build.
+pytestmark = pytest.mark.skipif(
+    not mp_support.multiprocess_collectives_supported(),
+    reason=mp_support.SKIP_REASON,
+)
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _REPO = os.path.dirname(_HERE)

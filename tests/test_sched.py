@@ -1,0 +1,613 @@
+"""Continuous batching (sartsolver_tpu/sched/, docs/PERFORMANCE.md §8):
+scheduler edge cases, masked-lane byte parity against the dense grouped
+loop, the one-compiled-program contract, failure/OOM/stop policy, and
+the CLI + obs integration."""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.cli import main
+from sartsolver_tpu.config import DIVERGED, SolverOptions
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.ops.laplacian import make_laplacian
+from sartsolver_tpu.parallel.mesh import make_mesh
+from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.failures import FrameFailure
+from sartsolver_tpu.sched import ContinuousBatcher
+
+
+# ---------------------------------------------------------------------------
+# harness: a tiny solver + a mixed-convergence frame set + both loops
+# ---------------------------------------------------------------------------
+
+P_PIX, V_VOX = 24, 16
+
+
+def _mixed_case(n, seed=0, spread=True):
+    """(H, frames): per-frame iteration counts genuinely vary (SART
+    converges low spatial frequencies first, so frames whose truth
+    carries more fine structure straggle)."""
+    rng = np.random.default_rng(seed)
+    H = rng.uniform(0.1, 1.0, (P_PIX, V_VOX)).astype(np.float32)
+    x = np.arange(V_VOX) / V_VOX
+    base = 1.0 + 0.5 * np.sin(2 * np.pi * x)
+    rough = np.sin(2 * np.pi * 6.5 * x)
+    amps = np.geomspace(1e-3, 3.0, n) if spread else np.zeros(n)
+    rng.shuffle(amps)
+    frames = []
+    for i in range(n):
+        f_i = np.maximum(base + amps[i] * rough, 1e-3)
+        g_i = H.astype(np.float64) @ f_i
+        frames.append(np.maximum(
+            g_i * (1.0 + 1e-3 * rng.standard_normal(P_PIX)), 0.0))
+    return H, frames
+
+
+def _opts(**kw):
+    kw.setdefault("max_iterations", 300)
+    kw.setdefault("conv_tolerance", 1e-6)
+    kw.setdefault("schedule_stride", 8)
+    return SolverOptions(**kw)
+
+
+def _solver(H, opts, lap=None):
+    return DistributedSARTSolver(H, lap, opts=opts, mesh=make_mesh(1, 1))
+
+
+def _run_sched(solver, items, lanes, **kw):
+    """Drive the batcher; returns (results ordered-by-emission, stats).
+    Each result is ("ok", ftime, status, iters, solution) or
+    ("failed", ftime, error)."""
+    out = []
+
+    def on_result(ftime, _ct, status, iters, _conv, fetcher, _ms):
+        out.append(("ok", ftime, status, iters, fetcher()))
+
+    def on_failed(ftime, _ct, err):
+        out.append(("failed", ftime, err))
+
+    batcher = ContinuousBatcher(solver, lanes=lanes, on_result=on_result,
+                                on_failed=on_failed, **kw)
+    stats = batcher.run(iter(items))
+    return out, stats
+
+
+def _run_dense(solver, frames, K):
+    """The CLI's classic run-to-slowest group loop: frame-order groups of
+    K, dark-frame tail padding, per-frame rows."""
+    sols, statuses, iters = [], [], []
+    for s in range(0, len(frames), K):
+        stack = np.stack(frames[s:s + K])
+        n = stack.shape[0]
+        if n < K:
+            stack = np.concatenate(
+                [stack, np.zeros((K - n, stack.shape[1]))], axis=0)
+        res = solver.solve_batch(stack, device_result=True)
+        sols.append(res.fetch_solutions()[:n])
+        statuses.extend(res.status[:n].tolist())
+        iters.extend(res.iterations[:n].tolist())
+    return np.concatenate(sols), statuses, iters
+
+
+def _items(frames):
+    return [(fr, float(i), [float(i)]) for i, fr in enumerate(frames)]
+
+
+# ---------------------------------------------------------------------------
+# parity + edge cases (ISSUE 6 satellite: scheduler edge-case coverage)
+# ---------------------------------------------------------------------------
+
+def test_masked_lane_byte_parity_vs_dense_grouped():
+    """THE contract: every retired lane's solution/status/iteration count
+    is byte-identical to the dense run-to-slowest loop solving the same
+    frame order — on a frame set whose iteration counts genuinely spread
+    (otherwise the test proves nothing about masking)."""
+    H, frames = _mixed_case(10, seed=1)
+    opts = _opts()
+    with _solver(H, opts) as solver:
+        want_sol, want_st, want_it = _run_dense(solver, frames, 4)
+        got, stats = _run_sched(solver, _items(frames), lanes=4)
+    assert [r[0] for r in got] == ["ok"] * 10
+    # emission is frame order by contract
+    assert [r[1] for r in got] == [float(i) for i in range(10)]
+    assert [r[2] for r in got] == want_st
+    assert [r[3] for r in got] == want_it
+    np.testing.assert_array_equal(np.stack([r[4] for r in got]), want_sol)
+    # the workload really is mixed — otherwise retirement never fires
+    # before the group drains and the parity is vacuous
+    assert max(want_it) >= 2 * min(want_it)
+    assert stats.frames == 10 and stats.solved == 10
+    assert stats.backfilled >= 10  # every frame occupied a lane
+    assert 0.0 < stats.occupancy <= 1.0
+
+
+def test_tail_drain_below_full_batch():
+    """Backfill at exhaustion: fewer frames than lanes — the tail drains
+    through the same fixed-shape program with the leftover lanes inert,
+    and the results match the dense loop's padded group bitwise."""
+    H, frames = _mixed_case(2, seed=2)
+    opts = _opts()
+    with _solver(H, opts) as solver:
+        want_sol, want_st, want_it = _run_dense(solver, frames, 5)
+        got, stats = _run_sched(solver, _items(frames), lanes=5)
+    assert [r[2] for r in got] == want_st
+    assert [r[3] for r in got] == want_it
+    np.testing.assert_array_equal(np.stack([r[4] for r in got]), want_sol)
+    assert stats.frames == 2 and stats.backfilled == 2
+
+
+def test_all_lanes_converge_in_one_stride():
+    """A stride longer than any frame's iteration count: every occupied
+    lane retires at its first control return (the device while loop exits
+    early once all lanes are done — no dead iterations to the stride
+    cap), and each refill generation costs exactly one stride."""
+    H, frames = _mixed_case(6, seed=3)
+    opts = _opts(schedule_stride=10_000)
+    with _solver(H, opts) as solver:
+        want_sol, want_st, want_it = _run_dense(solver, frames, 3)
+        got, stats = _run_sched(solver, _items(frames), lanes=3)
+    assert [r[2] for r in got] == want_st
+    assert [r[3] for r in got] == want_it
+    np.testing.assert_array_equal(np.stack([r[4] for r in got]), want_sol)
+    # 6 frames / 3 lanes = 2 generations = 2 strides
+    assert stats.strides == 2
+    # early exit: the device ran to the slowest lane, not to the stride
+    assert stats.loop_steps <= max(want_it) * 2
+
+
+def test_schedule_stride_one():
+    """stride=1 (retirement checked every iteration) stays byte-correct —
+    the degenerate maximum-overhead point of the stride trade-off."""
+    H, frames = _mixed_case(4, seed=4)
+    opts = _opts(schedule_stride=1, max_iterations=60)
+    with _solver(H, opts) as solver:
+        want_sol, want_st, want_it = _run_dense(solver, frames, 2)
+        got, _stats = _run_sched(solver, _items(frames), lanes=2)
+    assert [r[3] for r in got] == want_it
+    np.testing.assert_array_equal(np.stack([r[4] for r in got]), want_sol)
+
+
+def test_divergence_recovery_rollback_inside_masked_batch():
+    """The rollback/relaxation ladder runs per lane inside the masked
+    batch: a genuinely diverging configuration (explicit-Euler-unstable
+    Laplacian weight) ends DIVERGED with a finite iterate, healthy lanes
+    alongside it are untouched, and every lane is byte-identical to the
+    dense guarded loop on the same frame order."""
+    H, frames = _mixed_case(6, seed=5)
+    rows, cols, vals = [], [], []
+    for i in range(V_VOX):
+        rows.append(i); cols.append(i); vals.append(2.0)
+        if i > 0:
+            rows.append(i); cols.append(i - 1); vals.append(-1.0)
+        if i < V_VOX - 1:
+            rows.append(i); cols.append(i + 1); vals.append(-1.0)
+    lap = make_laplacian(np.asarray(rows), np.asarray(cols),
+                         np.asarray(vals, np.float32), dtype="float32")
+    opts = _opts(max_iterations=120, beta_laplace=0.8,
+                 divergence_recovery=4, divergence_threshold=1e3)
+    with _solver(H, opts, lap=lap) as solver:
+        want_sol, want_st, want_it = _run_dense(solver, frames, 3)
+        got, _stats = _run_sched(solver, _items(frames), lanes=3)
+    assert DIVERGED in want_st  # the ladder genuinely exhausted
+    assert [r[2] for r in got] == want_st
+    assert [r[3] for r in got] == want_it
+    np.testing.assert_array_equal(np.stack([r[4] for r in got]), want_sol)
+    assert np.isfinite(np.stack([r[4] for r in got])).all()
+
+
+def test_nan_poisoned_frame_diverges_in_lane():
+    """The refill branch's pre-flight input guard (recovery mode): a NaN
+    frame pre-fails DIVERGED in its lane with zero iterations while its
+    neighbours solve exactly as in a clean run."""
+    H, frames = _mixed_case(5, seed=6)
+    bad = frames[2].copy()
+    bad[0] = np.nan
+    poisoned = frames[:2] + [bad] + frames[3:]
+    opts = _opts(divergence_recovery=2)
+    with _solver(H, opts) as solver:
+        clean, _ = _run_sched(solver, _items(frames), lanes=2)
+        got, _ = _run_sched(solver, _items(poisoned), lanes=2)
+    assert got[2][2] == DIVERGED and got[2][3] == 0
+    np.testing.assert_array_equal(got[2][4], 0.0)
+    for i in (0, 1, 3, 4):
+        np.testing.assert_array_equal(got[i][4], clean[i][4])
+
+
+def test_one_compiled_program_across_occupancies():
+    """The fixed batch shape is the whole point: a run whose occupancy
+    visits full, partial and single-lane states must leave exactly ONE
+    compiled stride program in the jit cache — no per-occupancy
+    recompiles."""
+    H, frames = _mixed_case(7, seed=7)
+    opts = _opts()
+    with _solver(H, opts) as solver:
+        _run_sched(solver, _items(frames), lanes=3)
+        assert solver._sched_fn()._cache_size() == 1
+
+
+# ---------------------------------------------------------------------------
+# failure policy
+# ---------------------------------------------------------------------------
+
+def test_frame_failure_items_flow_through_in_order():
+    """Prefetcher FrameFailure items take a sequence slot (no lane) and
+    come out interleaved at their frame position."""
+    H, frames = _mixed_case(4, seed=8)
+    err = OSError("unreadable")
+    items = [_items(frames)[0],
+             FrameFailure(None, 1.0, [1.0], err),
+             *_items(frames)[1:]]
+    items[2] = (items[2][0], 2.0, [2.0])
+    items[3] = (items[3][0], 3.0, [3.0])
+    items[4] = (items[4][0], 4.0, [4.0])
+    opts = _opts()
+    with _solver(H, opts) as solver:
+        got, stats = _run_sched(solver, items, lanes=2)
+    assert [r[0] for r in got] == ["ok", "failed", "ok", "ok", "ok"]
+    assert [r[1] for r in got] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert got[1][2] is err
+    assert stats.failed == 1 and stats.solved == 4 and stats.frames == 5
+
+
+def test_dispatch_fault_fails_inflight_lanes_and_continues():
+    """A recoverable (non-OOM) dispatch fault fails exactly the in-flight
+    lanes — the dense loop's 'the group produced nothing' — and the run
+    continues on fresh lanes."""
+    H, frames = _mixed_case(6, seed=9)
+    opts = _opts()
+    faults.reset()
+    faults.inject(faults.SITE_SOLVE, "error", count=1)
+    try:
+        with _solver(H, opts) as solver:
+            got, stats = _run_sched(solver, _items(frames), lanes=2)
+    finally:
+        faults.reset()
+    # first stride's 2 lanes fail; the rest solve
+    kinds = [r[0] for r in got]
+    assert kinds[:2] == ["failed", "failed"] and kinds[2:] == ["ok"] * 4
+    assert [r[1] for r in got] == [float(i) for i in range(6)]
+    assert stats.failed == 2 and stats.solved == 4
+    assert stats.leftover is None
+
+
+def test_dispatch_fault_raises_without_isolation():
+    H, frames = _mixed_case(3, seed=10)
+    opts = _opts()
+    faults.reset()
+    faults.inject(faults.SITE_SOLVE, "error", count=1)
+    try:
+        with _solver(H, opts) as solver:
+            with pytest.raises(faults.InjectedFault):
+                _run_sched(solver, _items(frames), lanes=2, isolate=False)
+    finally:
+        faults.reset()
+
+
+def test_oom_hands_unemitted_frames_back_in_order():
+    """Device OOM: the one failure a fixed lane count cannot absorb. The
+    scheduler returns every un-emitted frame (in-flight AND buffered
+    out-of-order completions) in frame order for the classic loop's
+    halving ladder, and the frames re-solve to the right answers."""
+    H, frames = _mixed_case(6, seed=11)
+    opts = _opts(max_iterations=800)
+    faults.reset()
+    faults.inject(faults.SITE_SOLVE, "oom", count=1)
+    try:
+        with _solver(H, opts) as solver:
+            items = iter(_items(frames))
+            got, stats = _run_sched(solver, items, lanes=2)
+            assert got == []  # nothing emitted before the first dispatch
+            assert stats.leftover is not None
+            assert stats.oom_error is not None
+            # the two in-flight frames come back in frame order; the rest
+            # of the stream was never consumed (the CLI fallback chains
+            # leftover + the live iterator)
+            assert [it[1] for it in stats.leftover] == [0.0, 1.0]
+            assert len(list(items)) == 4
+            faults.reset()
+            # the CLI fallback path: the same items re-solve dense
+            _sol, st, _ = _run_dense(
+                solver, [it[0] for it in stats.leftover], 1)
+            assert st == [0] * 2
+    finally:
+        faults.reset()
+
+
+def test_stop_check_drains_inflight_and_truncates_queue():
+    """A stop request at a stride boundary ends backfilling; the lanes
+    already in flight drain to full convergence (their results emitted),
+    the rest of the queue is left unread."""
+    H, frames = _mixed_case(8, seed=12)
+    opts = _opts(schedule_stride=2)
+    polls = {"n": 0}
+
+    def stop_after_two():
+        polls["n"] += 1
+        return polls["n"] > 2
+
+    with _solver(H, opts) as solver:
+        got, stats = _run_sched(solver, _items(frames), lanes=2,
+                                stop_check=stop_after_two)
+    assert stats.interrupted
+    # the 2 in-flight lanes drained; the queue's tail was never read
+    assert 0 < len(got) < 8
+    assert all(r[0] == "ok" and r[2] == 0 for r in got)
+
+
+def test_stop_during_tail_drain_is_not_interrupted():
+    """A stop request landing AFTER the queue is exhausted cannot
+    truncate anything — the in-flight lanes drain to completion and every
+    frame is emitted, so the run must NOT report interrupted (exit 4
+    would make a supervisor requeue a finished job; same contract as the
+    classic loop's last-boundary check)."""
+    H, frames = _mixed_case(3, seed=12)
+    opts = _opts(schedule_stride=2)
+    polls = {"n": 0}
+
+    def stop_after_first_poll():
+        polls["n"] += 1
+        return polls["n"] > 1
+
+    with _solver(H, opts) as solver:
+        # lanes > frames: the first intake exhausts the stream, so every
+        # stop poll after the first lands during the tail drain
+        got, stats = _run_sched(solver, _items(frames), lanes=4,
+                                stop_check=stop_after_first_poll)
+    assert not stats.interrupted
+    assert len(got) == 3
+    assert all(r[0] == "ok" and r[2] == 0 for r in got)
+
+
+def test_lane_and_stride_validation():
+    H, frames = _mixed_case(1, seed=13)
+    with pytest.raises(ValueError, match="schedule_stride"):
+        _opts(schedule_stride=0)
+    with _solver(H, _opts()) as solver:
+        with pytest.raises(ValueError, match="[Ll]ane count"):
+            solver.sched_lanes(0)
+        with pytest.raises(ValueError, match="[Ll]ane count"):
+            ContinuousBatcher(solver, lanes=0, on_result=lambda *a: None,
+                              on_failed=lambda *a: None)
+    # closed solver: the lane entry points refuse like solve_batch does
+    with pytest.raises(ValueError, match="closed"):
+        solver.sched_lanes(2)
+
+
+def test_scheduler_occupancy_accounting_beats_run_to_slowest():
+    """The accounting itself (not wall clock — deterministic on CI): on a
+    straggler-heavy stream (one slow frame per ~8, the bench.py
+    straggler distribution in miniature) the scheduler's useful-
+    iteration occupancy is >= 1.5x the dense loop's run-to-slowest
+    occupancy."""
+    rng = np.random.default_rng(0)
+    H = rng.uniform(0.1, 1.0, (P_PIX, V_VOX)).astype(np.float32)
+    x = np.arange(V_VOX) / V_VOX
+    base = 1.0 + 0.5 * np.sin(2 * np.pi * x)
+    rough = np.sin(2 * np.pi * 6.5 * x)
+    n = 24
+    amps = np.full(n, 1e-3)
+    # one straggler (~3x the iterations) leading every dense group of 4:
+    # the run-to-slowest loop pads 3 fast lanes per group while the
+    # scheduler retires and backfills them
+    amps[::4] = 3.0
+    frames = [
+        np.maximum(
+            H.astype(np.float64) @ np.maximum(base + a * rough, 1e-3)
+            * (1.0 + 1e-3 * rng.standard_normal(P_PIX)), 0.0)
+        for a in amps
+    ]
+    opts = _opts(conv_tolerance=1e-5, max_iterations=800,
+                 schedule_stride=4)
+    with _solver(H, opts) as solver:
+        _, statuses, iters = _run_dense(solver, frames, 4)
+        # dense capacity: every group runs to its slowest frame
+        cap = sum(max(iters[s:s + 4]) * 4
+                  for s in range(0, len(frames), 4))
+        dense_occ = sum(iters) / cap
+        _, stats = _run_sched(solver, _items(frames), lanes=4)
+    assert statuses == [0] * n
+    assert stats.useful_iters == sum(iters)  # identical useful work
+    assert stats.occupancy >= 1.5 * dense_occ
+
+
+# ---------------------------------------------------------------------------
+# CLI + obs integration
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def world(tmp_path):
+    return fx.write_world(tmp_path, n_frames=5)
+
+
+def run_cli(paths, *extra):
+    return main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "300", "-c", "1e-6", "--no_guess",
+        *extra,
+    ])
+
+
+def _read_solution(path):
+    with h5py.File(path, "r") as f:
+        return {k: np.array(f["solution"][k]) for k in f["solution"]}
+
+
+def test_cli_scheduled_matches_classic_loop_bitwise(world):
+    """--batch_frames N runs the scheduler by default; its solution file
+    equals --no_continuous_batching's dataset for dataset, byte for
+    byte."""
+    paths, *_ = world
+    assert run_cli(paths, "--batch_frames", "3") == 0
+    sched = _read_solution(paths["output"])
+    assert run_cli(paths, "--batch_frames", "3",
+                   "--no_continuous_batching") == 0
+    dense = _read_solution(paths["output"])
+    assert set(sched) == set(dense)
+    for key in sched:
+        np.testing.assert_array_equal(sched[key], dense[key])
+
+
+def test_cli_schedule_stride_flag_and_env(world, monkeypatch):
+    paths, *_ = world
+    # flag wins over env; both byte-identical to the default (the stride
+    # never changes per-lane math, only control-return cadence)
+    assert run_cli(paths, "--batch_frames", "2") == 0
+    want = _read_solution(paths["output"])
+    monkeypatch.setenv("SART_SCHEDULE_STRIDE", "3")
+    assert run_cli(paths, "--batch_frames", "2") == 0
+    got_env = _read_solution(paths["output"])
+    assert run_cli(paths, "--batch_frames", "2",
+                   "--schedule_stride", "5") == 0
+    got_flag = _read_solution(paths["output"])
+    for key in want:
+        np.testing.assert_array_equal(want[key], got_env[key])
+        np.testing.assert_array_equal(want[key], got_flag[key])
+    with pytest.raises(SystemExit):
+        run_cli(paths, "--schedule_stride", "0")
+    monkeypatch.setenv("SART_SCHEDULE_STRIDE", "-2")
+    assert run_cli(paths, "--batch_frames", "2") == 1  # SartInputError
+    # malformed values fail loudly too — an operator typo on a perf knob
+    # must not silently run at the default stride
+    monkeypatch.setenv("SART_SCHEDULE_STRIDE", "1e2")
+    assert run_cli(paths, "--batch_frames", "2") == 1
+
+
+def test_cli_scheduler_oom_falls_back_to_classic_ladder(world):
+    """A device OOM inside the scheduler hands the stream back to the
+    classic grouped loop at half the lane count — the run completes with
+    every frame solved (the fixed-shape scheduler cannot halve itself)."""
+    paths, *_ = world
+    faults.reset()
+    faults.inject(faults.SITE_SOLVE, "oom", count=1, prob=1.0)
+    try:
+        assert run_cli(paths, "--batch_frames", "4") == 0
+    finally:
+        faults.reset()
+    out = _read_solution(paths["output"])
+    assert list(out["status"]) == [0] * 5
+    # parity with the never-faulted classic loop
+    assert run_cli(paths, "--batch_frames", "2",
+                   "--no_continuous_batching") == 0
+    dense = _read_solution(paths["output"])
+    np.testing.assert_array_equal(out["value"], dense["value"])
+
+
+def test_cli_scheduler_oom_after_stream_exhausted(world):
+    """OOM fallback when the prefetcher is already drained: with more
+    lanes than frames the intake consumes the whole stream (end sentinel
+    included) before the first dispatch, so the fallback must continue
+    the batcher's own iterator — re-iterating the prefetcher would block
+    forever on an empty queue."""
+    paths, *_ = world
+    faults.reset()
+    faults.inject(faults.SITE_SOLVE, "oom", count=1, prob=1.0)
+    try:
+        assert run_cli(paths, "--batch_frames", "8") == 0
+    finally:
+        faults.reset()
+    out = _read_solution(paths["output"])
+    assert list(out["status"]) == [0] * 5
+    assert run_cli(paths, "--batch_frames", "2",
+                   "--no_continuous_batching") == 0
+    dense = _read_solution(paths["output"])
+    np.testing.assert_array_equal(out["value"], dense["value"])
+
+
+def test_cli_scheduler_metrics_artifact(world, tmp_path, monkeypatch):
+    """--metrics_out carries the scheduler's occupancy gauge/counters and
+    the iterations_to_converge histogram; the artifact validates; the
+    trace has solve.dispatch spans (the scheduler dispatches through the
+    same dispatch_guarded wrapper as the classic loop)."""
+    paths, *_ = world
+    art = str(tmp_path / "run.jsonl")
+    trace_out = str(tmp_path / "run.trace.json")
+    monkeypatch.setenv("SART_TRACE_EVENTS", trace_out)
+    assert run_cli(paths, "--batch_frames", "2", "--metrics_out", art) == 0
+    with open(trace_out) as fh:
+        trace = json.load(fh)
+    dispatch_spans = [e for e in trace["traceEvents"]
+                      if e.get("name") == "solve.dispatch"]
+    assert len(dispatch_spans) >= 1  # one per scheduler stride
+    with open(art) as fh:
+        records = [json.loads(line) for line in fh if line.strip()]
+    metric = {
+        (r["name"], tuple(sorted((r.get("labels") or {}).items()))): r
+        for r in records if r.get("type") == "metric"
+    }
+    occ = metric[("sched_lane_occupancy", ())]
+    assert 0.0 < occ["value"] <= 1.0
+    assert metric[("sched_lanes_retired_total", ())]["value"] == 5
+    assert metric[("sched_lanes_backfilled_total", ())]["value"] == 5
+    assert metric[("sched_strides_total", ())]["value"] >= 1
+    hist = metric[("iterations_to_converge", ())]
+    assert hist["kind"] == "histogram" and hist["count"] == 5
+    assert hist["min"] >= 1
+    # the artifact passes the schema/run-contract check
+    from sartsolver_tpu.obs.cli import metrics_main
+
+    assert metrics_main(["--check", art]) == 0
+
+
+def test_metrics_diff_gates_convergence_drift(tmp_path):
+    """`sartsolve metrics --diff --threshold` exits 2 when the mean
+    iterations_to_converge drifts past the threshold — in either
+    direction — and 0 within it."""
+    from sartsolver_tpu.obs.cli import metrics_main
+
+    from sartsolver_tpu.obs import schema
+
+    def artifact(name, iters):
+        reg = obs_metrics.MetricsRegistry()
+        h = reg.histogram("iterations_to_converge")
+        for i in iters:
+            h.observe(i)
+        recs = [schema.make_meta_record(created_unix=1.0),
+                schema.make_frame_record(0.0, 0, "converged",
+                                         int(iters[0]), 1.0, 0.5, "sched")]
+        recs += [{"type": "metric", **snap} for snap in reg.snapshot()]
+        recs.append(schema.make_summary_record(
+            1, {"converged": 1}, wall_s=1.0))
+        path = str(tmp_path / name)
+        with open(path, "w") as fh:
+            for rec in recs:
+                fh.write(json.dumps(rec) + "\n")
+        return path
+
+    a = artifact("a.jsonl", [100, 100])
+    slower = artifact("slower.jsonl", [160, 160])  # +60%
+    faster = artifact("faster.jsonl", [40, 40])  # -60%
+    same = artifact("same.jsonl", [104, 104])  # +4%
+    assert metrics_main(["--diff", a, slower, "--threshold", "25"]) == 2
+    assert metrics_main(["--diff", a, faster, "--threshold", "25"]) == 2
+    assert metrics_main(["--diff", a, same, "--threshold", "25"]) == 0
+    assert metrics_main(["--diff", a, slower]) == 0  # report-only
+
+
+def test_metrics_diff_gates_straggler_headline(tmp_path):
+    """The BENCH artifact's occupancy-weighted straggler throughput is a
+    gated rate: a drop past the threshold exits 2."""
+    from sartsolver_tpu.obs.cli import metrics_main
+
+    def bench(name, occ_rate):
+        rec = {"type": "bench", "schema": 1, "metric": "m", "value": 100.0,
+               "unit": "iter/s", "vs_baseline": 1.0,
+               "detail": {"straggler": {"occ_frame_iter_s": occ_rate,
+                                        "occupancy": 0.9}}}
+        path = str(tmp_path / name)
+        with open(path, "w") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        return path
+
+    old = bench("old.json", 1000.0)
+    bad = bench("bad.json", 500.0)
+    ok = bench("ok.json", 950.0)
+    assert metrics_main(["--diff", old, bad, "--threshold", "30"]) == 2
+    assert metrics_main(["--diff", old, ok, "--threshold", "30"]) == 0
