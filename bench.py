@@ -636,6 +636,50 @@ def _worker_main() -> int:
         finally:
             solver.close()
 
+    def run_integrity(timed_reps: int) -> dict:
+        """Integrity-on vs integrity-off fixed-iteration throughput
+        (ISSUE 7, docs/RESILIENCE.md §8): the in-solve ABFT check costs
+        two dot products folded into the convergence all-reduce — the
+        acceptance bar is the on-rate staying within a few percent of
+        off on real hardware. Both rates land in the artifact and the
+        on-rate is gated run-over-run by `sartsolve metrics --diff`
+        (detail.integrity.iter_s_on)."""
+        problem = get_problem("float32")
+        g_dev = jnp.asarray(G_n[:1])
+        msq_dev = jnp.asarray(msqs[:1], jnp.float32)
+        f0 = jnp.zeros((1, V), jnp.float32)
+
+        def rate(flag: bool) -> float:
+            opts = SolverOptions(
+                max_iterations=iters, conv_tolerance=0.0,
+                fused_sweep="auto", integrity=flag,
+            )
+
+            def run():
+                return solve_normalized_batch(
+                    problem, g_dev, msq_dev, f0, opts=opts,
+                    axis_name=None, voxel_axis=None, use_guess=True,
+                )
+
+            res = run()
+            np.asarray(res.solution)  # compile + warm
+            n_done = max(int(res.iterations[0]), 1)
+            best = float("inf")
+            for _ in range(timed_reps):
+                t_rep = time.perf_counter()
+                res = run()
+                np.asarray(res.solution)
+                best = min(best, time.perf_counter() - t_rep)
+            return n_done / best
+
+        off = rate(False)
+        on = rate(True)
+        return {
+            "iter_s_off": round(off, 2),
+            "iter_s_on": round(on, 2),
+            "overhead_pct": round(100.0 * (off - on) / off, 2) if off else 0.0,
+        }
+
     def run_probe() -> dict:
         """~0.35 s fixed-shape bandwidth probe (VERDICT r4 next #5): a
         50-step power iteration over the staged fp32 matrix using the
@@ -795,6 +839,8 @@ def _worker_main() -> int:
                 data = run_sharded(item["rtm_dtype"], item["reps"])
             elif item["kind"] == "straggler":
                 data = run_straggler(item["B"], item["reps"])
+            elif item["kind"] == "integrity":
+                data = run_integrity(item["reps"])
             elif item["kind"] == "probe":
                 data = run_probe()
             else:
@@ -1095,6 +1141,13 @@ def main() -> int:
     items.append({"kind": "straggler", "id": f"straggler:B{strag_B}",
                   "B": strag_B, "reps": 2, "deadline": budget_s + 240,
                   "timeout": conv_timeout})
+    # numerical-integrity overhead section (ISSUE 7): integrity-on vs
+    # integrity-off iter/s at the headline config; the on-rate is gated
+    # run-over-run by `make bench-smoke`'s `sartsolve metrics --diff`.
+    # Runs in quick mode too so the smoke artifact carries it.
+    items.append({"kind": "integrity", "id": "integrity:overhead",
+                  "reps": 2, "deadline": budget_s + 240,
+                  "timeout": cfg_timeout})
     # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
@@ -1165,6 +1218,11 @@ def main() -> int:
         # the occupancy-weighted headline `sartsolve metrics --diff`
         # gates on (detail.straggler.occ_frame_iter_s)
         detail["straggler"] = strag
+    integ = results.get("integrity:overhead")
+    if integ is not None and "error" not in integ:
+        # integrity-on vs -off iter/s; `sartsolve metrics --diff` gates
+        # on detail.integrity.iter_s_on run-over-run (ISSUE 7)
+        detail["integrity"] = integ
     probes = {end: results[f"probe:{end}"] for end in ("start", "end")
               if f"probe:{end}" in results}
     if probes:

@@ -207,6 +207,22 @@ def build_parser() -> argparse.ArgumentParser:
                           "the guard (reference behavior: divergence "
                           "spins to the iteration cap or NaNs the "
                           "output).")
+    res.add_argument("--integrity", action="store_true",
+                     help="End-to-end numerical-integrity layer "
+                          "(docs/RESILIENCE.md §8; also SART_INTEGRITY=1): "
+                          "per-iteration ABFT checksums in the solve cores "
+                          "(sum(Hf)=rho.f / sum(H^T w)=lambda.w, folded "
+                          "into the existing convergence all-reduce), RTM "
+                          "stripe read-verify digests with re-read on "
+                          "mismatch, post-upload rho/lambda verification, "
+                          "and a periodic resident re-audit every "
+                          "SART_INTEGRITY_REAUDIT frames. A detected frame "
+                          "is recomputed once, then FAILED; "
+                          "SART_SDC_ABORT_THRESHOLD terminal frames (or a "
+                          "resident mismatch) quarantine the run with "
+                          "exit 3. Default off: every traced program and "
+                          "ingest byte is identical to a build without "
+                          "the layer.")
     res.add_argument("--fail_fast", action="store_true",
                      help="Disable per-frame failure isolation: the first "
                           "frame whose ingest or solve fails aborts the "
@@ -329,6 +345,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     configure_compilation_cache()
 
     from sartsolver_tpu.resilience import degrade, shutdown, watchdog
+    from sartsolver_tpu.resilience import integrity as integ_mod
     from sartsolver_tpu.resilience.failures import (
         EXIT_INFRASTRUCTURE, EXIT_INTERRUPTED, FRAME_FAILED,
         RECOVERABLE_FRAME_ERRORS, FrameFailure, OutputWriteError, RunSummary,
@@ -377,7 +394,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             return EXIT_INFRASTRUCTURE
 
     from sartsolver_tpu.config import (
-        SartInputError, SolverOptions, parse_time_intervals,
+        SDC_DETECTED, SartInputError, SolverOptions, parse_time_intervals,
     )
     from sartsolver_tpu.io import hdf5files as hf
     from sartsolver_tpu.io.image import CompositeImage
@@ -492,6 +509,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"SART_SCHEDULE_STRIDE must be >= 1, "
                 f"{schedule_stride} given."
             )
+        # Numerical-integrity layer (docs/RESILIENCE.md §8): flag or env.
+        # configure() switches the ingest-side digests (library code has
+        # no opts object at stripe level); the in-solve ABFT check rides
+        # SolverOptions.integrity below.
+        integrity_on = bool(args.integrity) or integ_mod.env_enabled()
+        integ_mod.configure(integrity_on)
+        sdc_policy = (
+            integ_mod.SdcEscalation(on_event=note_event)
+            if integrity_on else None
+        )
         if args.use_cpu:
             opts = SolverOptions.cpu_parity(
                 logarithmic=args.logarithmic,
@@ -504,6 +531,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 max_iterations=args.max_iterations,
                 divergence_recovery=args.divergence_recovery,
                 schedule_stride=schedule_stride,
+                integrity=integrity_on,
                 # forwarded so an explicit --fused_sweep on fails loudly
                 # (the fused sweep is fp32-only) instead of silently
                 # degrading to the unfused path
@@ -523,6 +551,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 max_iterations=args.max_iterations,
                 divergence_recovery=args.divergence_recovery,
                 schedule_stride=schedule_stride,
+                integrity=integrity_on,
                 rtm_dtype=args.rtm_dtype,
                 fused_sweep=args.fused_sweep,
             )
@@ -691,6 +720,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.parallel.multihost import read_and_shard_rtm
 
         rtm_scale = None
+        # Integrity: host-side rho/lambda accumulation during the chunked
+        # ingest, verified against the device-computed stats right after
+        # the upload (docs/RESILIENCE.md §8). Single-process only — a
+        # pod's processes each see only their own rows/columns; they rely
+        # on the stripe digests plus the periodic resident re-audit.
+        ingest_stats = (
+            integ_mod.IngestStats(npixel, nvoxel)
+            if integrity_on and not args.multihost else None
+        )
         with obs_trace.span("ingest.rtm", npixel=npixel, nvoxel=nvoxel):
             if opts.rtm_dtype == "int8":
                 # two-pass ingest: quantize fp32 chunks host-side into
@@ -703,17 +741,30 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 rtm, rtm_scale = read_and_quantize_rtm(
                     sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                    ingest_stats=ingest_stats,
                 )
             else:
                 rtm = read_and_shard_rtm(
                     sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
                     dtype=opts.rtm_dtype or opts.dtype,
                     serialize=args.multihost and not args.parallel_read,
+                    ingest_stats=ingest_stats,
                 )
             solver = DistributedSARTSolver(
                 rtm, lap, opts=opts, mesh=mesh, npixel=npixel,
                 nvoxel=nvoxel, rtm_scale=rtm_scale,
             )
+        if ingest_stats is not None:
+            # post-upload verification: the device's rho/lambda must match
+            # the host sums the ingest just accumulated — a mismatch means
+            # the staging DMA or on-device layout corrupted the matrix,
+            # and every solve it would serve is poisoned: quarantine now
+            issues = solver.verify_ray_stats(ingest_stats)
+            if issues:
+                sdc_policy.resident_failure(
+                    "post-upload ray-stats verification: "
+                    + "; ".join(issues)
+                )
         _mark("ingest RTM + upload")
 
         grid = make_voxel_grid(
@@ -809,6 +860,59 @@ def main(argv: Optional[List[str]] = None) -> int:
                     item for item in frames if not already_written(item[1])
                 )
 
+            # Periodic resident re-audit (--integrity, RESILIENCE.md §8):
+            # recompute rho/lambda from the device-resident RTM every N
+            # completed frames and compare bit-for-bit to the upload-time
+            # snapshot — resident bit rot between solves is caught even
+            # when no frame's ABFT check has tripped yet. A mismatch is
+            # unrecoverable by construction: quarantine (exit 3).
+            reaudit_every = int(
+                _os.environ.get("SART_INTEGRITY_REAUDIT", "64")
+            )
+            audit_state = {"since": 0}
+
+            def integ_tick(n_frames: int) -> None:
+                if sdc_policy is None or reaudit_every <= 0:
+                    return
+                audit_state["since"] += n_frames
+                if audit_state["since"] < reaudit_every:
+                    return
+                audit_state["since"] = 0
+                issues = solver.reaudit_ray_stats()
+                if issues:
+                    sdc_policy.resident_failure(
+                        "resident re-audit: " + "; ".join(issues)
+                    )
+
+            _SDC_REPRODUCED = integ_mod.SDC_REPRODUCED
+
+            def sdc_guarded(solve_fn):
+                """Recompute-once wrapper for the grouped loops
+                (docs/RESILIENCE.md §8): a group whose statuses carry
+                SDC_DETECTED is re-solved once — a transient MXU fault
+                does not reproduce, a resident fault does. The status
+                fetch synchronizes the pipeline; that is the documented
+                host-side cost of --integrity on grouped paths (the
+                in-solve check itself is the <2 percent device cost). Frames
+                still SDC after the recompute become FAILED rows at
+                write time."""
+                if sdc_policy is None:
+                    return solve_fn
+
+                def guarded(stack):
+                    result = solve_fn(stack)
+                    sdc = np.asarray(result.status) == SDC_DETECTED
+                    if sdc.any():
+                        sdc_policy.detected(int(sdc.sum()))
+                        sdc_policy.note_recompute(int(sdc.sum()))
+                        result = solve_fn(stack)
+                        repeat = np.asarray(result.status) == SDC_DETECTED
+                        if repeat.any():
+                            sdc_policy.detected(int(repeat.sum()))
+                    return result
+
+                return guarded
+
             def record_failed(ftime, cam_times, err):
                 writer.add(failed_row(nvoxel), FRAME_FAILED, ftime,
                            cam_times, iterations=-1)
@@ -896,6 +1000,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                               detail=True)
                     per_frame_ms = dt * 1e3 / len(metas)
                     for b, (_, ftime, cam_times) in enumerate(metas):
+                        if (sdc_policy is not None
+                                and int(statuses[b]) == SDC_DETECTED):
+                            # the group already recomputed once
+                            # (sdc_guarded): this frame's corruption
+                            # reproduced — FAILED row; the terminal
+                            # accounting may quarantine the run (exit 3)
+                            sdc_policy.record_terminal(ftime)
+                            record_failed(
+                                ftime, cam_times,
+                                integ_mod.IntegrityError(_SDC_REPRODUCED),
+                            )
+                            continue
                         writer.add(result.solution_fetcher(b),
                                    int(statuses[b]), ftime, cam_times,
                                    iterations=int(result.iterations[b]))
@@ -911,6 +1027,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                             print(f"Processed in: {per_frame_ms} ms "
                                   f"(average over {label} of {len(metas)}; "
                                   f"{int(result.iterations[b])} iterations)")
+                    integ_tick(len(metas))
                     write_ok = True
 
                 def drain_inflight():
@@ -1037,8 +1154,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                     K,
                     # inert dark frames (independent solves, no carry)
                     lambda stack, n: np.zeros((n, stack.shape[1])),
-                    lambda stack: solver.solve_batch(
-                        stack, local=use_local, device_result=True),
+                    sdc_guarded(lambda stack: solver.solve_batch(
+                        stack, local=use_local, device_result=True)),
                     "batch",
                     items=items,
                 )
@@ -1063,6 +1180,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     telem.record_frame(ftime, status, iterations,
                                        convergence, per_frame_ms, "sched")
                     watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+                    integ_tick(1)
                     # detail=: inside the frame-loop phase, like the
                     # grouped loop's pipelined-wall rows
                     timer.add("solve sched (pipelined wall)",
@@ -1076,7 +1194,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     solver, lanes=K,
                     on_result=sched_result, on_failed=record_failed,
                     stop_check=stop_now, on_event=degrade_event,
-                    isolate=isolate,
+                    isolate=isolate, integrity_policy=sdc_policy,
                 )
                 # ONE shared iterator: the OOM fallback must continue the
                 # same stream the batcher was draining, not re-iterate the
@@ -1120,12 +1238,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                 }
 
                 def solve_chain_group(stack):
-                    dres = solver.solve_chain(
-                        stack, f0=chain_state["f0"],
-                        warm=chain_state["warm"], local=use_local)
-                    chain_state["f0"] = None
-                    chain_state["warm"] = dres
-                    return dres
+                    # snapshot the warm carry so sdc_guarded's recompute
+                    # re-enters with the SAME seed (the first attempt
+                    # already swapped its own result in)
+                    snap = (chain_state["f0"], chain_state["warm"])
+
+                    def once(stack):
+                        chain_state["f0"], chain_state["warm"] = snap
+                        dres = solver.solve_chain(
+                            stack, f0=chain_state["f0"],
+                            warm=chain_state["warm"], local=use_local)
+                        chain_state["f0"] = None
+                        chain_state["warm"] = dres
+                        return dres
+
+                    return sdc_guarded(once)(stack)
 
                 run_grouped(
                     args.chain_frames,
@@ -1173,9 +1300,38 @@ def main(argv: Optional[List[str]] = None) -> int:
                         # seed) stays valid for the next frame
                         record_failed(ftime, cam_times, err)
                         continue
+                    status = int(dres.status[0])
+                    if sdc_policy is not None and status == SDC_DETECTED:
+                        # escalation (RESILIENCE.md §8): recompute once
+                        # with the SAME seed; a repeat means the resident
+                        # state is corrupt — FAILED row, and the previous
+                        # warm start stays the next frame's seed
+                        sdc_policy.detected()
+                        sdc_policy.note_recompute()
+                        try:
+                            dres = solver.solve_batch(
+                                np.asarray(frame)[None, :],
+                                None if f0_host is None
+                                else f0_host[None, :],
+                                local=use_local, device_result=True,
+                                warm=warm_dev,
+                            )
+                        except RECOVERABLE_FRAME_ERRORS as err:
+                            if not isolate:
+                                raise
+                            record_failed(ftime, cam_times, err)
+                            continue
+                        status = int(dres.status[0])
+                        if status == SDC_DETECTED:
+                            sdc_policy.detected()
+                            sdc_policy.record_terminal(ftime)
+                            record_failed(
+                                ftime, cam_times,
+                                integ_mod.IntegrityError(_SDC_REPRODUCED),
+                            )
+                            continue
                     f0_host = None  # resume seed consumed; chain on device
                     warm_dev = None if args.no_guess else dres
-                    status = int(dres.status[0])
                     writer.add(dres.solution_fetcher(0), status,
                                ftime, cam_times,
                                iterations=int(dres.iterations[0]))
@@ -1189,6 +1345,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     # detail=: per-frame rows lie inside the frame-loop
                     # phase — shown, but excluded from the total line
                     timer.add("solve frame", elapsed_ms / 1e3, detail=True)
+                    integ_tick(1)
                     if primary:
                         print(f"Processed in: {elapsed_ms} ms")
 
@@ -1268,6 +1425,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # a solution-file flush failed mid-run; the file is resumable up
         # to its last committed flush
         print(err, file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
+    except integ_mod.PersistentCorruptionError as err:
+        # the integrity layer quarantined the session: corruption that a
+        # recompute cannot clear (resident matrix / staged state). The
+        # quarantine event is already in the telemetry; the file is
+        # resumable up to its last committed flush — requeue on healthy
+        # hardware with --resume (docs/RESILIENCE.md §8)
+        print(f"Quarantined: {err}", file=sys.stderr)
         return EXIT_INFRASTRUCTURE
     except DeferredWriteError as err:
         # the async writer latched an infrastructure-class failure (a

@@ -21,6 +21,12 @@ MAX_ITERATIONS_EXCEEDED = -1
 # last finite iterate. The pipeline-level FRAME_FAILED = -3 lives in
 # resilience/failures.py (it is never produced by the solver itself).
 DIVERGED = -2
+# The in-solve ABFT integrity check (SolverOptions.integrity,
+# docs/RESILIENCE.md §8) caught a silent-data-corruption signature: the
+# linear-algebra identity sum(Hf) == rho.f broke past the dtype tolerance.
+# The frame froze on its last consistent iterate; the host escalation
+# policy (resilience/integrity.py) recomputes it once, then fails it.
+SDC_DETECTED = -4
 
 
 class SartInputError(ValueError):
@@ -231,6 +237,20 @@ class SolverOptions:
     # read by the scheduler path — the classic batch/chain programs are
     # untouched by this value.
     schedule_stride: int = 16
+    # End-to-end numerical-integrity layer (docs/RESILIENCE.md §8): fold a
+    # per-iteration ABFT check into the solve cores — the identity
+    # sum(Hf) == rho.f (rho = ray_density, the column sums) holds exactly,
+    # so comparing the two reductions against an fp-derived per-dtype
+    # tolerance (resilience/integrity.py) detects a corrupted resident RTM
+    # or a bad MXU product the same iteration it happens, for two dot
+    # products and a scalar compare per frame. A tripped frame freezes on
+    # its last consistent iterate with status SDC_DETECTED; the host
+    # escalation (recompute-once -> FAILED -> quarantine abort) lives in
+    # resilience/integrity.py. Also enables ingest stripe-digest
+    # verification and the periodic resident ray-stats re-audit. False
+    # (default): every traced program is byte-identical to a build without
+    # the layer.
+    integrity: bool = False
     # Accumulate the convergence metric's ||Hf||^2 in fp64 (emulated as
     # float32 pairs on TPU) even when the compute dtype is fp32, so the
     # |dC| < tol stall crossing (Eq. 5, sartsolver.cpp:224-228) stops

@@ -102,6 +102,27 @@ def _sparse_segment_window(
         pix, vox, val = _load_sparse_segment(
             group, filename, start_pixel, start_voxel, nvoxel, dtype
         )
+        from sartsolver_tpu.resilience import integrity
+
+        if integrity.enabled():
+            # This read's bytes are served from memory for every later
+            # stripe (the point of the cache), so the stripe-level
+            # double-read compare upstream would digest the same buffer
+            # twice — verify HERE, against a second disk read, the one
+            # time the segment actually comes off the filesystem. The
+            # raise precedes the cache insert, so the ingest retry
+            # re-reads both copies fresh.
+            p2, v2, a2 = _load_sparse_segment(
+                group, filename, start_pixel, start_voxel, nvoxel, dtype
+            )
+            if (integrity.stripe_digest(pix) != integrity.stripe_digest(p2)
+                    or integrity.stripe_digest(vox)
+                    != integrity.stripe_digest(v2)
+                    or integrity.stripe_digest(val)
+                    != integrity.stripe_digest(a2)):
+                integrity.digest_mismatch(
+                    f"sparse RTM segment {filename!r}"
+                )
         if cache_rows is not None:
             sel = (pix >= cache_rows[0]) & (pix < cache_rows[1])
             pix, vox, val = pix[sel], vox[sel], val[sel]

@@ -23,6 +23,18 @@ import numpy as np
 from sartsolver_tpu.config import SartInputError
 
 
+def row_checksum(row: np.ndarray) -> np.uint32:
+    """CRC32 of one solution row's fp64 bytes — the per-frame checksum
+    written alongside ``solution/value`` and verified on ``--resume``
+    (resume previously trusted the file's bytes blindly; a corrupted row
+    would silently warm-start every frame after it). Shares
+    :func:`~sartsolver_tpu.resilience.integrity.stripe_digest` so the
+    digesting convention has exactly one definition."""
+    from sartsolver_tpu.resilience.integrity import stripe_digest
+
+    return np.uint32(stripe_digest(np.asarray(row, np.float64)))
+
+
 def _crash_window(point: str) -> None:
     """Test-only hook: when ``SART_TEST_FLUSH_DELAY`` is set, announce the
     named commit point on stderr and sleep that many seconds inside it.
@@ -131,12 +143,37 @@ def read_resume_state(
         per_frame = [value, group["time"], group["status"]]
         if "iterations" in group:
             per_frame.append(group["iterations"])
+        if "checksum" in group:
+            per_frame.append(group["checksum"])
         completed = min(
             *(d.shape[0] for d in per_frame),
             *(group[k].shape[0] for k in expected),
         )
         if "completed" in group.attrs:
             completed = min(completed, int(group.attrs["completed"]))
+        if "checksum" in group and completed:
+            # Verify every completed row against its stored CRC32 before
+            # trusting the file: the resume warm start reads the LAST row
+            # and the skip filter trusts them all, so a silently corrupted
+            # row (disk rot, a torn copy between runs) must refuse the
+            # resume loudly instead of poisoning the appended series.
+            # Files from before the checksum dataset resume as before.
+            stored = np.asarray(group["checksum"][:completed], np.uint32)
+            # slab reads: one h5py read per chunk-aligned block, checksums
+            # from the in-memory slab — a per-row value[i, :] would re-read
+            # (and re-decompress) each chunk once per row it holds
+            slab = max(1, (value.chunks or (completed,))[0])
+            for a in range(0, completed, slab):
+                b = min(a + slab, completed)
+                rows = value[a:b, :]
+                for i in range(a, b):
+                    if np.uint32(stored[i]) != row_checksum(rows[i - a]):
+                        raise SartInputError(
+                            f"Cannot resume into {filename}: solution row "
+                            f"{i} fails its stored checksum (the file is "
+                            "corrupt); re-run without --resume or restore "
+                            "the file."
+                        )
         times = group["time"][:completed]
         last = value[completed - 1, :] if completed else None
         return ResumeState(times, last)
@@ -177,6 +214,7 @@ class SolutionWriter:
         self._solutions: List[np.ndarray] = []
         self._status: List[int] = []
         self._iterations: List[int] = []
+        self._checksums: List[np.uint32] = []
         self._time: List[float] = []
         self._camera_time: Dict[str, List[float]] = {name: [] for name in camera_names}
 
@@ -194,7 +232,9 @@ class SolutionWriter:
         over the reference schema; -1 = unknown) records the per-frame
         convergence cost alongside the status code."""
         self._status.append(int(status))
-        self._solutions.append(np.asarray(solution, np.float64))
+        solution = np.asarray(solution, np.float64)
+        self._solutions.append(solution)
+        self._checksums.append(row_checksum(solution))
         self._time.append(float(time))
         self._iterations.append(int(iterations))
         for name, t in zip(self._camera_time, camera_time):
@@ -236,6 +276,7 @@ class SolutionWriter:
         self._solutions.clear()
         self._status.clear()
         self._iterations.clear()
+        self._checksums.clear()
         self._time.clear()
         for v in self._camera_time.values():
             v.clear()
@@ -299,6 +340,13 @@ class SolutionWriter:
                 "iterations", data=np.asarray(self._iterations, np.int32),
                 maxshape=(None,), chunks=(n,), dtype=np.int32, fillvalue=-1,
             )
+            # per-frame CRC32 of the fp64 solution row (row_checksum),
+            # verified by read_resume_state. Created BEFORE `status` for
+            # the same torn-first-flush-sentinel reason as `iterations`.
+            group.create_dataset(
+                "checksum", data=np.asarray(self._checksums, np.uint32),
+                maxshape=(None,), chunks=(n,), dtype=np.uint32, fillvalue=0,
+            )
             group.create_dataset(
                 "status", data=np.asarray(self._status, np.int32),
                 maxshape=(None,), chunks=(n,), dtype=np.int32, fillvalue=0,
@@ -332,6 +380,11 @@ class SolutionWriter:
                 dset = f["solution/iterations"]  # pre-extension file
                 dset.resize((new_size,))
                 dset[offset:] = np.asarray(self._iterations, np.int32)
+
+            if "checksum" in f["solution"]:  # absent when resuming a
+                dset = f["solution/checksum"]  # pre-checksum file
+                dset.resize((new_size,))
+                dset[offset:] = np.asarray(self._checksums, np.uint32)
 
             for name, times in self._camera_time.items():
                 dset = f[f"solution/time_{name}"]

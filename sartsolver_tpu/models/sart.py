@@ -35,6 +35,7 @@ from jax import Array, lax
 from sartsolver_tpu.config import (
     DIVERGED,
     MAX_ITERATIONS_EXCEEDED,
+    SDC_DETECTED,
     SUCCESS,
     SolverOptions,
 )
@@ -669,6 +670,34 @@ class _SweepContext:
             lmask, 1 / jnp.where(lmask, problem.ray_length, 1), 0
         ).astype(dtype)
 
+        # In-solve ABFT integrity check (docs/RESILIENCE.md §8,
+        # resilience/integrity.py): the identities sum(Hf) == rho.f and
+        # sum(H^T w) == lambda.w hold exactly for the stored matrix, so a
+        # per-iteration residual against an fp-derived tolerance detects
+        # resident-RTM corruption / a bad MXU product the iteration it
+        # happens. Python-gated: integrity=False traces byte-identically.
+        self.integrity = bool(opts.integrity)
+        if self.integrity:
+            from sartsolver_tpu.resilience.integrity import abft_tolerance
+
+            # the compared reductions are GLOBAL psums, so the tolerance
+            # must use the global reduction lengths — under shard_map
+            # rtm.shape holds the per-shard block, which would tighten
+            # the band ~sqrt(n_shards)x and let a clean large-pod solve
+            # trip the check. lax.psum of a Python int is static at
+            # trace time (the mesh axis size), so this stays a host float.
+            n_pix = rtm.shape[0] * (
+                int(lax.psum(1, axis_name)) if axis_name else 1
+            )
+            n_vox = nvoxel * (
+                int(lax.psum(1, voxel_axis)) if voxel_axis else 1
+            )
+            self.abft_tol = abft_tolerance(
+                opts.dtype, opts.rtm_dtype, n_pix, n_vox
+            )
+            self.dens_row = problem.ray_density.astype(dtype)[None, :]
+            self.length_row = problem.ray_length.astype(dtype)[None, :]
+
         # int8-quantized storage: the iteration loop dequantizes codes
         # exactly inside the fused kernel; the handful of out-of-loop
         # projections below run as integer dots with per-row quantization
@@ -826,14 +855,55 @@ class _SweepContext:
                            fwd_scale=0 if self.is_int8 else None,
                            interpret=self.fused == "interpret")
 
+    def abft_residual(self, s_a, s_b):
+        """[B] bool: |s_a - s_b| beyond the dtype tolerance (or non-finite
+        — a NaN residual means a non-finite product, which is corruption
+        or divergence either way; the guard's own check still classifies
+        divergence first when both layers are armed)."""
+        err = jnp.abs(s_a - s_b)
+        ref = jnp.maximum(jnp.abs(s_a), jnp.abs(s_b)) + 1.0
+        return ~(err <= self.abft_tol * ref)
+
+    def abft_check(self, fsq_local, fitted_new, f_new, bp_chk, done,
+                   axis_name, voxel_axis):
+        """(fsq, tripped): the folded ABFT reductions shared by both
+        iteration cores (docs/RESILIENCE.md §8). The forward checksum
+        sum(Hf) stacks with the metric's ||Hf||^2 (and, unfused, with
+        lambda.w) into ONE pixel-axis psum — the per-iteration collective
+        budget stays at the audited count (``sharded_integrity_batch``
+        golden). The rho.f side reduces over the voxel axis — a no-op on
+        1-D pixel meshes, one extra scalar-stack psum on 2-D meshes.
+        ``tripped`` is already masked to live (``~done``) frames."""
+        pix_parts = [fsq_local, jnp.sum(fitted_new, axis=1)]
+        if bp_chk is not None:
+            pix_parts.append(bp_chk[1])  # lambda_local . w
+        red = _psum(jnp.stack(pix_parts), axis_name)
+        fsq, s_fwd = red[0], red[1]
+        vox_parts = [jnp.sum(f_new * self.dens_row, axis=1)]
+        if bp_chk is not None:
+            vox_parts.append(bp_chk[0])  # sum_v(H^T w) local
+        vred = _psum(jnp.stack(vox_parts), voxel_axis)
+        tripped = self.abft_residual(s_fwd, vred[0])
+        if bp_chk is not None:
+            tripped = tripped | self.abft_residual(vred[1], red[2])
+        return fsq, (~done) & tripped
+
     def run_sweep(self, f, fitted, penalty, dk, ascale, g, meas_mask, obs):
-        """(f_upd, fitted_upd or None): the iteration's two RTM sweeps.
-        ``dk`` is the schedule factor decay^k — a traced scalar in the
-        batched core, a per-lane ``[B, 1]`` column in the stepped core
+        """(f_upd, fitted_upd or None, bp_chk): the iteration's two RTM
+        sweeps. ``dk`` is the schedule factor decay^k — a traced scalar in
+        the batched core, a per-lane ``[B, 1]`` column in the stepped core
         (lanes age independently there), 1/None when the schedule is off
         (never materialized); ``ascale`` is the divergence guard's
         per-frame [B] relaxation scale (None when the guard is off).
-        ``obs`` is :meth:`make_obs`'s result (log variant only)."""
+        ``obs`` is :meth:`make_obs`'s result (log variant only).
+
+        ``bp_chk`` carries the ABFT back-projection checksum operands
+        (integrity on, two-matmul path only — the fused kernels never
+        materialize the bp product): ``(sum_v(H^T w) local [B],
+        lambda_local . w [B])``; the caller reduces the first over the
+        voxel axis and folds the second into the pixel-axis convergence
+        psum, then compares (sum(H^T w) == lambda . w holds exactly).
+        None when integrity is off or the sweep is fused."""
         opts = self.opts
         dtype = self.dtype
         if opts.logarithmic:
@@ -848,11 +918,18 @@ class _SweepContext:
                         aux.append(jnp.broadcast_to(
                             a_k.astype(dtype), (f.shape[0], self.nvoxel)
                         ))
-                return self.run_fused(
+                f_upd, fitted_upd = self.run_fused(
                     w, f, aux + ([penalty] if self.has_pen else [])
                 )
+                return f_upd, fitted_upd, None
             fit = _psum(back_project(self.rtm, w, accum_dtype=dtype),
                         self.axis_name)
+            bp_chk = None
+            if self.integrity:
+                # checksum the RAW psummed product (before the vmask zeroes
+                # masked voxels — the identity holds for the full H^T w)
+                bp_chk = (jnp.sum(fit, axis=1),
+                          jnp.sum(self.length_row * w, axis=1))
             fit = jnp.where(self.vmask[None, :], fit, 0)
             exponent = jnp.asarray(opts.relaxation, dtype)
             if self.scheduled:
@@ -862,7 +939,7 @@ class _SweepContext:
                 # through the exponent: ratio ** (alpha * ascale_b)
                 exponent = exponent * ascale[:, None]
             ratio = ((obs + self.eps) / (fit + self.eps)) ** exponent
-            return f * ratio * jnp.exp(-penalty), None
+            return f * ratio * jnp.exp(-penalty), None, bp_chk
         w = jnp.where(meas_mask, g - fitted, 0) * self.inv_length
         if self.scheduled:
             # the linear update is linear in w, so alpha_k = alpha * dk
@@ -873,16 +950,21 @@ class _SweepContext:
             # same fold for the guard's per-frame scale (exact when 1.0)
             w = w * ascale[:, None]
         if self.fused is not None:
-            return self.run_fused(
+            f_upd, fitted_upd = self.run_fused(
                 w, f,
                 [self.inv_density[None, :]]
                 + ([penalty] if self.has_pen else [])
             )
+            return f_upd, fitted_upd, None
         bp = _psum(back_project(self.rtm, w, accum_dtype=dtype),
                    self.axis_name)
+        bp_chk = None
+        if self.integrity:
+            bp_chk = (jnp.sum(bp, axis=1),
+                      jnp.sum(self.length_row * w, axis=1))
         return jnp.maximum(
             f + self.inv_density[None, :] * bp - penalty, 0
-        ), None
+        ), None, bp_chk
 
 
 def _solve_normalized_batch_impl(
@@ -978,8 +1060,11 @@ def _solve_normalized_batch_impl(
     # Python level), so goldens/parity are untouched by default.
     recovery = int(opts.divergence_recovery)
     explode = float(opts.divergence_threshold)
+    integ = kit.integrity
 
     def body(carry):
+        if integ:
+            carry, sdc = carry[:-1], carry[-1]
         if recovery:
             f, fitted, conv_prev, it, done, iters, ascale, recov, div = carry
         else:
@@ -991,8 +1076,8 @@ def _solve_normalized_batch_impl(
             penalty = kit.compute_penalty(f)
         dk = (jnp.asarray(kit.decay, dtype) ** it.astype(dtype)
               if kit.scheduled else None)
-        f_upd, fitted_upd = kit.run_sweep(f, fitted, penalty, dk, ascale,
-                                          g, meas_mask, obs)
+        f_upd, fitted_upd, bp_chk = kit.run_sweep(f, fitted, penalty, dk,
+                                                  ascale, g, meas_mask, obs)
 
         f_new = jnp.where(done[:, None], f, f_upd)  # converged frames freeze
         if fitted_upd is not None:
@@ -1002,10 +1087,30 @@ def _solve_normalized_batch_impl(
         else:
             fitted_new = _psum(forward_project(rtm, f_new, accum_dtype=dtype), voxel_axis)
         if opts.precise_convergence:
-            fsq = _psum(_sumsq_precise(fitted_new, dtype), axis_name)
+            fsq_local = _sumsq_precise(fitted_new, dtype)
         else:  # the reference CUDA path's fp32 dot (sartsolver_cuda.cpp:253)
-            fsq = _psum(jnp.sum(fitted_new * fitted_new, axis=1), axis_name)
+            fsq_local = jnp.sum(fitted_new * fitted_new, axis=1)
+        if integ:
+            fsq, tripped = kit.abft_check(fsq_local, fitted_new, f_new,
+                                          bp_chk, done, axis_name,
+                                          voxel_axis)
+        else:
+            fsq = _psum(fsq_local, axis_name)
         conv = (msq - fsq) / msq
+        if integ and recovery:
+            # a non-finite checksum trips the ABFT compare vacuously, but
+            # that signature belongs to the divergence guard — rollback /
+            # DIVERGED, not quarantine (abft_residual's contract: the
+            # guard classifies divergence first when both layers are on)
+            tripped = tripped & (jnp.isfinite(fsq) & jnp.isfinite(conv))
+        if integ:
+            # a tripped frame FREEZES on its entering state — the last
+            # iterate whose checksums were consistent; the host escalation
+            # (resilience/integrity.py) takes it from there
+            f_new = jnp.where(tripped[:, None], f, f_new)
+            fitted_new = jnp.where(tripped[:, None], fitted, fitted_new)
+            conv = jnp.where(tripped, conv_prev, conv)
+            sdc = sdc | tripped
         if recovery:
             # the candidate update is judged BEFORE it is stored: a bad
             # frame keeps its entering (f, fitted, conv) — the rollback —
@@ -1014,6 +1119,10 @@ def _solve_normalized_batch_impl(
                 ~(jnp.isfinite(fsq) & jnp.isfinite(conv))
                 | (fsq > explode * jnp.maximum(msq, 1.0))
             )
+            if integ:
+                # finite-mismatch SDC outranks the rollback ladder (an
+                # explode-test coincidence stays classified as SDC)
+                bad = bad & ~tripped
             exhausted = bad & (recov >= recovery)
             f_new = jnp.where(bad[:, None], f, f_new)
             fitted_new = jnp.where(bad[:, None], fitted, fitted_new)
@@ -1024,13 +1133,25 @@ def _solve_normalized_batch_impl(
             # equals conv_prev by construction, not by convergence)
             newly = ((~done) & ~bad & (it >= 1)
                      & (jnp.abs(conv - conv_prev) < tol))
+            if integ:
+                # same reasoning for a frozen SDC frame's unchanged conv
+                newly = newly & ~tripped
             ended = newly | exhausted
+            if integ:
+                ended = ended | tripped
             iters = jnp.where(ended, it + 1, iters)
-            return (f_new, fitted_new, conv, it + 1, done | ended, iters,
-                    ascale, recov, div | exhausted)
+            out = (f_new, fitted_new, conv, it + 1, done | ended, iters,
+                   ascale, recov, div | exhausted)
+            return out + (sdc,) if integ else out
         newly = (~done) & (it >= 1) & (jnp.abs(conv - conv_prev) < tol)
-        iters = jnp.where(newly, it + 1, iters)
-        return (f_new, fitted_new, conv, it + 1, done | newly, iters)
+        if integ:
+            newly = newly & ~tripped
+            ended = newly | tripped
+        else:
+            ended = newly
+        iters = jnp.where(ended, it + 1, iters)
+        out = (f_new, fitted_new, conv, it + 1, done | ended, iters)
+        return out + (sdc,) if integ else out
 
     def cond(carry):
         it, done = carry[3], carry[4]
@@ -1066,20 +1187,32 @@ def _solve_normalized_batch_impl(
             jnp.zeros(B, jnp.int32),  # recoveries consumed
             input_bad,  # diverged (pre-failed, or ladder exhausted later)
         )
-        f, fitted_fin, conv, it, done, iters, _, _, div = lax.while_loop(
-            cond, body, init
-        )
+        if integ:
+            init = init + (jnp.zeros(B, bool),)  # SDC-tripped frames
+        out = lax.while_loop(cond, body, init)
+        if integ:
+            out, sdc = out[:-1], out[-1]
+        f, fitted_fin, conv, it, done, iters, _, _, div = out
         status = jnp.where(
             div, DIVERGED,
             jnp.where(done, SUCCESS, MAX_ITERATIONS_EXCEEDED),
         ).astype(jnp.int32)
+        if integ:
+            status = jnp.where(sdc, SDC_DETECTED, status).astype(jnp.int32)
     else:
         init = (
             f0, fitted0, jnp.zeros(B, dtype), jnp.asarray(0, jnp.int32),
             jnp.zeros(B, bool), jnp.full(B, opts.max_iterations, jnp.int32),
         )
-        f, fitted_fin, conv, it, done, iters = lax.while_loop(cond, body, init)
+        if integ:
+            init = init + (jnp.zeros(B, bool),)
+        out = lax.while_loop(cond, body, init)
+        if integ:
+            out, sdc = out[:-1], out[-1]
+        f, fitted_fin, conv, it, done, iters = out
         status = jnp.where(done, SUCCESS, MAX_ITERATIONS_EXCEEDED).astype(jnp.int32)
+        if integ:
+            status = jnp.where(sdc, SDC_DETECTED, status).astype(jnp.int32)
     res = SolveResult(f, status, iters, conv)
     return (res, fitted_fin) if return_fitted else res
 
@@ -1238,6 +1371,8 @@ def sched_step_normalized(
     g, msq, obs = state.g, state.msq, state.obs
     meas_mask = g >= 0
 
+    integ = kit.integrity
+
     def body(carry):
         (step, f, fitted, conv_prev, itl, done, status, iters,
          ascale, recov) = carry
@@ -1248,7 +1383,7 @@ def sched_step_normalized(
         # per-lane schedule factor decay^k — lanes age independently
         dk = ((jnp.asarray(kit.decay, dtype) ** itl.astype(dtype))[:, None]
               if kit.scheduled else None)
-        f_upd, fitted_upd = kit.run_sweep(
+        f_upd, fitted_upd, bp_chk = kit.run_sweep(
             f, fitted, penalty, dk, ascale if recovery else None,
             g, meas_mask, obs,
         )
@@ -1263,15 +1398,36 @@ def sched_step_normalized(
                 voxel_axis,
             )
         if opts.precise_convergence:
-            fsq = _psum(_sumsq_precise(fitted_new, dtype), axis_name)
+            fsq_local = _sumsq_precise(fitted_new, dtype)
         else:
-            fsq = _psum(jnp.sum(fitted_new * fitted_new, axis=1), axis_name)
+            fsq_local = jnp.sum(fitted_new * fitted_new, axis=1)
+        if integ:
+            # same folded ABFT reductions as the batched core (the check
+            # is per lane; a tripped lane retires with SDC_DETECTED and
+            # the scheduler's escalation decides recompute-vs-fail)
+            fsq, tripped = kit.abft_check(fsq_local, fitted_new, f_new,
+                                          bp_chk, done, axis_name,
+                                          voxel_axis)
+        else:
+            fsq = _psum(fsq_local, axis_name)
         conv = (msq - fsq) / msq
+        if integ and recovery:
+            # divergence classifies first — see the batched core
+            tripped = tripped & (jnp.isfinite(fsq) & jnp.isfinite(conv))
+        if integ:
+            f_new = jnp.where(tripped[:, None], f, f_new)
+            fitted_new = jnp.where(tripped[:, None], fitted, fitted_new)
+            conv = jnp.where(tripped, conv_prev, conv)
+            status = jnp.where(
+                tripped, jnp.asarray(SDC_DETECTED, jnp.int32), status
+            )
         if recovery:
             bad = (~done) & (
                 ~(jnp.isfinite(fsq) & jnp.isfinite(conv))
                 | (fsq > explode * jnp.maximum(msq, 1.0))
             )
+            if integ:
+                bad = bad & ~tripped  # finite-mismatch SDC outranks
             exhausted = bad & (recov >= recovery)
             f_new = jnp.where(bad[:, None], f, f_new)
             fitted_new = jnp.where(bad[:, None], fitted, fitted_new)
@@ -1280,13 +1436,21 @@ def sched_step_normalized(
             recov = recov + bad.astype(jnp.int32)
             newly = ((~done) & ~bad & (itl >= 1)
                      & (jnp.abs(conv - conv_prev) < tol))
+            if integ:
+                newly = newly & ~tripped
             ended = newly | exhausted
+            if integ:
+                ended = ended | tripped
             status = jnp.where(
                 exhausted, jnp.asarray(DIVERGED, jnp.int32), status
             )
         else:
             newly = (~done) & (itl >= 1) & (jnp.abs(conv - conv_prev) < tol)
-            ended = newly
+            if integ:
+                newly = newly & ~tripped
+                ended = newly | tripped
+            else:
+                ended = newly
         # per-lane iteration cap: the batched loop's `it < max_iterations`
         # exit, applied lane-wise (capped lanes keep the refill-time
         # MAX_ITERATIONS_EXCEEDED status and latch iters = max_iterations)
@@ -1427,6 +1591,33 @@ def _audit_recovery_sweep():
     return fn.lower(_audit_problem(), *_audit_batch_args(2))
 
 
+@_register_audit_entry(
+    "integrity_sweep",
+    description="iteration sweep with the in-solve ABFT integrity check "
+                "(sum(Hf) == rho.f and sum(H^T w) == lambda.w residuals; "
+                "two-matmul path, fp32)",
+    # the check must stay O(B x (P+V)) bookkeeping on the existing
+    # products: no matrix-sized copies/converts in the loop, and the
+    # single-device program stays collective-free like the plain sweep
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_integrity_sweep():
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        integrity=True,
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=False,
+    ))
+    return fn.lower(_audit_problem(), *_audit_batch_args(2))
+
+
 def prepare_measurement(measurement, opts: SolverOptions):
     """Host-side pre-step shared by the single-device and sharded drivers —
     the reference's ``pre_iteration_setup`` (sartsolver_cuda.cpp:138-194).
@@ -1443,6 +1634,27 @@ def prepare_measurement(measurement, opts: SolverOptions):
       ``-||Hf||^2`` and the stall test still terminates.
     """
     g64 = np.asarray(measurement, dtype=np.float64)
+    n_bad = int(np.count_nonzero(~np.isfinite(g64)))
+    if n_bad:
+        # Non-finite pixels used to be *silently* excluded (from the
+        # normalization max, ||g||^2 and — NaN compares false — the Eq. 6
+        # measurement mask). They still are, but visibly now: counted
+        # into the nonfinite_pixels_total obs counter and warned once per
+        # run (warnings' per-location dedup makes repeats free).
+        import warnings
+
+        from sartsolver_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.get_registry().counter("nonfinite_pixels_total").inc(
+            n_bad
+        )
+        warnings.warn(
+            "measurement frames contain non-finite pixels; they are "
+            "excluded from normalization, ||g||^2 and the solve "
+            "(counted in the nonfinite_pixels_total metric)",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     if opts.normalize:
         norm = float(np.max(g64, initial=0.0))
         if not np.isfinite(norm):

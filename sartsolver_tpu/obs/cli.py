@@ -133,6 +133,16 @@ def summarize(records: List[dict]) -> dict:
                 "occ_frame_iter_s": strag["occ_frame_iter_s"],
                 "occupancy": strag.get("occupancy"),
             }
+        # integrity-overhead section (bench.py): the integrity-on iter/s
+        # is a gated rate — the ABFT check's cost must stay bounded
+        # run-over-run (ISSUE 7 acceptance: within threshold of off)
+        integ = (bench[0].get("detail") or {}).get("integrity")
+        if isinstance(integ, dict) and "iter_s_on" in integ:
+            out["integrity"] = {
+                "iter_s_on": integ["iter_s_on"],
+                "iter_s_off": integ.get("iter_s_off"),
+                "overhead_pct": integ.get("overhead_pct"),
+            }
     return out
 
 
@@ -170,6 +180,11 @@ def _print_summary(path: str, summary: dict) -> None:
         b = summary["bench"]
         print(f"  bench {b['metric']}: {b['value']:g} "
               f"(vs_baseline {b['vs_baseline']:g})")
+    if "integrity" in summary:
+        i = summary["integrity"]
+        print(f"  integrity iter/s: on {i['iter_s_on']:g}, "
+              f"off {i['iter_s_off']:g} "
+              f"(overhead {i['overhead_pct']:+.1f}%)")
 
 
 def diff(old: dict, new: dict) -> dict:
@@ -225,6 +240,17 @@ def diff(old: dict, new: dict) -> dict:
         out["straggler"] = {"old": old["straggler"]["occ_frame_iter_s"],
                             "new": new["straggler"]["occ_frame_iter_s"]}
     out["straggler_value_pct"] = strag_pct
+    # integrity-on headline (numerical-integrity layer, RESILIENCE.md §8):
+    # a rate, gated like the bench value — a run-over-run drop means the
+    # ABFT check's overhead grew
+    integ_pct = None
+    if ("integrity" in old and "integrity" in new
+            and old["integrity"]["iter_s_on"]):
+        integ_pct = 100.0 * (new["integrity"]["iter_s_on"]
+                             / old["integrity"]["iter_s_on"] - 1.0)
+        out["integrity"] = {"old": old["integrity"]["iter_s_on"],
+                            "new": new["integrity"]["iter_s_on"]}
+    out["integrity_value_pct"] = integ_pct
     return out
 
 
@@ -292,6 +318,11 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['straggler']['old']:g} -> "
                       f"{delta['straggler']['new']:g} "
                       f"({delta['straggler_value_pct']:+.1f}%)")
+            if delta["integrity_value_pct"] is not None:
+                print(f"  integrity-on iter/s: "
+                      f"{delta['integrity']['old']:g} -> "
+                      f"{delta['integrity']['new']:g} "
+                      f"({delta['integrity_value_pct']:+.1f}%)")
         if args.threshold is not None:
             # regression directions differ by metric: solve_ms is a cost
             # (up = worse), the bench headline is a rate (down = worse)
@@ -321,6 +352,13 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"throughput regression "
                       f"{delta['straggler_value_pct']:+.1f}% exceeds the "
                       f"{args.threshold:g}% threshold.", file=sys.stderr)
+                return 2
+            if (delta["integrity_value_pct"] is not None
+                    and delta["integrity_value_pct"] < -args.threshold):
+                print(f"sartsolve metrics: integrity-on throughput "
+                      f"regression {delta['integrity_value_pct']:+.1f}% "
+                      f"exceeds the {args.threshold:g}% threshold.",
+                      file=sys.stderr)
                 return 2
         return 0
 

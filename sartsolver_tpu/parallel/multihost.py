@@ -123,6 +123,7 @@ def read_and_quantize_rtm(
     mesh,
     *,
     chunk_rows: Optional[int] = None,
+    ingest_stats=None,
 ):
     """Two-pass chunked int8 ingest: ``(codes jax.Array, scale jax.Array)``.
 
@@ -188,9 +189,16 @@ def read_and_quantize_rtm(
             np.rint(stripe / s[None, :]), -127, 127
         ).astype(np.int8)
 
+    def stats_dequant(codes_block: np.ndarray, col0: int) -> np.ndarray:
+        # integrity accumulation in DEQUANTIZED space: exactly what the
+        # device's compute_ray_stats_int8 reduction sums (codes x scale)
+        s = scale_np[col0:col0 + codes_block.shape[1]].astype(np.float64)
+        return codes_block.astype(np.float64) * s[None, :]
+
     codes = read_and_shard_rtm(
         sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
         dtype="int8", chunk_rows=chunk, _quantize_chunk=quantize_chunk,
+        ingest_stats=ingest_stats, _stats_dequant=stats_dequant,
         # share the pass-1 sparse cache: sparse segments are read once for
         # the whole two-pass ingest (dense hyperslabs still stream twice —
         # caching them would defeat the bounded-memory design)
@@ -216,9 +224,32 @@ def _read_stripe_retried(
     whole tens-of-GB ingest. Exhaustion raises ``RetriesExhausted``; the
     run cannot continue without its matrix, and the CLI maps that to the
     infrastructure exit code.
+
+    Integrity mode (``--integrity`` / ``SART_INTEGRITY``,
+    docs/RESILIENCE.md §8): every stripe is read TWICE and the CRC32 of
+    the two byte streams compared — a torn or silently-corrupted read
+    will not reproduce byte-for-byte, so a mismatch raises
+    :class:`~sartsolver_tpu.resilience.integrity.StripeDigestError`
+    (an ``OSError``) inside this same retry policy and the stripe is
+    simply re-read. Sparse segments held in the one-pass ingest cache
+    would make the second stripe read vacuous (both digests from the
+    same in-memory buffer), so those are verified once at
+    cache-population time instead (``io/raytransfer.py``) — the one
+    moment their bytes actually come off the filesystem. Costs one
+    extra read pass of the matrix, only when the layer is on.
     """
-    from sartsolver_tpu.resilience import faults, watchdog
+    from sartsolver_tpu.resilience import faults, integrity, watchdog
     from sartsolver_tpu.resilience.retry import retry_call
+
+    def read_once() -> np.ndarray:
+        stripe = read_rtm_block(
+            sorted_matrix_files, rtm_name, n, nvoxel, r0,
+            dtype=np.float32, **kwargs,
+        )
+        # data-kind faults (nan / corrupt) perturb the read's result —
+        # the corrupt kind models exactly the silent torn read the
+        # digest pass exists to catch
+        return faults.corrupt(faults.SITE_RTM_INGEST, stripe)
 
     def attempt() -> np.ndarray:
         # per-chunk progress beacon: the ingest of a tens-of-GB matrix is
@@ -226,10 +257,14 @@ def _read_stripe_retried(
         # the whole phase (docs/RESILIENCE.md §6)
         watchdog.beacon(watchdog.PHASE_PREFETCH)
         faults.fire(faults.SITE_RTM_INGEST)
-        return read_rtm_block(
-            sorted_matrix_files, rtm_name, n, nvoxel, r0,
-            dtype=np.float32, **kwargs,
-        )
+        stripe = read_once()
+        if integrity.enabled():
+            check = read_once()
+            if integrity.stripe_digest(stripe) != integrity.stripe_digest(
+                check
+            ):
+                integrity.digest_mismatch(f"RTM stripe [{r0}:{r0 + n})")
+        return stripe
 
     stripe = retry_call(attempt, site=faults.SITE_RTM_INGEST)
     # telemetry: exactly the bytes this stripe read off the filesystem —
@@ -253,8 +288,10 @@ def read_and_shard_rtm(
     dtype,
     serialize: bool = False,
     chunk_rows: Optional[int] = None,
+    ingest_stats=None,
     _quantize_chunk=None,
     _sparse_cache: Optional[dict] = None,
+    _stats_dequant=None,
 ) -> jax.Array:
     """Assemble the global padded RTM, each process reading only its rows.
 
@@ -276,6 +313,14 @@ def read_and_shard_rtm(
     barrier between turns — the reference's default HDD-friendly
     round-robin ingest (main.cpp:78-86, MPI_Barrier at :84); leave False
     for parallel reads (the reference's ``--parallel_read``).
+
+    ``ingest_stats`` (integrity layer): a
+    :class:`~sartsolver_tpu.resilience.integrity.IngestStats` accumulator
+    fed every logical device-block piece exactly once, in the
+    *storage-rounded* representation the device will actually sum — the
+    host-side rho/lambda the post-upload verification compares against
+    (``DistributedSARTSolver.verify_ray_stats``). Single-process only
+    (each process sees only its own rows/columns of a pod's matrix).
     """
     n_pix = mesh.shape.get(PIXEL_AXIS, 1)
     n_vox = mesh.shape.get(VOXEL_AXIS, 1)
@@ -401,6 +446,19 @@ def read_and_shard_rtm(
                             piece[:n, :cols_have] = (
                                 _quantize_chunk(sl, c0) if _quantize_chunk else sl
                             )
+                            if ingest_stats is not None and n > 0:
+                                from sartsolver_tpu.resilience import (
+                                    integrity as _integ,
+                                )
+
+                                block = piece[:n, :cols_have]
+                                if _stats_dequant is not None:
+                                    vals = _stats_dequant(block, c0)
+                                else:
+                                    vals = _integ.storage_round(
+                                        block, jdtype
+                                    )
+                                ingest_stats.add(vals, r0 + cs, c0)
                         bufs[j] = _scatter(
                             bufs[j], jax.device_put(piece, dev),
                             np.int32(cs),

@@ -499,6 +499,18 @@ class DistributedSARTSolver:
             rtm_dev, ray_density, ray_length, laplacian, rtm_scale
         )
         self._solve_fns = {}
+        # Integrity layer (docs/RESILIENCE.md §8): keep the stats program
+        # and an upload-time host snapshot of rho/lambda so the resident
+        # matrix can be re-audited between frames (reaudit_ray_stats) and
+        # the upload verified against ingest-accumulated host sums
+        # (verify_ray_stats). Off by default: no snapshot, no fetch.
+        self._ray_stats_fn = None
+        self._ray_stats_snapshot = None
+        if opts.integrity:
+            self._ray_stats_fn = stats_fn
+            self._ray_stats_snapshot = (
+                _fetch(ray_density).copy(), _fetch(ray_length).copy()
+            )
         # Tiny device helpers for the DeviceSolveResult path; their dispatch
         # is asynchronous, so neither adds a synchronous host round trip.
         # Scalars pack to fp32: status (0/-1) and iterations (<= max 2000)
@@ -583,6 +595,80 @@ class DistributedSARTSolver:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ---- numerical integrity (docs/RESILIENCE.md §8) --------------------
+
+    def _maybe_corrupt_resident(self) -> None:
+        """Probe the ``device.buffer`` *corrupt* fault: a trip perturbs one
+        element of the device-resident RTM in place (dtype preserved) while
+        the uploaded ray stats stay stale — exactly the resident-bit-rot
+        signature the ABFT check and the rho/lambda re-audit exist to
+        catch. Zero work (one dict lookup) when nothing is armed."""
+        from sartsolver_tpu.resilience import faults
+
+        if not faults.take_corrupt(faults.SITE_DEVICE_BUFFER):
+            return
+        rtm = self.problem.rtm
+        sharding = NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
+        if rtm.dtype == jnp.int8:
+            # codes live in [-127, 127]: reflect around 127 guarantees a
+            # changed, in-range value for any code but 63 (the fixture
+            # matrices never quantize element 0 to exactly 63)
+            upd = jax.jit(lambda m: m.at[0, 0].set(127 - m[0, 0]),
+                          out_shardings=sharding)
+        else:
+            upd = jax.jit(lambda m: m.at[0, 0].set(m[0, 0] * 256 + 1),
+                          out_shardings=sharding)
+        self.problem = self.problem._replace(rtm=upd(rtm))
+
+    def _ray_stats_args(self):
+        if self.problem.rtm_scale is not None:
+            return (self.problem.rtm, self.problem.rtm_scale)
+        return (self.problem.rtm,)
+
+    def verify_ray_stats(self, ingest_stats) -> list:
+        """Post-upload integrity verification: the device-computed
+        rho/lambda against the host sums accumulated during the chunked
+        ingest (``resilience.integrity.IngestStats``). Returns mismatch
+        descriptions (empty = verified). Requires ``opts.integrity``."""
+        from sartsolver_tpu.resilience import integrity
+
+        if self._ray_stats_snapshot is None:
+            raise ValueError(
+                "verify_ray_stats needs SolverOptions.integrity=True "
+                "(the upload-time rho/lambda snapshot is not kept "
+                "otherwise)."
+            )
+        dens, length = self._ray_stats_snapshot
+        return integrity.verify_ray_stats(
+            ingest_stats, dens[: self.nvoxel], length[: self.npixel],
+            rtm_dtype=self.opts.rtm_dtype,
+        )
+
+    def reaudit_ray_stats(self) -> list:
+        """Recompute rho/lambda from the RESIDENT matrix and compare
+        bit-for-bit against the upload-time snapshot — the same compiled
+        program on the same data is deterministic, so ANY difference is
+        resident bit rot. Returns mismatch descriptions (empty = clean).
+        Requires ``opts.integrity``; cost is one column+row reduction pass
+        over the RTM, intended every ``SART_INTEGRITY_REAUDIT`` frames."""
+        if self._ray_stats_fn is None:
+            raise ValueError(
+                "reaudit_ray_stats needs SolverOptions.integrity=True."
+            )
+        dens, length = self._ray_stats_fn(*self._ray_stats_args())
+        out = []
+        for name, now, ref in (
+            ("ray_density", _fetch(dens), self._ray_stats_snapshot[0]),
+            ("ray_length", _fetch(length), self._ray_stats_snapshot[1]),
+        ):
+            if not np.array_equal(np.asarray(now), ref):
+                diff = np.flatnonzero(np.asarray(now) != ref)
+                out.append(
+                    f"{name}: {diff.size} element(s) changed since upload "
+                    f"(first at index {int(diff[0])})"
+                )
+        return out
 
     def _problem_spec(self) -> SARTProblem:
         has_lap = self.problem.laplacian is not None
@@ -911,6 +997,7 @@ class DistributedSARTSolver:
 
         watchdog.beacon(watchdog.PHASE_DISPATCH)
         faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
+        self._maybe_corrupt_resident()  # device.buffer corrupt-fault drill
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
         if warm is not None and f0 is not None:
@@ -995,6 +1082,7 @@ class DistributedSARTSolver:
 
         watchdog.beacon(watchdog.PHASE_DISPATCH)
         faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
+        self._maybe_corrupt_resident()  # device.buffer corrupt-fault drill
         opts = self.opts
         dtype = jnp.dtype(opts.dtype)
         if warm is not None and f0 is not None:
@@ -1206,6 +1294,7 @@ class DistributedSARTSolver:
 
         watchdog.beacon(watchdog.PHASE_DISPATCH)
         faults.fire(faults.SITE_SOLVE)  # named site: solve-dispatch hazard
+        self._maybe_corrupt_resident()  # device.buffer corrupt-fault drill
         if self.problem is None:
             raise ValueError(
                 "This solver has been closed (close() released its device "
@@ -1372,6 +1461,31 @@ def _audit_sharded_fused_batch():
     return _audit_sharded_lowering(SolverOptions(
         max_iterations=8, conv_tolerance=1e-30, fused_sweep="on",
         fused_panel_voxels=_AUDIT_PANEL_VOXELS,
+    ))
+
+
+@_register_audit_entry(
+    "sharded_integrity_batch",
+    description=f"pixel-sharded batched solve step WITH the in-solve ABFT "
+                f"integrity check ({_AUDIT_SHARDS}x1 mesh, fp32): the "
+                "forward checksum and lambda.w dot are STACKED into the "
+                "convergence metric's all-reduce, so the per-iteration "
+                "collective budget stays at the plain sharded_batch count",
+    loop_copy_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    loop_convert_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    # THE invariant of the fold (ISSUE 7 acceptance): integrity on adds
+    # ZERO collectives to the audited loop — the back-projection psum and
+    # the (now checksum-carrying) metric psum, nothing else
+    loop_collective_budget={
+        "all-reduce": 2, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+    min_devices=_AUDIT_SHARDS,
+)
+def _audit_sharded_integrity_batch():
+    return _audit_sharded_lowering(SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        integrity=True,
     ))
 
 

@@ -17,6 +17,12 @@ Per-frame statuses (``solution/status``; extends config.py's codes):
 - ``-3`` FRAME_FAILED — the frame never produced a solution (ingest
   retries exhausted, staging/solve dispatch fault); the row holds zeros
   and ``iterations = -1``.
+- ``-4`` SDC_DETECTED — the in-solve ABFT integrity check
+  (``--integrity``, docs/RESILIENCE.md §8) caught a silent-data-
+  corruption signature; the row holds the last *consistent* iterate. The
+  CLI's escalation normally recomputes the frame once and converts a
+  repeat into FRAME_FAILED, so -4 reaches the file only from library
+  callers that skip the escalation.
 
 Process exit codes (the CLI contract):
 
@@ -40,7 +46,12 @@ from typing import List, NamedTuple, Optional
 
 import numpy as np
 
-from sartsolver_tpu.config import DIVERGED, MAX_ITERATIONS_EXCEEDED, SUCCESS
+from sartsolver_tpu.config import (
+    DIVERGED,
+    MAX_ITERATIONS_EXCEEDED,
+    SDC_DETECTED,
+    SUCCESS,
+)
 from sartsolver_tpu.resilience.faults import InjectedFault, InjectedIOError
 from sartsolver_tpu.resilience.retry import RetriesExhausted, retry_stats
 
@@ -115,6 +126,7 @@ def status_name(status: int) -> str:
         MAX_ITERATIONS_EXCEEDED: "max-iterations",
         DIVERGED: "diverged",
         FRAME_FAILED: "failed",
+        SDC_DETECTED: "sdc",
     }.get(int(status), f"unknown({int(status)})")
 
 
@@ -123,7 +135,7 @@ class RunSummary:
 
     def __init__(self) -> None:
         self.counts = {SUCCESS: 0, MAX_ITERATIONS_EXCEEDED: 0,
-                       DIVERGED: 0, FRAME_FAILED: 0}
+                       DIVERGED: 0, FRAME_FAILED: 0, SDC_DETECTED: 0}
         self.failed_times: List[float] = []
         # availability events (watchdog fires, OOM degradations, stop
         # requests): free-form one-liners appended by their owners and
@@ -134,7 +146,8 @@ class RunSummary:
     def record_status(self, status: int, time: Optional[float] = None) -> None:
         status = int(status)
         self.counts[status] = self.counts.get(status, 0) + 1
-        if status in (DIVERGED, FRAME_FAILED) and time is not None:
+        if (status in (DIVERGED, FRAME_FAILED, SDC_DETECTED)
+                and time is not None):
             self.failed_times.append(float(time))
 
     def record_event(self, event: str) -> None:
@@ -149,7 +162,8 @@ class RunSummary:
 
     @property
     def n_failed(self) -> int:
-        return self.counts[DIVERGED] + self.counts[FRAME_FAILED]
+        return (self.counts[DIVERGED] + self.counts[FRAME_FAILED]
+                + self.counts[SDC_DETECTED])
 
     def had_retries(self) -> bool:
         return any(

@@ -29,6 +29,15 @@ Kinds:
 - ``nan`` — sites that pass data through :func:`corrupt` get the array
   NaN-poisoned, modeling bad sensor frames / bit flips; exception sites
   ignore this kind.
+- ``corrupt`` — deterministic *finite* value perturbation, modeling a
+  silent bit flip that no NaN check can see: data sites
+  (:func:`corrupt`) get element 0 scaled by 256 and offset by 1 (dtype
+  preserved — a torn stripe read looks like a stripe read); device-buffer
+  sites probe :func:`take_corrupt` and perturb their resident array
+  themselves (``parallel/sharded.py``). Only the integrity layer
+  (``resilience/integrity.py``, ``SolverOptions.integrity``) detects this
+  kind — it drills ABFT checks, ingest digests and the SDC escalation
+  policy end-to-end, exactly like ``oom``/``hang`` drill theirs.
 - ``oom`` — the site raises :class:`InjectedOOM` (an
   :class:`InjectedFault` whose message carries the runtime's
   ``RESOURCE_EXHAUSTED`` marker), modeling a device out-of-memory on
@@ -70,13 +79,14 @@ SITE_DEVICE_PUT = "device.put"       # parallel/sharded.py: host->device staging
 SITE_SOLVE = "solve.dispatch"        # parallel/sharded.py: solve entry
 SITE_FLUSH = "io.flush"              # io/solution.py: output flush
 SITE_MULTIHOST_INIT = "multihost.init"  # parallel/multihost.py: runtime init
+SITE_DEVICE_BUFFER = "device.buffer"    # parallel/sharded.py: resident RTM rot
 
 FAULT_SITES = frozenset({
     SITE_FRAME_READ, SITE_RTM_INGEST, SITE_PREFETCH, SITE_DEVICE_PUT,
-    SITE_SOLVE, SITE_FLUSH, SITE_MULTIHOST_INIT,
+    SITE_SOLVE, SITE_FLUSH, SITE_MULTIHOST_INIT, SITE_DEVICE_BUFFER,
 })
 
-FAULT_KINDS = ("io", "error", "nan", "hang", "oom")
+FAULT_KINDS = ("io", "error", "nan", "hang", "oom", "corrupt")
 
 
 class InjectedIOError(OSError):
@@ -162,6 +172,13 @@ def parse_fault_spec(spec: str) -> Dict[str, _Fault]:
         count = int(parts[3]) if len(parts) == 4 else None
         if count is not None and count < 1:
             raise ValueError(f"Fault count must be >= 1, got {count}.")
+        if site in out:
+            # one fault per site: a drill spec listing a site twice would
+            # silently lose the first entry — loud beats last-wins
+            raise ValueError(
+                f"Fault site {site!r} armed twice in one spec; a site "
+                "holds one fault (arm different sites to combine drills)."
+            )
         out[site] = _Fault(
             site, kind, prob, count,
             rng=np.random.default_rng([seed, site_seed(site)]),
@@ -235,11 +252,11 @@ def _hang(site: str, trip: int) -> None:
 def fire(site: str) -> None:
     """Raise the armed exception fault for ``site``, if it trips.
 
-    The zero-fault path is one dict lookup; ``nan`` faults never raise
-    (they act through :func:`corrupt`).
+    The zero-fault path is one dict lookup; ``nan``/``corrupt`` faults
+    never raise (they act through :func:`corrupt` / :func:`take_corrupt`).
     """
     fault = _active().get(site)
-    if fault is None or fault.kind == "nan":
+    if fault is None or fault.kind in ("nan", "corrupt"):
         return
     if fault.should_trip():
         if fault.kind == "io":
@@ -260,20 +277,40 @@ def fire(site: str) -> None:
 
 
 def corrupt(site: str, array: np.ndarray) -> np.ndarray:
-    """NaN-poison ``array`` if a ``nan`` fault trips at ``site``.
+    """Corrupt ``array`` if a data-kind fault trips at ``site``.
 
-    Returns the input unchanged (no copy) on the zero-fault path; a
-    tripped fault returns a poisoned copy (the first element set to NaN —
-    enough to poison any reduction over the data that contains it).
+    Returns the input unchanged (no copy) on the zero-fault path. A
+    tripped ``nan`` fault returns a poisoned fp64 copy (first element set
+    to NaN — enough to poison any reduction over the data). A tripped
+    ``corrupt`` fault returns a *finite* perturbation with the dtype
+    preserved — element 0 scaled by 256 and offset by 1 — modeling a
+    silent bit flip that no NaN check can see; only the integrity layer
+    (resilience/integrity.py) detects it.
     """
     fault = _active().get(site)
-    if fault is None or fault.kind != "nan":
+    if fault is None or fault.kind not in ("nan", "corrupt"):
         return array
     if not fault.should_trip():
         return array
-    poisoned = np.array(array, dtype=np.float64, copy=True)
-    poisoned.reshape(-1)[0] = np.nan
-    return poisoned
+    if fault.kind == "nan":
+        poisoned = np.array(array, dtype=np.float64, copy=True)
+        poisoned.reshape(-1)[0] = np.nan
+        return poisoned
+    perturbed = np.array(array, copy=True)  # dtype preserved
+    flat = perturbed.reshape(-1)
+    flat[0] = flat[0] * 256 + 1
+    return perturbed
+
+
+def take_corrupt(site: str) -> bool:
+    """True iff a ``corrupt`` fault trips at ``site`` — for sites whose
+    data is a *device-resident* buffer they must perturb themselves
+    (``parallel/sharded.py``'s resident-RTM rot drill) rather than pass
+    a host array through :func:`corrupt`."""
+    fault = _active().get(site)
+    if fault is None or fault.kind != "corrupt":
+        return False
+    return fault.should_trip()
 
 
 def fault_trips() -> Dict[str, int]:

@@ -1,0 +1,335 @@
+"""End-to-end numerical integrity: ABFT checks, ingest digests, SDC policy.
+
+Every other resilience layer in this package reacts to failures that
+*announce themselves* — exceptions, hangs, non-finite metrics. Silent data
+corruption does none of that: a flipped bit in the device-resident RTM, a
+torn HDF5 stripe, or a bad MXU product produces a perfectly finite, merely
+*wrong* solution that then warm-starts every following frame. This module
+is the detection-and-escalation side of docs/RESILIENCE.md §8; the
+device-side checks it parameterizes live in ``models/sart.py``.
+
+Three detection mechanisms, all off by default (``SolverOptions.integrity``
+/ ``--integrity`` / ``SART_INTEGRITY=1``; with the layer off every traced
+program and every ingest byte is identical to a build without it):
+
+1. **In-solve ABFT** (algorithm-based fault tolerance): the linear-algebra
+   identities ``sum(Hf) == rho . f`` (rho = ``ray_density``, the column
+   sums) and ``sum(H^T w) == lambda . w`` (lambda = ``ray_length``, the row
+   sums) hold *exactly* for the stored matrix, for any vector — so a
+   per-iteration comparison of two already-needed reductions against an
+   fp-derived tolerance (:func:`abft_tolerance`) detects a corrupted
+   resident matrix or a bad matmul product the same iteration it happens,
+   at the cost of two dot products and two scalar compares per frame. The
+   checksum dot folds into the existing convergence all-reduce on sharded
+   meshes (``jnp.stack`` before the psum), so the audited per-iteration
+   collective budget is unchanged (``sharded_integrity_batch`` golden).
+2. **Ingest stripe digests**: every RTM stripe is read twice and the CRC32
+   of the two byte streams compared (:func:`stripe_digest`); a torn or
+   corrupted read will not reproduce byte-for-byte, so a mismatch raises
+   :class:`StripeDigestError` *inside* the existing retry policy — the
+   stripe is simply re-read. Post-upload, the device-computed rho/lambda
+   are verified against host-side sums accumulated during the ingest
+   (:class:`IngestStats` / :func:`verify_ray_stats`), catching staging and
+   quantization corruption before the first solve.
+3. **Resident re-audit**: rho/lambda recomputed from the device-resident
+   matrix every ``SART_INTEGRITY_REAUDIT`` frames and compared bit-for-bit
+   against the upload-time snapshot
+   (``DistributedSARTSolver.reaudit_ray_stats``) — resident bit rot that
+   predates any solve's ABFT trip is caught between frames.
+
+Escalation (:class:`SdcEscalation`), wired into the existing taxonomy:
+a detected frame is **recomputed once** (a transient MXU fault does not
+reproduce); a frame that trips again is **FAILED** through the per-frame
+isolation path (status -3 row, run continues, exit 2); once
+``SART_SDC_ABORT_THRESHOLD`` frames have failed terminally — or a resident
+re-audit / post-upload verification mismatches — the run **aborts** with
+:class:`PersistentCorruptionError` (infrastructure exit 3, resumable file)
+and a quarantine event in telemetry, because a corrupted resident session
+poisons every request it serves.
+
+Telemetry: ``sdc_detected_total``, ``integrity_recomputes_total``,
+``stripe_digest_mismatch_total`` counters (docs/OBSERVABILITY.md) plus
+quarantine events in the run summary and ``--metrics_out`` artifact.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import zlib
+from typing import List, Optional
+
+import numpy as np
+
+
+class IntegrityError(RuntimeError):
+    """A frame's silent-data-corruption detection survived its recompute;
+    the frame is escalated into the per-frame isolation path (a FAILED
+    status row) with this as the recorded error."""
+
+
+#: the one user-facing diagnostic for a reproduced in-solve detection —
+#: shared by the grouped loops (cli.py) and the scheduler path so the
+#: identical condition reads identically whichever loop hit it
+SDC_REPRODUCED = (
+    "silent data corruption detected in-solve and reproduced "
+    "by the recompute"
+)
+
+
+class PersistentCorruptionError(RuntimeError):
+    """Corruption that recomputing cannot clear: the resident matrix (or
+    the output of its staging) is wrong, so every further solve is
+    poisoned. The CLI maps this to the infrastructure exit code 3 (the
+    output file stays resumable) and records a quarantine event."""
+
+
+class StripeDigestError(OSError):
+    """The two reads of one RTM stripe disagreed byte-for-byte — a torn or
+    corrupted read. An ``OSError`` so the existing ``hdf5.rtm_ingest``
+    retry policy re-reads the stripe instead of aborting."""
+
+
+# ---------------------------------------------------------------------------
+# enablement
+# ---------------------------------------------------------------------------
+
+_state = {"enabled": None}  # None: not configured, read SART_INTEGRITY
+_lock = threading.Lock()
+
+
+def configure(enabled: bool) -> None:
+    """Set the process-wide ingest-integrity switch (the CLI calls this
+    from ``--integrity``; the in-solve check is per-``SolverOptions``)."""
+    with _lock:
+        _state["enabled"] = bool(enabled)
+
+
+def env_enabled() -> bool:
+    """The ``SART_INTEGRITY`` environment switch alone, ignoring any
+    :func:`configure` call — the ONE copy of the accepted-value list
+    (the CLI folds it into its per-run decision before configuring)."""
+    return os.environ.get("SART_INTEGRITY", "") in ("1", "true", "on")
+
+
+def enabled() -> bool:
+    """Whether ingest-side integrity (stripe digests) is on. Defaults to
+    the ``SART_INTEGRITY`` environment variable so library users get the
+    same switch the CLI exposes."""
+    val = _state["enabled"]
+    if val is None:
+        return env_enabled()
+    return val
+
+
+# ---------------------------------------------------------------------------
+# ABFT tolerance
+# ---------------------------------------------------------------------------
+
+def abft_tolerance(
+    compute_dtype, rtm_dtype: Optional[str], npixel: int, nvoxel: int
+) -> float:
+    """Relative tolerance of the in-solve ABFT residual, per dtype.
+
+    Both sides of each identity are sums of ``npixel * nvoxel``
+    non-negative products (the RTM and the iterates are non-negative, so
+    there is no cancellation): the accumulated rounding error is bounded
+    by ``~eps * n * |sum|`` worst-case and ``~eps * sqrt(n) * |sum|`` for
+    the blocked/pairwise reductions XLA actually emits. The tolerance uses
+    the square-root law with a 64x safety factor — wide enough that clean
+    solves never trip across dtypes/shapes/seeds (pinned by the
+    ``tests/test_integrity.py`` hypothesis suite), tight enough that any
+    single flip whose induced residual exceeds it is detected the same
+    iteration. bf16/int8 storage get a further 4x: their ray stats and
+    dequantized products round through extra fp32 steps.
+    """
+    eps = float(np.finfo(np.dtype(compute_dtype)).eps)
+    factor = 4.0 if rtm_dtype in ("bfloat16", "int8") else 1.0
+    return 64.0 * factor * eps * math.sqrt(float(npixel + nvoxel) + 1.0)
+
+
+# ---------------------------------------------------------------------------
+# ingest digests + host-side ray-stats accumulation
+# ---------------------------------------------------------------------------
+
+def stripe_digest(array: np.ndarray) -> int:
+    """CRC32 of an array's bytes (order-stable: contiguous C layout)."""
+    return zlib.crc32(np.ascontiguousarray(array).tobytes()) & 0xFFFFFFFF
+
+
+def digest_mismatch(what: str) -> None:
+    """The ONE detect-count-raise convention for ingest double-read
+    digest mismatches: increment ``stripe_digest_mismatch_total`` and
+    raise :class:`StripeDigestError` (an ``OSError``, so the existing
+    ``hdf5.rtm_ingest`` retry policy re-reads instead of aborting).
+    Shared by the stripe-level compare (``parallel/multihost.py``) and
+    the sparse-cache population compare (``io/raytransfer.py``)."""
+    from sartsolver_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.get_registry().counter(
+        "stripe_digest_mismatch_total"
+    ).inc()
+    raise StripeDigestError(
+        f"{what} read twice with different bytes (torn or corrupted "
+        "read); retrying"
+    )
+
+
+def storage_round(values: np.ndarray, rtm_dtype) -> np.ndarray:
+    """fp64 view of ``values`` after rounding through the on-device
+    storage dtype — what the device's ray-stat reductions will actually
+    sum. int8 is handled by the caller (codes need their scales)."""
+    jd = np.dtype("float32") if rtm_dtype is None else None
+    if jd is None:
+        name = str(rtm_dtype)
+        if name == "bfloat16":
+            import ml_dtypes  # jax's own dtype package — always present
+
+            return np.asarray(values, ml_dtypes.bfloat16).astype(np.float64)
+        jd = np.dtype(name)
+    return np.asarray(values, jd).astype(np.float64)
+
+
+class IngestStats:
+    """Host-side rho/lambda accumulator filled during the chunked ingest.
+
+    ``add(values, r0, c0)`` takes one logical block of the matrix in the
+    *storage-rounded* fp64 representation (``storage_round``, or
+    dequantized int8 codes) at logical offset ``(r0, c0)``; every logical
+    element must be added exactly once. The absolute sums scale the
+    verification tolerance (:func:`verify_ray_stats`).
+    """
+
+    def __init__(self, npixel: int, nvoxel: int):
+        self.npixel, self.nvoxel = int(npixel), int(nvoxel)
+        self.colsum = np.zeros(nvoxel, np.float64)
+        self.rowsum = np.zeros(npixel, np.float64)
+        self.colabs = np.zeros(nvoxel, np.float64)
+        self.rowabs = np.zeros(npixel, np.float64)
+
+    def add(self, values: np.ndarray, r0: int, c0: int) -> None:
+        v = np.asarray(values, np.float64)
+        n, m = v.shape
+        self.colsum[c0:c0 + m] += v.sum(axis=0)
+        self.rowsum[r0:r0 + n] += v.sum(axis=1)
+        av = np.abs(v)
+        self.colabs[c0:c0 + m] += av.sum(axis=0)
+        self.rowabs[r0:r0 + n] += av.sum(axis=1)
+
+
+def verify_ray_stats(
+    stats: IngestStats,
+    ray_density: np.ndarray,
+    ray_length: np.ndarray,
+    *,
+    rtm_dtype: Optional[str] = None,
+) -> List[str]:
+    """Compare device-computed rho/lambda against the ingest accumulator.
+
+    Returns a list of mismatch descriptions (empty = verified). The
+    tolerance covers the device's fp32 reductions against the host's fp64
+    ones — relative to the *absolute* column/row mass, so sparse columns
+    do not false-positive on cancellation they cannot have, and it grows
+    with the reduction length like :func:`abft_tolerance` (the device's
+    blocked fp32 sums accumulate ``~eps32 * sqrt(n)`` relative error, so
+    a fixed band would spuriously quarantine a clean many-megapixel
+    ingest at startup). int8 gets a wider floor: its device stats
+    multiply an exact int32 sum by an fp32 scale, and the host
+    dequantizes through the same fp32 scales in fp64.
+    """
+    floor = 1e-3 if rtm_dtype == "int8" else 1e-4
+    eps32 = float(np.finfo(np.float32).eps)
+    out: List[str] = []
+    for name, host, habs, dev, length in (
+        ("ray_density", stats.colsum, stats.colabs,
+         np.asarray(ray_density, np.float64)[: stats.nvoxel],
+         stats.npixel),
+        ("ray_length", stats.rowsum, stats.rowabs,
+         np.asarray(ray_length, np.float64)[: stats.npixel],
+         stats.nvoxel),
+    ):
+        rel = max(floor, 32.0 * eps32 * math.sqrt(float(length) + 1.0))
+        err = np.abs(host - dev)
+        bad = err > rel * (habs + 1.0)
+        if bad.any():
+            worst = int(np.argmax(err / (habs + 1.0)))
+            out.append(
+                f"{name}: {int(bad.sum())} element(s) beyond the "
+                f"{rel:g}-relative band (worst at index {worst}: host "
+                f"{host[worst]:.9g} vs device {dev[worst]:.9g})"
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# escalation policy
+# ---------------------------------------------------------------------------
+
+class SdcEscalation:
+    """Host-side escalation of in-solve SDC detections
+    (docs/RESILIENCE.md §8): recompute once → FAILED row → quarantine
+    abort after ``SART_SDC_ABORT_THRESHOLD`` terminal frames (default 2 —
+    two frames corrupt even after recomputing means the *resident* state
+    is corrupt, not the transient).
+
+    The three integrity counters are registered up front so a clean
+    integrity-on run's artifact shows them at zero (a dashboard can tell
+    "nothing detected" from "layer off").
+    """
+
+    def __init__(self, *, on_event=None, abort_threshold: Optional[int] = None):
+        from sartsolver_tpu.obs import metrics as obs_metrics
+
+        registry = obs_metrics.get_registry()
+        self._detected = registry.counter("sdc_detected_total")
+        self._recomputes = registry.counter("integrity_recomputes_total")
+        registry.counter("stripe_digest_mismatch_total")
+        self._on_event = on_event
+        self._terminal = 0
+        self._terminal_times: List[float] = []
+        self.threshold = (
+            int(os.environ.get("SART_SDC_ABORT_THRESHOLD", "2"))
+            if abort_threshold is None else int(abort_threshold)
+        )
+
+    def _event(self, message: str) -> None:
+        if self._on_event is not None:
+            self._on_event(message)
+
+    def detected(self, n: int = 1) -> None:
+        """Record n in-solve SDC detections (pre-escalation)."""
+        self._detected.inc(n)
+
+    def note_recompute(self, n_frames: int = 1) -> None:
+        """A detected frame (or group) is being re-solved once."""
+        self._recomputes.inc(n_frames)
+
+    def record_terminal(self, frame_time: float) -> None:
+        """A frame stayed corrupt through its recompute: it becomes a
+        FAILED row; raise :class:`PersistentCorruptionError` once the
+        abort threshold is reached (quarantine the session). The frame
+        times travel in the quarantine event so the operator knows which
+        rows to distrust."""
+        self._terminal += 1
+        self._terminal_times.append(float(frame_time))
+        if self.threshold > 0 and self._terminal >= self.threshold:
+            shown = ", ".join(f"{t:g}" for t in self._terminal_times[:8])
+            if self._terminal > 8:
+                shown += ", ..."
+            msg = (
+                f"quarantine: {self._terminal} frame(s) failed their SDC "
+                f"recompute (t = {shown}; persistent silent data "
+                "corruption — resident matrix or device state); aborting "
+                "the session"
+            )
+            self._event(msg)
+            raise PersistentCorruptionError(msg)
+
+    def resident_failure(self, detail: str) -> None:
+        """A resident re-audit or post-upload rho/lambda verification
+        mismatched: the session state is provably corrupt — quarantine
+        immediately, no recompute can help."""
+        msg = f"quarantine: resident integrity verification failed ({detail})"
+        self._event(msg)
+        raise PersistentCorruptionError(msg)

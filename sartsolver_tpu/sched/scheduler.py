@@ -58,6 +58,7 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from sartsolver_tpu.config import SDC_DETECTED
 from sartsolver_tpu.obs import metrics as obs_metrics
 from sartsolver_tpu.resilience.degrade import (
     dispatch_guarded,
@@ -100,7 +101,8 @@ class SchedRunStats:
 class _Slot:
     """One occupied lane's host-side bookkeeping."""
 
-    __slots__ = ("seq", "frame", "ftime", "cam_times", "it_prev")
+    __slots__ = ("seq", "frame", "ftime", "cam_times", "it_prev",
+                 "sdc_retries")
 
     def __init__(self, seq, frame, ftime, cam_times):
         self.seq = seq
@@ -108,6 +110,10 @@ class _Slot:
         self.ftime = ftime
         self.cam_times = cam_times
         self.it_prev = 0
+        # SDC escalation (docs/RESILIENCE.md §8): how many times this
+        # frame was re-queued after an ABFT trip — recompute-once, then
+        # the lane fails through the ordered FAILED-row path
+        self.sdc_retries = 0
 
 
 class ContinuousBatcher:
@@ -136,9 +142,16 @@ class ContinuousBatcher:
         on_event: Optional[Callable[[str], None]] = None,
         isolate: bool = True,
         refill_quantum: Optional[int] = None,
+        integrity_policy=None,
     ):
         if lanes < 1:
             raise ValueError("Lane count must be positive.")
+        # resilience.integrity.SdcEscalation (or None): a lane retiring
+        # with SDC_DETECTED is re-queued once (recompute), then failed as
+        # an ordered row; the policy's terminal accounting may raise
+        # PersistentCorruptionError to quarantine the whole session —
+        # deliberately NOT a recoverable error, it propagates to the CLI
+        self._integrity = integrity_policy
         self._solver = solver
         self._lanes = int(lanes)
         # A refill stride pays the Eq. 4 guess branch — two extra RTM
@@ -203,6 +216,7 @@ class ContinuousBatcher:
         exhausted = False
         free = deque(range(B))
         occupied = {}  # lane index -> _Slot
+        self._sdc_retry = deque()  # slots awaiting their SDC recompute
         seq = 0
         t_last = time.perf_counter()
 
@@ -214,6 +228,15 @@ class ContinuousBatcher:
             guess branch for a single lane."""
             nonlocal exhausted, seq
             refills = []
+            # SDC recomputes first, bypassing the refill quantum: the
+            # frame is already in flight (its seq slot blocks the ordered
+            # emission) — delaying its recompute stalls the reorder buffer
+            while self._sdc_retry and free:
+                slot = self._sdc_retry.popleft()
+                slot.it_prev = 0
+                lane = free.popleft()
+                occupied[lane] = slot
+                refills.append((lane, slot.frame))
             if occupied and len(free) < self._refill_quantum:
                 return refills
             while free and not exhausted and not stats.interrupted:
@@ -286,6 +309,11 @@ class ContinuousBatcher:
                     self._emit_buf[slot.seq] = (
                         "failed", (slot.ftime, slot.cam_times, err), None,
                     )
+                for slot in self._sdc_retry:  # awaiting-recompute frames
+                    self._emit_buf[slot.seq] = (
+                        "failed", (slot.ftime, slot.cam_times, err), None,
+                    )
+                self._sdc_retry.clear()
                 occupied.clear()
                 free = deque(range(B))
                 lane_state = solver.sched_lanes(B)
@@ -317,6 +345,34 @@ class ContinuousBatcher:
             ]
             for lane in sorted(retired_now,
                                key=lambda b: occupied[b].seq):
+                if (self._integrity is not None
+                        and int(status[lane]) == SDC_DETECTED):
+                    # ABFT trip (docs/RESILIENCE.md §8): recompute once by
+                    # re-queuing the frame onto a fresh lane; a repeat is
+                    # a FAILED row in the same ordered stream. The
+                    # terminal accounting may raise
+                    # PersistentCorruptionError — quarantine the session.
+                    slot = occupied.pop(lane)
+                    free.append(lane)
+                    self._integrity.detected()
+                    if slot.sdc_retries == 0:
+                        slot.sdc_retries = 1
+                        self._integrity.note_recompute()
+                        self._sdc_retry.append(slot)
+                        continue
+                    from sartsolver_tpu.resilience.integrity import (
+                        SDC_REPRODUCED,
+                        IntegrityError,
+                    )
+
+                    self._integrity.record_terminal(slot.ftime)
+                    self._emit_buf[slot.seq] = (
+                        "failed",
+                        (slot.ftime, slot.cam_times,
+                         IntegrityError(SDC_REPRODUCED)),
+                        None,
+                    )
+                    continue
                 slot = occupied.pop(lane)
                 fetcher = lane_state.lane_solution_fetcher(lane)
                 stats.solved += 1
@@ -358,6 +414,9 @@ class ContinuousBatcher:
                 ftime, cam_times = payload[0], payload[1]
                 entries.append((seq_i, (frame, ftime, cam_times)))
         for lane, slot in occupied.items():
+            entries.append((slot.seq, (slot.frame, slot.ftime,
+                                       slot.cam_times)))
+        for slot in getattr(self, "_sdc_retry", ()):  # awaiting recompute
             entries.append((slot.seq, (slot.frame, slot.ftime,
                                        slot.cam_times)))
         self._emit_buf.clear()

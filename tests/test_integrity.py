@@ -1,0 +1,608 @@
+"""Numerical-integrity layer (docs/RESILIENCE.md §8): `make sdc`.
+
+The drill matrix proves every leg of the SDC contract:
+
+- **clean, no false positives** — integrity-on solves across dtypes /
+  shapes / seeds / both solver variants never trip the ABFT check and
+  produce bit-identical solutions to integrity-off runs (hypothesis);
+- **guaranteed detection** — any single injected perturbation whose
+  induced checksum residual exceeds the dtype tolerance is flagged
+  SDC_DETECTED the same solve (hypothesis, margin-scaled flips);
+- **corrupt-fault drills** — the `corrupt` fault kind at the ingest
+  stripe (digest re-read, clean output), the device-resident buffer
+  (recompute → FAILED → quarantine exit 3) and the scheduler lane path,
+  end-to-end through the real CLI, exactly like `oom`/`hang` drill their
+  layers;
+- **escalation policy** — recompute-once accounting, the terminal-frame
+  abort threshold, resident re-audit and post-upload verification;
+- **satellites** — per-frame solution checksums verified on --resume,
+  the non-finite-pixel counter, multi-site fault specs.
+"""
+
+import json
+import os
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+from sartsolver_tpu.cli import main
+from sartsolver_tpu.config import SDC_DETECTED, SartInputError, SolverOptions
+from sartsolver_tpu.models.sart import (
+    make_problem,
+    prepare_measurement,
+    solve_normalized_batch,
+)
+from sartsolver_tpu.resilience import faults, integrity
+from sartsolver_tpu.resilience.failures import (
+    EXIT_INFRASTRUCTURE,
+    EXIT_PARTIAL,
+    FRAME_FAILED,
+)
+from sartsolver_tpu.resilience.retry import reset_retry_stats
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate(monkeypatch):
+    """No armed faults, fresh retry stats, fast backoff, and the
+    integrity switch back to its env default after every test."""
+    monkeypatch.setenv("SART_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("SART_RETRY_MAX_DELAY", "0.002")
+    monkeypatch.delenv("SART_FAULT", raising=False)
+    monkeypatch.delenv("SART_INTEGRITY", raising=False)
+    faults.clear_faults()
+    reset_retry_stats()
+    yield
+    faults.clear_faults()
+    reset_retry_stats()
+    integrity._state["enabled"] = None
+
+
+@pytest.fixture
+def world(tmp_path):
+    return fx.write_world(tmp_path)
+
+
+def run_cli(paths, *extra):
+    return main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "-m", "100",
+        *extra,
+    ])
+
+
+def _read_out(paths):
+    with h5py.File(paths["output"], "r") as f:
+        return (f["solution/value"][:], f["solution/status"][:],
+                f["solution/iterations"][:])
+
+
+def _problem(seed, P, V, opts):
+    rng = np.random.default_rng(seed)
+    H = rng.uniform(0.1, 1.0, (P, V)).astype(np.float32)
+    f_true = rng.uniform(0.5, 2.0, V)
+    g = H @ f_true
+    g64, msq, _norm = prepare_measurement(g, opts)
+    problem = make_problem(H, opts=opts)
+    return H, problem, jnp.asarray(g64, jnp.float32)[None, :], msq
+
+
+def _solve(problem, g_n, msq, opts):
+    return solve_normalized_batch(
+        problem, g_n, jnp.asarray([msq], jnp.float32),
+        jnp.zeros((1, problem.rtm.shape[1]), jnp.float32),
+        opts=opts, axis_name=None, voxel_axis=None, use_guess=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# ABFT tolerance properties (hypothesis)
+# ---------------------------------------------------------------------------
+
+try:
+    from hypothesis import given, settings, strategies as st
+
+    HAVE_HYP = True
+    # each example compiles fresh XLA programs (distinct shapes) — keep
+    # the counts small so the suite wall-time stays flat (SET_JIT
+    # convention of tests/test_properties.py)
+    SET_JIT = settings(max_examples=12, deadline=None, derandomize=True)
+except ImportError:  # pragma: no cover - optional extra
+    HAVE_HYP = False
+
+
+def test_abft_tolerance_shape():
+    """Tolerance grows with extent and loosens for lossy storage."""
+    t32 = integrity.abft_tolerance("float32", None, 64, 512)
+    assert 0 < t32 < 1e-2
+    assert integrity.abft_tolerance("float32", None, 640, 5120) > t32
+    assert integrity.abft_tolerance("float32", "bfloat16", 64, 512) > t32
+    assert integrity.abft_tolerance("float32", "int8", 64, 512) > t32
+    assert (integrity.abft_tolerance("float64", None, 64, 512)
+            < t32)  # fp64 compute tightens the band
+
+
+if HAVE_HYP:
+
+    @SET_JIT
+    @given(
+        st.integers(0, 2**31 - 1),
+        st.sampled_from([(16, 24), (24, 40), (8, 56)]),
+        st.sampled_from([None, "bfloat16"]),
+        st.booleans(),  # logarithmic
+    )
+    def test_abft_clean_runs_never_trip(seed, shape, rtm_dtype, log):
+        """Zero false positives: an integrity-on solve of a clean random
+        problem never reports SDC and matches the integrity-off solve
+        bit for bit (the check is a pure observer)."""
+        P, V = shape
+        base = dict(max_iterations=40, logarithmic=log,
+                    rtm_dtype=rtm_dtype, fused_sweep="off")
+        off = SolverOptions(**base)
+        on = SolverOptions(**base, integrity=True)
+        _H, problem, g_n, msq = _problem(seed, P, V, off)
+        r_off = _solve(problem, g_n, msq, off)
+        r_on = _solve(problem, g_n, msq, on)
+        assert int(r_on.status[0]) != SDC_DETECTED
+        assert int(r_on.status[0]) == int(r_off.status[0])
+        np.testing.assert_array_equal(
+            np.asarray(r_on.solution), np.asarray(r_off.solution)
+        )
+
+    @SET_JIT
+    @given(
+        st.integers(0, 2**31 - 1),
+        st.sampled_from([(16, 24), (24, 40)]),
+        st.integers(0, 1000),  # perturbed column (mod V)
+        st.floats(8.0, 1e4),  # margin above the tolerance magnitude
+    )
+    def test_abft_detects_flip_above_tolerance(seed, shape, col, margin):
+        """Guaranteed detection: perturb ONE matrix entry by a delta whose
+        induced checksum residual exceeds the dtype tolerance (scaled by
+        `margin`), leaving the uploaded ray stats stale — the solve must
+        flag SDC_DETECTED and keep its solution finite (the last
+        consistent iterate)."""
+        P, V = shape
+        j = col % V
+        opts_off = SolverOptions(max_iterations=20, fused_sweep="off")
+        opts_on = SolverOptions(max_iterations=20, fused_sweep="off",
+                                integrity=True)
+        H, problem, g_n, msq = _problem(seed, P, V, opts_off)
+        # probe the clean solve's scale: iterate magnitudes and the
+        # checksum reference both come from the fitted sums
+        probe = _solve(problem, g_n, msq,
+                       SolverOptions(max_iterations=1, fused_sweep="off"))
+        f1 = np.asarray(probe.solution)[0]
+        ref = float(np.sum(H.astype(np.float64) @ f1)) + 1.0
+        tol = integrity.abft_tolerance("float32", None, P, V)
+        # delta * f_j is the residual a stale rho sees; f is bounded below
+        # by the update's structure on this all-positive problem
+        f_floor = max(float(f1[j]), 1e-3)
+        delta = margin * tol * ref / f_floor
+        H2 = H.copy()
+        H2[0, j] += np.float32(delta)
+        corrupted = problem._replace(rtm=jnp.asarray(H2))
+        res = _solve(corrupted, g_n, msq, opts_on)
+        assert int(res.status[0]) == SDC_DETECTED
+        assert np.isfinite(np.asarray(res.solution)).all()
+
+
+# ---------------------------------------------------------------------------
+# fault kinds: corrupt / take_corrupt / multi-site specs
+# ---------------------------------------------------------------------------
+
+def test_corrupt_kind_finite_and_dtype_preserving():
+    faults.inject(faults.SITE_RTM_INGEST, "corrupt", count=1)
+    arr = np.full((3, 2), 2.0, np.float32)
+    out = faults.corrupt(faults.SITE_RTM_INGEST, arr)
+    assert out.dtype == np.float32 and out is not arr
+    assert np.isfinite(out).all()
+    assert out.reshape(-1)[0] == np.float32(2.0 * 256 + 1)
+    assert (out.reshape(-1)[1:] == 2.0).all()
+    # capped after one trip: identity, no copy
+    assert faults.corrupt(faults.SITE_RTM_INGEST, arr) is arr
+    # corrupt faults never raise through fire()
+    faults.clear_faults()
+    faults.inject(faults.SITE_RTM_INGEST, "corrupt", count=5)
+    faults.fire(faults.SITE_RTM_INGEST)
+
+
+def test_take_corrupt_only_for_corrupt_kind():
+    assert not faults.take_corrupt(faults.SITE_DEVICE_BUFFER)
+    faults.inject(faults.SITE_DEVICE_BUFFER, "corrupt", count=1)
+    assert faults.take_corrupt(faults.SITE_DEVICE_BUFFER)
+    assert not faults.take_corrupt(faults.SITE_DEVICE_BUFFER)  # capped
+    faults.clear_faults()
+    faults.inject(faults.SITE_DEVICE_BUFFER, "error")
+    assert not faults.take_corrupt(faults.SITE_DEVICE_BUFFER)
+
+
+def test_multi_site_spec_arms_ingest_and_solve_in_one_run():
+    """One SART_FAULT string arms independent drills at several sites."""
+    armed = faults.parse_fault_spec(
+        "hdf5.rtm_ingest:corrupt:1:1, device.buffer:corrupt:1:2, "
+        "solve.dispatch:error:0.5:3"
+    )
+    assert set(armed) == {"hdf5.rtm_ingest", "device.buffer",
+                          "solve.dispatch"}
+    assert armed["device.buffer"].kind == "corrupt"
+    assert armed["device.buffer"].count == 2
+
+
+def test_duplicate_site_spec_rejected():
+    with pytest.raises(ValueError, match="armed twice"):
+        faults.parse_fault_spec("io.flush:io:1, io.flush:error:1")
+
+
+# ---------------------------------------------------------------------------
+# escalation policy + resident verification units
+# ---------------------------------------------------------------------------
+
+def test_sdc_escalation_threshold_and_events():
+    events = []
+    policy = integrity.SdcEscalation(on_event=events.append,
+                                     abort_threshold=2)
+    policy.detected()
+    policy.note_recompute()
+    policy.record_terminal(0.1)  # below threshold: no raise
+    with pytest.raises(integrity.PersistentCorruptionError):
+        policy.record_terminal(0.2)
+    assert any("quarantine" in e for e in events)
+    # the terminal frame times travel in the event — the operator must
+    # know which rows to distrust
+    assert any("0.1" in e and "0.2" in e for e in events)
+
+
+def test_sdc_escalation_resident_failure_raises_immediately():
+    policy = integrity.SdcEscalation(abort_threshold=99)
+    with pytest.raises(integrity.PersistentCorruptionError,
+                       match="resident"):
+        policy.resident_failure("re-audit mismatch")
+
+
+def test_reaudit_detects_resident_corruption():
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    rng = np.random.default_rng(3)
+    H = rng.uniform(0.1, 1.0, (16, 24)).astype(np.float32)
+    opts = SolverOptions(max_iterations=10, fused_sweep="off",
+                         integrity=True)
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(1, 1))
+    try:
+        assert solver.reaudit_ray_stats() == []
+        faults.inject(faults.SITE_DEVICE_BUFFER, "corrupt", count=1)
+        solver._maybe_corrupt_resident()
+        issues = solver.reaudit_ray_stats()
+        assert issues and "ray_density" in "; ".join(issues)
+    finally:
+        solver.close()
+
+
+def test_sparse_cache_population_verifies_against_second_read(monkeypatch):
+    """The one-pass sparse ingest cache serves later stripe reads from
+    memory, so the stripe-level double-read compare would digest the same
+    buffer twice — the segment must instead be verified at
+    cache-population time against a genuine second disk read. A loader
+    whose two reads disagree raises StripeDigestError BEFORE the cache
+    insert (so the ingest retry re-reads fresh), and the mismatch counter
+    increments."""
+    from sartsolver_tpu.io import raytransfer as rt
+    from sartsolver_tpu.obs import metrics as obs_metrics
+
+    pix = np.arange(6, dtype=np.int64)
+    vox = np.arange(6, dtype=np.int64) % 4
+    val = np.linspace(0.1, 0.6, 6).astype(np.float32)
+    calls = {"n": 0}
+
+    def flaky_loader(group, filename, sp, sv, nvoxel, dtype):
+        calls["n"] += 1
+        if calls["n"] == 2:  # the verification read of the first attempt
+            bad = val.copy()
+            bad[0] *= 256.0
+            return pix.copy(), vox.copy(), bad
+        return pix.copy(), vox.copy(), val.copy()
+
+    monkeypatch.setattr(rt, "_load_sparse_segment", flaky_loader)
+    integrity.configure(True)
+    cache: dict = {}
+    ctr = obs_metrics.get_registry().counter("stripe_digest_mismatch_total")
+    before = ctr.value
+    with pytest.raises(integrity.StripeDigestError, match="sparse"):
+        rt._sparse_segment_window(None, "seg.h5", 0, 0, 4, np.float32,
+                                  cache, None, None)
+    assert ctr.value == before + 1
+    assert not any(k != rt._CACHE_BYTES_KEY for k in cache)  # no insert
+    # the retry's fresh attempt (reads 3+4 agree) populates and verifies
+    (p, v, a), cached = rt._sparse_segment_window(
+        None, "seg.h5", 0, 0, 4, np.float32, cache, None, None
+    )
+    np.testing.assert_array_equal(a, val)
+    assert calls["n"] == 4
+    # later stripe reads serve from the now-verified cache, no disk read
+    (_, _, a2), cached2 = rt._sparse_segment_window(
+        None, "seg.h5", 0, 0, 4, np.float32, cache, None, None
+    )
+    assert cached2 and calls["n"] == 4
+    np.testing.assert_array_equal(a2, val)
+
+
+def test_genuine_divergence_classifies_diverged_not_sdc():
+    """Integrity AND the divergence guard armed, a genuinely diverging
+    solve (explicit-Euler-unstable Laplacian weight): the non-finite
+    checksum trips the ABFT compare vacuously, but that signature belongs
+    to the guard — the frame must end DIVERGED via the rollback ladder,
+    bit-identical to the guard-only run, never SDC_DETECTED (which would
+    recompute deterministically and quarantine a healthy session)."""
+    from sartsolver_tpu.config import DIVERGED
+    from sartsolver_tpu.models.sart import solve
+    from sartsolver_tpu.ops.laplacian import make_laplacian
+
+    rng = np.random.default_rng(3)
+    H = rng.uniform(0.1, 1.0, (16, 12)).astype(np.float32)
+    g = H @ rng.uniform(0.5, 2.0, 12)
+    V = H.shape[1]
+    rows, cols, vals = [], [], []
+    for i in range(V):
+        rows.append(i); cols.append(i); vals.append(2.0)
+        if i > 0:
+            rows.append(i); cols.append(i - 1); vals.append(-1.0)
+        if i < V - 1:
+            rows.append(i); cols.append(i + 1); vals.append(-1.0)
+    lap = make_laplacian(np.asarray(rows), np.asarray(cols),
+                         np.asarray(vals, np.float32), dtype="float32")
+    kw = dict(max_iterations=500, conv_tolerance=1e-6, beta_laplace=0.8,
+              divergence_recovery=6, divergence_threshold=1e3)
+    o_guard = SolverOptions(**kw)
+    o_both = SolverOptions(integrity=True, **kw)
+    r_guard = solve(make_problem(H, lap, opts=o_guard), g, opts=o_guard)
+    r_both = solve(make_problem(H, lap, opts=o_both), g, opts=o_both)
+
+    assert int(r_both.status) == DIVERGED
+    assert int(r_both.status) == int(r_guard.status)
+    assert int(r_both.iterations) == int(r_guard.iterations)
+    np.testing.assert_array_equal(np.asarray(r_both.solution),
+                                  np.asarray(r_guard.solution))
+
+
+def test_ingest_stats_verify_and_tamper(world):
+    """read_and_shard_rtm feeds the accumulator; the post-upload check
+    passes on a clean ingest and flags a tampered accumulator."""
+    paths, H, *_ = world
+    from sartsolver_tpu.io import hdf5files as hf
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.multihost import read_and_shard_rtm
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    matrix_files, _ = hf.categorize_input_files(
+        [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+         paths["img_a"], paths["img_b"]]
+    )
+    sorted_matrix_files = hf.sort_rtm_files(matrix_files)
+    npixel, nvoxel = hf.get_total_rtm_size(sorted_matrix_files)
+    stats = integrity.IngestStats(npixel, nvoxel)
+    mesh = make_mesh(1, 1)
+    rtm = read_and_shard_rtm(
+        sorted_matrix_files, "with_reflections", npixel, nvoxel, mesh,
+        dtype="float32", ingest_stats=stats,
+    )
+    opts = SolverOptions(max_iterations=5, fused_sweep="off",
+                         integrity=True)
+    solver = DistributedSARTSolver(rtm, opts=opts, mesh=mesh,
+                                   npixel=npixel, nvoxel=nvoxel)
+    try:
+        assert solver.verify_ray_stats(stats) == []
+        stats.colsum[0] += 1.0  # a flipped staging byte would look so
+        issues = solver.verify_ray_stats(stats)
+        assert issues and "ray_density" in issues[0]
+    finally:
+        solver.close()
+
+
+# ---------------------------------------------------------------------------
+# CLI drill matrix (the `corrupt` fault kind end-to-end)
+# ---------------------------------------------------------------------------
+
+def test_cli_clean_integrity_run_identical(world):
+    """Integrity on over a clean run: exit 0, zero detections, output
+    bit-identical to the integrity-off run (the layer is an observer)."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    clean = _read_out(paths)
+    metrics = paths["output"] + ".jsonl"
+    assert run_cli(paths, "--integrity", "--metrics_out", metrics) == 0
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    np.testing.assert_array_equal(got[1], clean[1])
+    counters = {
+        r["name"]: r["value"]
+        for r in (json.loads(line) for line in open(metrics))
+        if r.get("type") == "metric" and r.get("kind") == "counter"
+    }
+    # the three integrity counters are registered AND zero on clean runs
+    assert counters.get("sdc_detected_total") == 0
+    assert counters.get("integrity_recomputes_total") == 0
+    assert counters.get("stripe_digest_mismatch_total") == 0
+
+
+def test_cli_ingest_corrupt_detected_and_rereads(world, monkeypatch):
+    """Drill leg 1 — ingest: a corrupted stripe read is caught by the
+    digest re-read and retried clean; output identical to a clean run,
+    exit 0. Without --integrity the same fault silently poisons the
+    solutions — proving the detection is the integrity layer's."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    clean = _read_out(paths)
+
+    metrics = paths["output"] + ".jsonl"
+    monkeypatch.setenv("SART_FAULT", "hdf5.rtm_ingest:corrupt:1:1")
+    faults.reset()
+    assert run_cli(paths, "--integrity", "--metrics_out", metrics) == 0
+    got = _read_out(paths)
+    np.testing.assert_array_equal(got[0], clean[0])
+    mismatches = [
+        r["value"] for r in (json.loads(line) for line in open(metrics))
+        if r.get("type") == "metric"
+        and r.get("name") == "stripe_digest_mismatch_total"
+    ]
+    assert mismatches and mismatches[0] >= 1
+
+    faults.reset()  # re-arm: fresh trip budget for the integrity-off leg
+    rc = main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"], "-m", "100",
+    ])
+    assert rc == 0
+    silent = _read_out(paths)
+    assert not np.array_equal(silent[0], clean[0])
+
+
+def test_cli_device_buffer_corrupt_quarantines(world, monkeypatch, capsys):
+    """Drill leg 2 — resident buffer: a corrupted device-resident RTM
+    trips the in-solve ABFT check; the recompute reproduces it, frames
+    FAIL, and the default threshold quarantines the run with the
+    infrastructure exit and a quarantine event."""
+    paths, *_ = world
+    metrics = paths["output"] + ".jsonl"
+    monkeypatch.setenv("SART_FAULT", "device.buffer:corrupt:1:1")
+    faults.reset()
+    rc = run_cli(paths, "--integrity", "--metrics_out", metrics)
+    assert rc == EXIT_INFRASTRUCTURE
+    assert "Quarantined" in capsys.readouterr().err
+    recs = [json.loads(line) for line in open(metrics)]
+    events = [r["message"] for r in recs if r.get("type") == "event"]
+    assert any("quarantine" in e for e in events)
+    detected = [r["value"] for r in recs
+                if r.get("name") == "sdc_detected_total"]
+    recomputes = [r["value"] for r in recs
+                  if r.get("name") == "integrity_recomputes_total"]
+    assert detected and detected[0] >= 1
+    assert recomputes and recomputes[0] >= 1
+
+
+def test_cli_device_buffer_corrupt_isolated_at_high_threshold(
+    world, monkeypatch
+):
+    """Same resident corruption with the abort threshold raised: every
+    frame fails through per-frame isolation (FAILED rows), the run
+    completes with the partial exit — the documented middle rung."""
+    paths, *_ = world
+    monkeypatch.setenv("SART_FAULT", "device.buffer:corrupt:1:1")
+    monkeypatch.setenv("SART_SDC_ABORT_THRESHOLD", "99")
+    faults.reset()
+    rc = run_cli(paths, "--integrity")
+    assert rc == EXIT_PARTIAL
+    _, status, _ = _read_out(paths)
+    assert (status == FRAME_FAILED).all()
+
+
+def test_cli_sched_lane_corrupt_quarantines(world, monkeypatch, capsys):
+    """Drill leg 3 — scheduler lanes: the continuous-batching path
+    escalates SDC lanes (requeue-once, then FAILED) and the threshold
+    quarantines, same contract as the grouped loops."""
+    paths, *_ = world
+    monkeypatch.setenv("SART_FAULT", "device.buffer:corrupt:1:1")
+    faults.reset()
+    rc = run_cli(paths, "--integrity", "--no_guess",
+                 "--batch_frames", "2")
+    assert rc == EXIT_INFRASTRUCTURE
+    assert "Quarantined" in capsys.readouterr().err
+
+
+def test_cli_integrity_off_leaves_programs_untouched(world):
+    """The acceptance identity: with the layer off (default) nothing in
+    the pipeline changes — rerunning the classic matrix produces the
+    same bytes whether the build carries the integrity code or not is
+    pinned by goldens; here: off-run output equals pre-layer output
+    semantics (status/iterations identical across two off runs)."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    first = _read_out(paths)
+    assert run_cli(paths) == 0
+    second = _read_out(paths)
+    np.testing.assert_array_equal(first[0], second[0])
+    np.testing.assert_array_equal(first[2], second[2])
+
+
+# ---------------------------------------------------------------------------
+# satellites: solution checksums, nonfinite counter
+# ---------------------------------------------------------------------------
+
+def test_solution_checksum_roundtrip_and_corruption(tmp_path):
+    from sartsolver_tpu.io.solution import (
+        SolutionWriter, read_resume_state, row_checksum,
+    )
+
+    path = str(tmp_path / "sol.h5")
+    rows = [np.arange(8, dtype=np.float64) + i for i in range(3)]
+    with SolutionWriter(path, ["camA"], 8, max_cache_size=2) as w:
+        for i, row in enumerate(rows):
+            w.add(row, 0, 0.1 * (i + 1), [0.1 * (i + 1)], iterations=5)
+    state = read_resume_state(path, ["camA"], 8)
+    assert state is not None and len(state.times) == 3
+    np.testing.assert_array_equal(state.last_solution, rows[-1])
+    with h5py.File(path, "r") as f:
+        stored = f["solution/checksum"][:]
+    assert all(
+        np.uint32(stored[i]) == row_checksum(rows[i]) for i in range(3)
+    )
+    # corrupt one row's bytes behind the checksum's back
+    with h5py.File(path, "r+") as f:
+        f["solution/value"][1, 3] += 1e-9
+    with pytest.raises(SartInputError, match="checksum"):
+        read_resume_state(path, ["camA"], 8)
+
+
+def test_solution_checksum_legacy_file_resumes(tmp_path):
+    """Files from before the checksum dataset keep resuming (and keep
+    appending without one)."""
+    from sartsolver_tpu.io.solution import SolutionWriter, read_resume_state
+
+    path = str(tmp_path / "legacy.h5")
+    with SolutionWriter(path, ["camA"], 4) as w:
+        w.add(np.ones(4), 0, 0.1, [0.1])
+    with h5py.File(path, "r+") as f:
+        del f["solution/checksum"]
+    state = read_resume_state(path, ["camA"], 4)
+    assert state is not None and len(state.times) == 1
+    with SolutionWriter(path, ["camA"], 4, resume=state) as w:
+        w.add(2 * np.ones(4), 0, 0.2, [0.2])
+    state = read_resume_state(path, ["camA"], 4)
+    assert len(state.times) == 2
+
+
+def test_cli_resume_refuses_corrupt_row(world, capsys):
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    with h5py.File(paths["output"], "r+") as f:
+        f["solution/value"][0, 0] += 1.0
+    rc = run_cli(paths, "--resume")
+    assert rc == 1
+    assert "checksum" in capsys.readouterr().err
+
+
+def test_prepare_measurement_counts_nonfinite_pixels():
+    from sartsolver_tpu.obs import metrics as obs_metrics
+
+    registry = obs_metrics.reset_registry()
+    opts = SolverOptions()
+    g = np.ones(16)
+    g[3] = np.nan
+    g[7] = np.inf
+    with pytest.warns(RuntimeWarning, match="non-finite"):
+        g64, msq, norm = prepare_measurement(g, opts)
+    assert registry.counter("nonfinite_pixels_total").value == 2
+    # the poisoned pixels must not poison the normalization factor (the
+    # finite pixels define the scale; NaN additionally stays out of
+    # ||g||^2, while inf flows into msq for the solver's input guard)
+    assert np.isfinite(norm)
+    # clean frames touch neither counter nor warning machinery
+    prepare_measurement(np.ones(16), opts)
+    assert registry.counter("nonfinite_pixels_total").value == 2
