@@ -258,20 +258,72 @@ def probe_backend(retries: int = 3, timeout_s: float = 240.0):
     return None
 
 
+_roofline_mod = None
+
+
+def _obs_roofline():
+    """The roofline accounting module (sartsolver_tpu/obs/roofline.py),
+    loaded BY FILE PATH for the same reason as the schema: this parent
+    process must never import jax, and the package ``__init__`` pulls it
+    in. The module is stdlib-only by contract. One definition of the
+    per-platform peak table serves the parent's bandwidth detection AND
+    the worker's utilization accounting. A failed load falls back to the
+    smallest-TPU figures for every accelerator — LOUDLY (stderr +
+    ``source: fallback`` in the artifact), because those numbers are
+    wrong for v4/v5p/v6 parts and any derived fraction is then only a
+    cross-run-comparable proxy."""
+    global _roofline_mod
+    if _roofline_mod is None:
+        import importlib.util
+
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "sartsolver_tpu", "obs", "roofline.py",
+        )
+        try:
+            spec = importlib.util.spec_from_file_location(
+                "_sart_obs_roofline", path
+            )
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+        except Exception as err:
+            print(f"bench: failed to load {path} ({err}); roofline "
+                  "peaks fall back to v5e-class figures — set "
+                  "SART_PEAK_MXU_TFLOPS/SART_PEAK_HBM_GBS to correct "
+                  "them", file=sys.stderr)
+
+            class _Fallback:
+                @staticmethod
+                def device_peaks(platform, device_kind="", ndev=1):
+                    # the env overrides the message above advertises
+                    # must work here too — they are the only correction
+                    # path left once the table failed to load
+                    tflops = 0.5 if platform == "cpu" else 197.0
+                    gbs = 50.0 if platform == "cpu" else 819.0
+                    source = "fallback"
+                    env_t = os.environ.get("SART_PEAK_MXU_TFLOPS")
+                    env_g = os.environ.get("SART_PEAK_HBM_GBS")
+                    if env_t:
+                        tflops, source = float(env_t), "env"
+                    if env_g:
+                        gbs, source = float(env_g), "env"
+                    return {"per_device_hbm_gbs": gbs,
+                            "per_device_tflops": tflops,
+                            "mxu_flops_s": tflops * 1e12 * ndev,
+                            "hbm_bytes_s": gbs * 1e9 * ndev,
+                            "ndev": ndev, "source": source,
+                            "device_kind": device_kind}
+
+            mod = _Fallback
+        _roofline_mod = mod
+    return _roofline_mod
+
+
 def _detect_hbm_bw_gbs(platform: str, device_kind: str) -> float:
-    """Best-effort HBM bandwidth of one local device, GB/s."""
-    kind = device_kind.lower()
-    if "v5 lite" in kind or "v5e" in kind or "v5lite" in kind:
-        return 819.0
-    if "v4" in kind:
-        return 1228.0
-    if "v5p" in kind:
-        return 2765.0
-    if "v6" in kind or "trillium" in kind:
-        return 1640.0
-    if platform == "cpu":
-        return 50.0  # rough host-memory number; CPU runs are smoke tests
-    return 819.0
+    """Best-effort HBM bandwidth of one local device, GB/s — read off
+    the shared roofline peak table (obs/roofline.py)."""
+    peaks = _obs_roofline().device_peaks(platform, device_kind)
+    return float(peaks["per_device_hbm_gbs"])
 
 
 def _emit(value: float, unit: str, vs_baseline: float, detail: dict) -> int:
@@ -318,6 +370,7 @@ def _worker_main() -> int:
         SARTProblem, _resolve_fused, compute_ray_stats,
         solve_normalized_batch,
     )
+    from sartsolver_tpu.obs import roofline as obs_roofline
     from sartsolver_tpu.ops.laplacian import make_laplacian
 
     P = spec["P"]
@@ -420,6 +473,21 @@ def _worker_main() -> int:
         itemsize = jnp.dtype(rtm_dtype).itemsize
         reads = 1 if fused_sel is not None else 2
         achieved_bytes_s = loop_iter_s * reads * P * V * itemsize
+        # roofline accounting (obs/roofline.py, docs/OBSERVABILITY.md
+        # §8): the solver's static per-iteration cost model x the
+        # measured rate -> achieved-vs-peak MXU and HBM-bandwidth
+        # fractions. These are what `sartsolve metrics --diff
+        # --threshold` gates (a utilization drop is a regression even
+        # when a faster chip hides it in the raw rate); hbm_frac stays
+        # for artifact continuity with BENCH_r01-r05.
+        d0 = jax.devices()[0]
+        flops_it, bytes_it = obs_roofline.sweep_cost_model(
+            P, V, B, itemsize, reads
+        )
+        roof = obs_roofline.utilization(
+            flops_it, bytes_it, loop_iter_s,
+            obs_roofline.device_peaks(d0.platform, d0.device_kind, 1),
+        )
         return {
             "fused": fused_sel or "off",
             "rtm_dtype": rtm_dtype,
@@ -427,6 +495,9 @@ def _worker_main() -> int:
             "loop_iter_s": round(loop_iter_s, 2),
             "frame_iter_s": round(loop_iter_s * B, 2),
             "hbm_frac": round(achieved_bytes_s / spec["our_bw"], 3),
+            # mxu_util/hbm_util/bound live inside this one block —
+            # summarize/--diff read detail.roofline, no duplicates
+            "roofline": roof,
         }
 
     converge_state: dict = {}
@@ -1200,6 +1271,12 @@ def main() -> int:
         "sweep": sweep,
         "time_to_converge": converge,
     }
+    if isinstance(head.get("roofline"), dict):
+        # the headline config's achieved-vs-peak MXU / HBM utilization
+        # (obs/roofline.py): `sartsolve metrics --diff --threshold`
+        # gates these run-over-run — BENCH_r06 onward tracks the
+        # utilization trajectory, not just the raw rate
+        detail["roofline"] = head["roofline"]
     chains = {dt: results[f"chain:warm_loop:{dt}"]
               for dt in ("bfloat16", "int8")
               if f"chain:warm_loop:{dt}" in results}

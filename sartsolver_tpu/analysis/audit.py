@@ -23,6 +23,19 @@ solve), compiles it, and checks:
   vanished while loop, an extra transpose — shows up in review as a
   golden diff instead of a benchmark regression three PRs later.
   ``--update-goldens`` (or ``update_goldens=True``) rewrites them.
+- **cost/memory golden**: XLA's own cost model of the compiled program —
+  ``compiled.cost_analysis()`` (FLOPs, bytes accessed) and
+  ``memory_analysis()`` (argument/output/temp bytes, their sum as the
+  peak device-memory figure) — recorded as a versioned obs ``cost``
+  record (``analysis/goldens/<entry>.<backend>.cost.json``) and compared
+  against the golden within the entry's ``cost_rtol`` tolerance band: a
+  silent 2x FLOP or bytes growth fails the audit like an op-histogram
+  drift, while sub-band jitter passes. ``--update-goldens`` rewrites
+  these too; ``--update-cost-goldens`` rewrites ONLY the cost goldens —
+  the histogram goldens stay byte-untouched and are still *verified*
+  first (a cost-only rebaseline must not paper over a structural
+  drift). The same records are what ``obs/roofline.py`` anchors
+  utilization accounting to.
 
 The audit pins ``jax_enable_x64=False`` while lowering — the production
 fp32 device profile — and restores the caller's setting after, so running
@@ -44,6 +57,7 @@ from sartsolver_tpu.analysis.registry import (
     AuditEntry,
     load_registered_entries,
 )
+from sartsolver_tpu.obs import schema as obs_schema
 
 GOLDENS_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "goldens")
 
@@ -58,6 +72,10 @@ class EntryReport:
     status: str  # ok | violation | golden-missing | golden-mismatch | updated | skipped | error
     violations: List[str] = dataclasses.field(default_factory=list)
     detail: str = ""
+    # the entry's measured cost record (obs schema type "cost") — exposed
+    # so `sartsolve lint --json` carries the attribution alongside the
+    # audit verdict; None for skipped/error entries
+    cost: Optional[dict] = None
 
     @property
     def failed(self) -> bool:
@@ -173,14 +191,87 @@ def signature(compiled_text: str) -> Dict[str, Dict[str, int]]:
     }
 
 
+# The numeric fields of a cost record that the tolerance band gates.
+COST_KEYS = ("flops", "bytes_accessed", "argument_bytes", "output_bytes",
+             "temp_bytes", "peak_bytes")
+
+
+def cost_signature(compiled, entry_name: str, backend: str) -> dict:
+    """Static cost attribution of one ``jax.stages.Compiled`` program as
+    a versioned obs ``cost`` record.
+
+    Extraction (tolerant across jaxlib versions and backends; every
+    field nullable) is :func:`obs.roofline.compiled_cost_numbers` — ONE
+    definition shared with ``bench.py``'s roofline accounting — so a
+    missing cost-analysis half never fails the audit by itself (the
+    golden comparison flags null-vs-number drifts explicitly)."""
+    from sartsolver_tpu.obs.roofline import compiled_cost_numbers
+
+    return obs_schema.make_cost_record(
+        entry_name, backend, **compiled_cost_numbers(compiled)
+    )
+
+
+def diff_cost(golden: dict, measured: dict, rtol: float) -> List[str]:
+    """Cost-golden drifts outside the tolerance band, as messages.
+
+    Gated in BOTH directions (an unexplained halving of FLOPs usually
+    means work was traced away). A null on exactly one side is a drift
+    too: the cost model gained or lost a capability, which is a
+    re-baseline, not a silent pass."""
+    out: List[str] = []
+    for key in COST_KEYS:
+        want = golden.get(key)
+        got = measured.get(key)
+        if want is None and got is None:
+            continue
+        if want is None or got is None:
+            out.append(
+                f"{key}: golden {want} vs measured {got} (null on one "
+                "side — re-baseline with --update-cost-goldens)"
+            )
+            continue
+        denom = max(abs(float(want)), 1.0)
+        drift = (float(got) - float(want)) / denom
+        if abs(drift) > rtol:
+            out.append(
+                f"{key}: golden {want:g} vs measured {got:g} "
+                f"({drift:+.0%} exceeds the ±{rtol:.0%} band)"
+            )
+    return out
+
+
 def _golden_path(entry_name: str, backend: str, goldens_dir: str) -> str:
     return os.path.join(goldens_dir, f"{entry_name}.{backend}.json")
+
+
+def _cost_golden_path(entry_name: str, backend: str,
+                      goldens_dir: str) -> str:
+    return os.path.join(goldens_dir, f"{entry_name}.{backend}.cost.json")
+
+
+def load_cost_golden(entry_name: str, backend: str,
+                     goldens_dir: str = GOLDENS_DIR) -> Optional[dict]:
+    """The committed cost record for one entry, or None when absent —
+    the anchor ``obs/roofline.py`` and tooling read attribution from."""
+    path = _cost_golden_path(entry_name, backend, goldens_dir)
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def _write_json(path: str, payload: dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
 
 
 def run_entry(
     entry: AuditEntry,
     *,
     update_goldens: bool = False,
+    update_cost_goldens: bool = False,
     goldens_dir: str = GOLDENS_DIR,
     skip_goldens: bool = False,
 ) -> EntryReport:
@@ -197,36 +288,47 @@ def run_entry(
         with _x64_disabled():
             lowered = entry.build()
             lowered_text = lowered.as_text()
-            compiled_text = lowered.compile().as_text()
+            compiled = lowered.compile()
+            compiled_text = compiled.as_text()
     except Exception as err:  # an unloweraable entry IS the finding
         return EntryReport(
             entry.name, "error",
             detail=f"build/lower/compile failed: {type(err).__name__}: {err}",
         )
 
+    backend = jax.default_backend()
+    cost = cost_signature(compiled, entry.name, backend)
+
     violations = check_invariants(
         compiled_text, entry, lowered_text=lowered_text
     )
     if violations:
-        return EntryReport(entry.name, "violation", violations)
+        return EntryReport(entry.name, "violation", violations, cost=cost)
 
     if skip_goldens:
-        return EntryReport(entry.name, "ok", detail="goldens skipped")
+        return EntryReport(entry.name, "ok", detail="goldens skipped",
+                           cost=cost)
 
-    backend = jax.default_backend()
     sig = signature(compiled_text)
     path = _golden_path(entry.name, backend, goldens_dir)
+    cost_path = _cost_golden_path(entry.name, backend, goldens_dir)
     if update_goldens:
         os.makedirs(goldens_dir, exist_ok=True)
-        with open(path, "w", encoding="utf-8") as fh:
-            json.dump(sig, fh, indent=1, sort_keys=True)
-            fh.write("\n")
-        return EntryReport(entry.name, "updated", detail=path)
+        _write_json(path, sig)
+        _write_json(cost_path, cost)
+        return EntryReport(entry.name, "updated",
+                           detail=f"{path}, {cost_path}", cost=cost)
+    # --update-cost-goldens falls through to the op-histogram comparison
+    # first: re-baselining the cost model must leave the structural
+    # signatures byte-untouched AND must not paper over a drift in them
+    # (a kernel change that shifts both would otherwise report a green
+    # "updated" and hide the histogram drift until the next full audit).
     if not os.path.exists(path):
         return EntryReport(
             entry.name, "golden-missing",
             detail=f"{path} (run `sartsolve lint --self --update-goldens` "
                    "on this backend and commit the result)",
+            cost=cost,
         )
     with open(path, "r", encoding="utf-8") as fh:
         golden = json.load(fh)
@@ -239,14 +341,38 @@ def run_entry(
             entry.name, "golden-mismatch", diffs,
             detail=f"signature drifted from {path} (re-run with "
                    "--update-goldens if the change is intended)",
+            cost=cost,
         )
-    return EntryReport(entry.name, "ok")
+    if update_cost_goldens:
+        os.makedirs(goldens_dir, exist_ok=True)
+        _write_json(cost_path, cost)
+        return EntryReport(entry.name, "updated", detail=cost_path,
+                           cost=cost)
+    golden_cost = load_cost_golden(entry.name, backend, goldens_dir)
+    if golden_cost is None:
+        return EntryReport(
+            entry.name, "golden-missing",
+            detail=f"{cost_path} (run `sartsolve lint --audit-only "
+                   "--update-cost-goldens` on this backend and commit "
+                   "the result)",
+            cost=cost,
+        )
+    cost_diffs = diff_cost(golden_cost, cost, entry.cost_rtol)
+    if cost_diffs:
+        return EntryReport(
+            entry.name, "golden-mismatch", cost_diffs,
+            detail=f"cost drifted from {cost_path} (re-run with "
+                   "--update-cost-goldens if the change is intended)",
+            cost=cost,
+        )
+    return EntryReport(entry.name, "ok", cost=cost)
 
 
 def run_compile_audit(
     *,
     entries: Optional[Sequence[str]] = None,
     update_goldens: bool = False,
+    update_cost_goldens: bool = False,
     goldens_dir: str = GOLDENS_DIR,
     skip_goldens: bool = False,
 ) -> List[EntryReport]:
@@ -264,12 +390,14 @@ def run_compile_audit(
             continue
         reports.append(run_entry(
             registry[name], update_goldens=update_goldens,
+            update_cost_goldens=update_cost_goldens,
             goldens_dir=goldens_dir, skip_goldens=skip_goldens,
         ))
     return reports
 
 
 __all__ = [
-    "AUDIT_REGISTRY", "EntryReport", "GOLDENS_DIR", "check_invariants",
+    "AUDIT_REGISTRY", "COST_KEYS", "EntryReport", "GOLDENS_DIR",
+    "check_invariants", "cost_signature", "diff_cost", "load_cost_golden",
     "run_compile_audit", "run_entry", "signature",
 ]

@@ -70,9 +70,15 @@ def build_lint_parser() -> argparse.ArgumentParser:
     p.add_argument("--audit-only", action="store_true",
                    help="Run only the compile audit (no AST lint).")
     p.add_argument("--update-goldens", action="store_true",
-                   help="Rewrite the golden op-histogram signatures for the "
-                        "current backend (analysis/goldens/) instead of "
-                        "verifying them; commit the result.")
+                   help="Rewrite the golden op-histogram signatures AND "
+                        "cost/memory goldens for the current backend "
+                        "(analysis/goldens/) instead of verifying them; "
+                        "commit the result.")
+    p.add_argument("--update-cost-goldens", action="store_true",
+                   help="Rewrite only the cost/memory goldens "
+                        "(analysis/goldens/*.cost.json) — the op-histogram "
+                        "signatures stay byte-untouched but are still "
+                        "verified first; commit the result.")
     p.add_argument("--entries", default=None,
                    help="Comma-separated audit entry names (default: all "
                         "registered).")
@@ -113,7 +119,8 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
             print(f"       fix: {rule.hint}")
         return 0
 
-    if not (args.paths or args.self_ or args.audit_only):
+    if not (args.paths or args.self_ or args.audit_only
+            or args.update_goldens or args.update_cost_goldens):
         print("sartsolve lint: pass paths to lint, or --self for the "
               "installed package (see --help).", file=sys.stderr)
         return 1
@@ -132,8 +139,8 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
 
     # ---- compile audit ---------------------------------------------------
     reports = []
-    run_audit = (args.self_ or args.audit_only or args.update_goldens) \
-        and not args.no_audit
+    run_audit = (args.self_ or args.audit_only or args.update_goldens
+                 or args.update_cost_goldens) and not args.no_audit
     if run_audit:
         _force_cpu_device_count()
         from sartsolver_tpu.analysis.audit import run_compile_audit
@@ -141,6 +148,7 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
         entries = args.entries.split(",") if args.entries else None
         reports = run_compile_audit(
             entries=entries, update_goldens=args.update_goldens,
+            update_cost_goldens=args.update_cost_goldens,
         )
 
     n_err = sum(1 for f in findings if f.severity == "error")

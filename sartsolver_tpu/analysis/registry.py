@@ -54,6 +54,14 @@ class AuditEntry:
       vacuously pass).
     - ``min_devices``: number of visible devices the builder needs (sharded
       entries); the auditor reports the entry as skipped when fewer exist.
+    - ``cost_rtol``: tolerance band for the cost/memory golden
+      (``analysis/goldens/<entry>.<backend>.cost.json``): any recorded
+      FLOP/bytes figure drifting more than this relative fraction from
+      its golden fails the audit — a silent 2x FLOP or bytes-accessed
+      growth now trips like an op-histogram drift, while sub-band jitter
+      (fusion reshuffles, minor layout changes) passes. Checked in BOTH
+      directions: an unexplained 2x drop usually means work was traced
+      away, which is just as worth a review.
     """
 
     name: str
@@ -68,6 +76,7 @@ class AuditEntry:
     min_donated_args: int = 0
     requires_while_loop: bool = True
     min_devices: int = 1
+    cost_rtol: float = 0.5
 
 
 AUDIT_REGISTRY: Dict[str, AuditEntry] = {}

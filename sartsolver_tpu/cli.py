@@ -33,7 +33,12 @@ def build_parser() -> argparse.ArgumentParser:
                "--help` and docs/STATIC_ANALYSIS.md); `sartsolve metrics` "
                "— validate, summarize and diff --metrics_out artifacts "
                "(see `sartsolve metrics --help` and "
-               "docs/OBSERVABILITY.md). "
+               "docs/OBSERVABILITY.md); `sartsolve top FILE` — refreshing "
+               "one-screen view of a live run from its heartbeat / "
+               "Prometheus textfile / status snapshot. A running solve "
+               "answers SIGUSR1 with a status snapshot on stderr and "
+               "<output>.status.json, and flushes a flight bundle "
+               "(<output>.crash.json) on abnormal exits. "
                "exit codes: 0 success; 1 input/flag error; 2 run completed "
                "with FAILED/DIVERGED frames; 3 aborted on an unrecoverable "
                "infrastructure failure after retries or a watchdog hard "
@@ -150,7 +155,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "fused sweep — available on pixel- and voxel-"
                           "sharded meshes alike).")
     tpu.add_argument("--profile_dir", default=None,
-                     help="Write a jax.profiler trace of the frame loop here.")
+                     help="Write a jax.profiler trace of the frame loop "
+                          "here. Each frame (serial path) / scheduler "
+                          "stride (batched path) is wrapped in a "
+                          "StepTraceAnnotation, so the XLA device trace "
+                          "aligns with obs spans and frame serials instead "
+                          "of one undifferentiated blob.")
     tpu.add_argument("--fused_sweep", default="auto",
                      choices=["auto", "on", "off", "interpret"],
                      help="Fused iteration sweep: one HBM read of the RTM "
@@ -319,6 +329,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.obs.cli import metrics_main
 
         return metrics_main(argv[1:])
+    if argv and argv[0] == "top":
+        # live-run viewer (docs/OBSERVABILITY.md §9): a refreshing
+        # one-screen render of the heartbeat / Prometheus textfile /
+        # SIGUSR1 status snapshot a running solve publishes
+        from sartsolver_tpu.obs.cli import top_main
+
+        return top_main(argv[1:])
     try:
         args = build_parser().parse_args(argv)
     except SystemExit as err:
@@ -412,11 +429,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the end-of-run accounting.
     summary = RunSummary()
 
+    # Live introspection (docs/OBSERVABILITY.md §9): the flight ring taps
+    # the beacon stream (in-memory, bounded), SIGUSR1 dumps a status
+    # snapshot to stderr + <output>.status.json, and every abnormal exit
+    # path flushes a flight bundle to <output>.crash.json — including the
+    # watchdog's stage-3 os._exit, via its crash hook (the one abort no
+    # `finally` survives). Output is byte-identical unless signaled or
+    # aborting. Primary-process-only where files are written: the bundle
+    # and status paths are shared, like the other sinks.
+    from sartsolver_tpu.obs import flight as obs_flight
+
+    obs_flight.install()
+    flight_primary = (not args.multihost) or mh.is_primary()
+    status_path = obs_flight.default_status_path(args.output_file)
+    bundle_path = obs_flight.default_bundle_path(args.output_file)
+    # the SIGUSR1 handler installs on EVERY process: SIGUSR1's default
+    # disposition is terminate, so a handler-less worker would die to a
+    # status poke (pkill -USR1 across a pod must be a read-only query,
+    # never fatal). Writes are atomic renames and the record carries the
+    # pid — whichever process was poked last owns the file's content.
+    prev_usr1 = obs_flight.install_status_handler(status_path)
+    abort = {"reason": None}
+    if flight_primary:
+        watchdog.set_crash_hook(
+            lambda reason: obs_flight.write_crash_bundle(
+                bundle_path, reason, summary
+            )
+        )
+
     def note_event(message: str) -> None:
-        # availability events land in BOTH accountings: the printed
-        # end-of-run summary and the typed telemetry records
+        # availability events land in ALL THREE accountings: the printed
+        # end-of-run summary, the typed telemetry records, and the
+        # flight ring (the crash bundle's recent-event tail)
         summary.record_event(message)
         telem.record_event(message)
+        obs_flight.record_event("event", message)
 
     # Hang watchdog (docs/RESILIENCE.md §6): armed by
     # SART_WATCHDOG_TIMEOUT and scoped to the WHOLE expensive body —
@@ -785,6 +832,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             jax.profiler.trace(args.profile_dir) if args.profile_dir
             else contextlib.nullcontext()
         )
+
+        def frame_step_span(idx: int):
+            """--profile_dir: mark one serial frame as a profiler step so
+            the XLA device trace is segmented by frame index (the
+            scheduler path marks strides instead — sched/scheduler.py).
+            A shared nullcontext when profiling is off."""
+            if not args.profile_dir:
+                return contextlib.nullcontext()
+            return jax.profiler.StepTraceAnnotation("frame", step_num=idx)
+
         from sartsolver_tpu.utils.prefetch import FramePrefetcher
 
         # Multi-host: every process runs the (collective) frame loop, only
@@ -1195,6 +1252,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                     on_result=sched_result, on_failed=record_failed,
                     stop_check=stop_now, on_event=degrade_event,
                     isolate=isolate, integrity_policy=sdc_policy,
+                    step_trace=bool(args.profile_dir),
                 )
                 # ONE shared iterator: the OOM fallback must continue the
                 # same stream the batcher was draining, not re-iterate the
@@ -1286,12 +1344,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     frame, ftime, cam_times = item
                     t0 = _time.perf_counter()
                     try:
-                        dres = solver.solve_batch(
-                            np.asarray(frame)[None, :],
-                            None if f0_host is None else f0_host[None, :],
-                            local=use_local, device_result=True,
-                            warm=warm_dev,
-                        )
+                        with frame_step_span(idx):
+                            dres = solver.solve_batch(
+                                np.asarray(frame)[None, :],
+                                None if f0_host is None
+                                else f0_host[None, :],
+                                local=use_local, device_result=True,
+                                warm=warm_dev,
+                            )
                     except RECOVERABLE_FRAME_ERRORS as err:
                         if not isolate:
                             raise
@@ -1399,8 +1459,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             # graceful preemption stop (docs/RESILIENCE.md §5): the
             # in-flight group drained, the writer flushed, the voxel map
             # is in place — the file is a consistent prefix of the run
+            sig = shutdown.stop_signal() or "a stop request"
+            # exit 4 is an abnormal exit too: the bundle records where
+            # the run was truncated, for triage before the requeue
+            abort["reason"] = f"interrupted by {sig} (exit 4)"
             if primary:
-                sig = shutdown.stop_signal() or "a stop request"
                 print(
                     f"Interrupted by {sig}: {summary.n_frames} frame(s) "
                     "written; the output file is resumable (--resume).",
@@ -1412,6 +1475,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     except RetriesExhausted as err:
         # a retried site (RTM ingest, multihost init, a non-isolated
         # frame read) failed permanently: infrastructure, not input
+        abort["reason"] = f"retries exhausted: {err}"
         print(f"Unrecoverable after retries: {err}", file=sys.stderr)
         return EXIT_INFRASTRUCTURE
     except WatchdogTimeout as err:
@@ -1419,11 +1483,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         # could not absorb (--fail_fast, multihost, or a stall outside
         # the frame scope): the process is saved, the run is not —
         # infrastructure exit, file resumable
+        abort["reason"] = f"watchdog abort: {err}"
         print(f"Aborted by the hang watchdog: {err}", file=sys.stderr)
         return EXIT_INFRASTRUCTURE
     except OutputWriteError as err:
         # a solution-file flush failed mid-run; the file is resumable up
         # to its last committed flush
+        abort["reason"] = f"output write failure: {err}"
         print(err, file=sys.stderr)
         return EXIT_INFRASTRUCTURE
     except integ_mod.PersistentCorruptionError as err:
@@ -1432,6 +1498,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # quarantine event is already in the telemetry; the file is
         # resumable up to its last committed flush — requeue on healthy
         # hardware with --resume (docs/RESILIENCE.md §8)
+        abort["reason"] = f"SDC quarantine: {err}"
         print(f"Quarantined: {err}", file=sys.stderr)
         return EXIT_INFRASTRUCTURE
     except DeferredWriteError as err:
@@ -1440,6 +1507,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # I/O error outside the flush path); an internal bug as the
         # cause still tracebacks loudly
         if isinstance(err.__cause__, RECOVERABLE_FRAME_ERRORS):
+            abort["reason"] = f"async writer failure: {err}"
             print(f"Asynchronous writer failed: {err}", file=sys.stderr)
             return EXIT_INFRASTRUCTURE
         raise
@@ -1455,7 +1523,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         # tracebacks loudly instead of being swallowed.
         print(err, file=sys.stderr)
         return 1
+    except BaseException as err:
+        # anything else is an internal bug (or a second-signal abort):
+        # it tracebacks exactly as before, but the flight bundle still
+        # lands first — an OOM-ladder exhaustion under --fail_fast or an
+        # unhandled dispatch error is triaged from the same file as the
+        # named abort paths
+        abort["reason"] = f"unhandled {type(err).__name__}: {err}"
+        raise
     finally:
+        # Crash bundle (docs/OBSERVABILITY.md §9): one JSON file with
+        # the status snapshot, the flight ring's recent-event tail and
+        # the partial-run accounting, flushed on every abnormal exit
+        # path that reaches this frame (the watchdog's stage-3 os._exit
+        # bypasses finally — its crash hook wrote the bundle already).
+        if flight_primary and abort["reason"] is not None:
+            obs_flight.write_crash_bundle(
+                bundle_path, abort["reason"], summary
+            )
+        watchdog.set_crash_hook(None)
+        obs_flight.uninstall_status_handler(prev_usr1)
+        obs_flight.uninstall()
         if wd is not None:
             wd.stop()
         shutdown.uninstall()

@@ -16,13 +16,22 @@ argument parser runs (like ``sartsolve lint``). Three modes:
 
 Exit codes: 0 ok; 1 invalid input (unreadable file, schema violations);
 2 ``--diff --threshold`` regression detected.
+
+This module also hosts ``sartsolve top`` (:func:`top_main`): a
+refreshing one-screen view over the files a live run already publishes —
+the Prometheus textfile (``SART_METRICS_PROM``), the heartbeat file
+(``SART_HEARTBEAT_FILE``) or a SIGUSR1 status snapshot — so an operator
+can watch a resident run without attaching a debugger or restarting it
+with more flags.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+import time
 from typing import Dict, List, Optional, Tuple
 
 from sartsolver_tpu.obs import schema
@@ -143,6 +152,17 @@ def summarize(records: List[dict]) -> dict:
                 "iter_s_off": integ.get("iter_s_off"),
                 "overhead_pct": integ.get("overhead_pct"),
             }
+        # roofline section (bench.py + obs/roofline.py): the headline
+        # config's achieved-vs-peak MXU and HBM-bandwidth fractions —
+        # gated rates like the headline itself (a utilization drop is a
+        # regression even when a faster chip hides it in raw iter/s)
+        roof = (bench[0].get("detail") or {}).get("roofline")
+        if isinstance(roof, dict) and "hbm_util" in roof:
+            out["roofline"] = {
+                "mxu_util": roof.get("mxu_util"),
+                "hbm_util": roof.get("hbm_util"),
+                "bound": roof.get("bound"),
+            }
     return out
 
 
@@ -185,6 +205,10 @@ def _print_summary(path: str, summary: dict) -> None:
         print(f"  integrity iter/s: on {i['iter_s_on']:g}, "
               f"off {i['iter_s_off']:g} "
               f"(overhead {i['overhead_pct']:+.1f}%)")
+    if "roofline" in summary:
+        r = summary["roofline"]
+        print(f"  roofline: mxu_util {r['mxu_util']:g}, "
+              f"hbm_util {r['hbm_util']:g} ({r['bound']}-bound)")
 
 
 def diff(old: dict, new: dict) -> dict:
@@ -251,7 +275,65 @@ def diff(old: dict, new: dict) -> dict:
         out["integrity"] = {"old": old["integrity"]["iter_s_on"],
                             "new": new["integrity"]["iter_s_on"]}
     out["integrity_value_pct"] = integ_pct
+    # roofline utilization (bench detail.roofline, obs/roofline.py):
+    # achieved-vs-peak MXU / HBM fractions are rates — a drop past the
+    # threshold is a regression, independently of the raw headline
+    for key in ("mxu_util", "hbm_util"):
+        pct = None
+        if "roofline" in old and "roofline" in new:
+            a = old["roofline"].get(key)
+            b = new["roofline"].get(key)
+            if a is not None and b is not None and a > 0:
+                pct = 100.0 * (b / a - 1.0)
+                out.setdefault("roofline", {})[key] = {"old": a, "new": b}
+        out[f"roofline_{key}_pct"] = pct
+    out["notes"] = _diff_notes(old, new)
     return out
+
+
+def _diff_notes(old: dict, new: dict) -> List[str]:
+    """Why a gate did NOT run: sections present on one side only and
+    zero-valued baselines. Printed by ``metrics_main`` so a skipped gate
+    is a loud note on stderr, never a silent pass — an artifact missing
+    its bench section must not read as "no regression"."""
+    notes: List[str] = []
+    for section in ("bench", "straggler", "integrity", "roofline"):
+        if (section in old) != (section in new):
+            side = "baseline" if section in new else "new"
+            notes.append(f"{section} section missing from the {side} "
+                         "artifact — its rate gate skipped")
+    zero_checks = [
+        ("bench", "value", "bench headline value"),
+        ("straggler", "occ_frame_iter_s", "straggler occ frame-iter/s"),
+        ("integrity", "iter_s_on", "integrity-on iter/s"),
+    ]
+    for section, key, label in zero_checks:
+        if (section in old and section in new
+                and not (old[section].get(key) or 0) > 0):
+            notes.append(f"baseline {label} is zero — its rate gate "
+                         "skipped")
+    if "roofline" in old and "roofline" in new:
+        for key in ("mxu_util", "hbm_util"):
+            a = old["roofline"].get(key)
+            if a is not None and not a > 0:
+                notes.append(f"baseline roofline {key} is zero — its "
+                             "rate gate skipped")
+    if (old.get("solve_ms") and new.get("solve_ms")
+            and not old["solve_ms"]["mean"] > 0):
+        notes.append("baseline mean solve-ms is zero — its gate skipped")
+    old_h = set(old.get("histograms") or {})
+    new_h = set(new.get("histograms") or {})
+    for key in sorted(old_h.symmetric_difference(new_h)):
+        side = "baseline" if key in new_h else "new"
+        notes.append(f"histogram {key} missing from the {side} artifact "
+                     "— not compared")
+    key = "iterations_to_converge"
+    a = (old.get("histograms") or {}).get(key)
+    b = (new.get("histograms") or {}).get(key)
+    if a and b and not a["mean"] > 0:
+        notes.append(f"baseline {key} mean is zero — its drift gate "
+                     "skipped")
+    return notes
 
 
 def metrics_main(argv: Optional[List[str]] = None) -> int:
@@ -323,6 +405,16 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['integrity']['old']:g} -> "
                       f"{delta['integrity']['new']:g} "
                       f"({delta['integrity_value_pct']:+.1f}%)")
+            for key in ("mxu_util", "hbm_util"):
+                if delta[f"roofline_{key}_pct"] is not None:
+                    d = delta["roofline"][key]
+                    print(f"  roofline {key}: {d['old']:g} -> "
+                          f"{d['new']:g} "
+                          f"({delta[f'roofline_{key}_pct']:+.1f}%)")
+        # a gate that did not run must say so — an artifact missing its
+        # bench section, a zero baseline — never silently pass
+        for note in delta.get("notes", ()):
+            print(f"sartsolve metrics: note: {note}", file=sys.stderr)
         if args.threshold is not None:
             # regression directions differ by metric: solve_ms is a cost
             # (up = worse), the bench headline is a rate (down = worse)
@@ -360,6 +452,14 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"exceeds the {args.threshold:g}% threshold.",
                       file=sys.stderr)
                 return 2
+            for key in ("mxu_util", "hbm_util"):
+                pct = delta[f"roofline_{key}_pct"]
+                if pct is not None and pct < -args.threshold:
+                    print(f"sartsolve metrics: roofline {key} "
+                          f"utilization regression {pct:+.1f}% exceeds "
+                          f"the {args.threshold:g}% threshold.",
+                          file=sys.stderr)
+                    return 2
         return 0
 
     summary = summarize(loaded[0])
@@ -368,3 +468,135 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
     else:
         _print_summary(args.artifacts[0], summary)
     return 0
+
+
+# ---------------------------------------------------------------------------
+# `sartsolve top`: refreshing one-screen view of a live run
+# ---------------------------------------------------------------------------
+
+def build_top_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sartsolve top",
+        description="Refreshing one-screen view of a live run, rendered "
+                    "from a file it already publishes: the Prometheus "
+                    "textfile (SART_METRICS_PROM), the heartbeat file "
+                    "(SART_HEARTBEAT_FILE), or a SIGUSR1 status snapshot "
+                    "(docs/OBSERVABILITY.md §9).",
+    )
+    p.add_argument("path", metavar="FILE",
+                   help="Prometheus textfile, heartbeat file, or status "
+                        "snapshot JSON to watch.")
+    p.add_argument("--interval", type=float, default=2.0, metavar="S",
+                   help="Refresh period in seconds (default 2).")
+    p.add_argument("--once", action="store_true",
+                   help="Render one frame and exit (scripting / tests).")
+    p.add_argument("--lines", type=int, default=40,
+                   help="Cap on rendered body lines (one screen).")
+    return p
+
+
+def _age_str(path: str) -> str:
+    try:
+        age = time.time() - os.stat(path).st_mtime
+        return f"{age:.1f}s ago"
+    except OSError:
+        return "?"
+
+
+def _render_heartbeat(path: str, text: str) -> List[str]:
+    fields = dict(
+        tok.split("=", 1) for tok in text.split() if "=" in tok
+    )
+    lines = [f"heartbeat {path} (updated {_age_str(path)})"]
+    for key in ("phase", "frames", "serial", "occupancy", "lanes"):
+        if key in fields:
+            lines.append(f"  {key:<10} {fields[key]}")
+    return lines
+
+
+def _render_prom(path: str, text: str) -> List[str]:
+    lines = [f"prometheus {path} (updated {_age_str(path)})"]
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if not raw or raw.startswith("#"):
+            continue
+        name, _, value = raw.rpartition(" ")
+        lines.append(f"  {name:<52} {value}")
+    return lines
+
+
+def _render_status(path: str, rec: dict) -> List[str]:
+    lines = [f"status {path} (snapshot {_age_str(path)})"]
+    lb = rec.get("last_beacon") or {}
+    lines.append(f"  frames_done {rec.get('frames_done')}   last beacon "
+                 f"{lb.get('phase')} (serial {lb.get('serial')}, "
+                 f"{lb.get('age_s')}s ago)")
+    ages = rec.get("beacon_ages") or {}
+    if ages:
+        lines.append("  beacon ages: " + "  ".join(
+            f"{ph}={age}s" for ph, age in ages.items()
+        ))
+    sched = rec.get("sched")
+    if sched:
+        lanes = sched.get("lanes")
+        lines.append(
+            f"  sched: occupancy {sched.get('occupancy')}  strides "
+            f"{sched.get('strides')}  in-flight lanes "
+            + (",".join(str(s) for s in lanes) if lanes else "-")
+        )
+    for m in rec.get("metrics") or []:
+        key = _metric_key(m)
+        if m.get("kind") == "histogram":
+            if m.get("count"):
+                lines.append(f"  {key:<44} count {m['count']:g} mean "
+                             f"{m['sum'] / m['count']:.2f}")
+        else:
+            lines.append(f"  {key:<44} {m.get('value', 0):g}")
+    return lines
+
+
+def render_top(path: str, max_lines: int = 40) -> str:
+    """One screen of ``path``, whatever kind of live file it is."""
+    with open(path) as f:
+        text = f.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        lines = _render_status(path, json.loads(stripped.splitlines()[0]))
+    elif stripped.startswith("#") or "# TYPE" in text:
+        lines = _render_prom(path, text)
+    elif "phase=" in stripped:
+        lines = _render_heartbeat(path, stripped)
+    else:
+        raise ValueError(
+            "unrecognized format (expected a Prometheus textfile, "
+            "heartbeat line, or status snapshot JSON)"
+        )
+    if len(lines) > max_lines:
+        dropped = len(lines) - max_lines
+        lines = lines[:max_lines] + [f"  ... (+{dropped} more)"]
+    return "\n".join(lines)
+
+
+def top_main(argv: Optional[List[str]] = None) -> int:
+    args = build_top_parser().parse_args(argv)
+    try:
+        while True:
+            failed = False
+            try:
+                screen = render_top(args.path, max_lines=args.lines)
+            except OSError as err:
+                screen, failed = f"{args.path}: {err}", True
+            except ValueError as err:
+                screen, failed = f"{args.path}: unparseable ({err})", True
+            if not args.once and sys.stdout.isatty():
+                # clear + home: a refreshing view, not a scrolling log
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(screen, flush=True)
+            if args.once:
+                # scripting mode: a probe that could not render must be
+                # distinguishable from a healthy screen (the live loop
+                # keeps going — the file may simply not exist *yet*)
+                return 1 if failed else 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0

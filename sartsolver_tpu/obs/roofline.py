@@ -1,0 +1,184 @@
+"""Roofline accounting: static program costs x measured rates.
+
+BENCH_r05 called the batched int8 path "no longer HBM-bound" on the
+strength of one hand-derived ratio. This module is the machinery behind
+that kind of claim: it combines a compiled program's *static* cost model
+(FLOPs and bytes accessed per iteration — XLA's own ``cost_analysis()``
+via the compile-audit cost goldens, or the solver's analytic sweep model
+as the fallback) with a *measured* iteration rate into achieved-vs-peak
+utilization fractions of the two resources a SART sweep can saturate:
+
+- **MXU** (matrix-unit FLOP/s): ``achieved_flops / peak_flops``;
+- **HBM bandwidth**: ``achieved_bytes_per_s / peak_bytes_per_s``.
+
+Their ratio against the device's ridge intensity (peak FLOP/s per peak
+byte/s) says which wall the program is actually against — the number
+that directs the next optimization (a sparse RTM only pays if the path
+is HBM-bound; more fusion only pays if it is not MXU-bound yet). Both
+"Performance Portable Back-projection Algorithms" (arxiv 2104.13248)
+and "Sparse Matrix-Based HPC Tomography" (arxiv 2003.12677) use exactly
+this accounting to rank candidate kernels.
+
+Device peaks come from a small per-platform table (dense-matmul peak
+FLOP/s and HBM bandwidth per chip) with environment overrides —
+``SART_PEAK_MXU_TFLOPS`` and ``SART_PEAK_HBM_GBS`` (per device) — for
+parts the table does not know or deliberately derated figures.
+
+IMPORTANT: stdlib-only by contract, like :mod:`~sartsolver_tpu.obs.schema`
+— ``bench.py``'s parent process may load it by file path, and nothing
+here may import jax (the one function that touches a compiled object
+only calls methods on it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+# Per-chip peaks: substring of the lowercased device kind -> (dense
+# matmul TFLOP/s, HBM GB/s). MXU figures are the bf16 systolic peaks —
+# the dtype every hot sweep computes in on TPU (fp32 operands are
+# passthrough-converted); utilization against them is deliberately
+# conservative for fp32 programs. First match wins, most specific first.
+DEVICE_PEAKS: Tuple[Tuple[Tuple[str, ...], float, float], ...] = (
+    (("v5 lite", "v5e", "v5lite"), 197.0, 819.0),
+    (("v5p",), 459.0, 2765.0),
+    (("v6", "trillium"), 918.0, 1640.0),
+    (("v4",), 275.0, 1228.0),
+)
+
+# Host fallbacks: a CPU "device" in the smoke meshes. Rough figures —
+# CPU runs are correctness smoke tests, and the utilization numbers they
+# record are only ever diffed against other CPU smoke runs.
+CPU_PEAK_TFLOPS = 0.5
+CPU_PEAK_HBM_GBS = 50.0
+
+# Unknown accelerator: assume the smallest TPU in the table rather than
+# inventing a part (utilization reads high, which invites a second look
+# — the safe failure direction for a capacity-planning number).
+DEFAULT_TFLOPS = 197.0
+DEFAULT_HBM_GBS = 819.0
+
+
+def device_peaks(platform: str, device_kind: str = "",
+                 ndev: int = 1) -> Dict[str, object]:
+    """Aggregate peak FLOP/s and HBM bytes/s for ``ndev`` devices.
+
+    ``SART_PEAK_MXU_TFLOPS`` / ``SART_PEAK_HBM_GBS`` (per device)
+    override the table — the escape hatch for parts the table does not
+    know, derated SKUs, or anchoring utilization to a measured probe
+    instead of the datasheet."""
+    kind = (device_kind or "").lower()
+    tflops, gbs, source = None, None, None
+    for needles, t, g in DEVICE_PEAKS:
+        if any(n in kind for n in needles):
+            tflops, gbs, source = t, g, f"table:{needles[0]}"
+            break
+    if tflops is None:
+        if (platform or "").lower() == "cpu":
+            tflops, gbs, source = CPU_PEAK_TFLOPS, CPU_PEAK_HBM_GBS, "cpu"
+        else:
+            tflops, gbs, source = DEFAULT_TFLOPS, DEFAULT_HBM_GBS, "default"
+    env_t = os.environ.get("SART_PEAK_MXU_TFLOPS")
+    env_g = os.environ.get("SART_PEAK_HBM_GBS")
+    if env_t:
+        tflops, source = float(env_t), "env"
+    if env_g:
+        gbs, source = float(env_g), "env"
+    ndev = max(int(ndev), 1)
+    return {
+        "mxu_flops_s": tflops * 1e12 * ndev,
+        "hbm_bytes_s": gbs * 1e9 * ndev,
+        "per_device_tflops": tflops,
+        "per_device_hbm_gbs": gbs,
+        "ndev": ndev,
+        "source": source,
+        "device_kind": device_kind or platform,
+    }
+
+
+def compiled_cost_numbers(compiled) -> Dict[str, Optional[float]]:
+    """Tolerant extraction of XLA's static cost model from a
+    ``jax.stages.Compiled`` — ``cost_analysis()`` is a per-device list
+    on some jaxlib versions, a flat dict on others, and either API may
+    be unimplemented for a backend, so every field is nullable. The one
+    definition both the compile-audit cost goldens
+    (``analysis/audit.cost_signature``) and ``bench.py`` extract
+    through."""
+    out: Dict[str, Optional[float]] = {
+        "flops": None, "bytes_accessed": None, "argument_bytes": None,
+        "output_bytes": None, "temp_bytes": None, "peak_bytes": None,
+    }
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            out["flops"] = ca.get("flops")
+            out["bytes_accessed"] = ca.get("bytes accessed")
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            out["argument_bytes"] = float(ma.argument_size_in_bytes)
+            out["output_bytes"] = float(ma.output_size_in_bytes)
+            out["temp_bytes"] = float(ma.temp_size_in_bytes)
+            out["peak_bytes"] = (
+                out["argument_bytes"] + out["output_bytes"]
+                + out["temp_bytes"]
+                + float(getattr(ma, "alias_size_in_bytes", 0) or 0)
+            )
+    except Exception:
+        pass
+    return out
+
+
+def sweep_cost_model(npixel: int, nvoxel: int, batch: int,
+                     itemsize: int, reads: int) -> Tuple[float, float]:
+    """Analytic per-iteration cost of one SART sweep: the fallback when
+    no compiled cost model is available.
+
+    FLOPs: the forward projection (``f @ H^T``) and back-projection
+    (``w @ H``) are each ``batch x npixel x nvoxel`` MACs (2 FLOPs);
+    everything else is O(npixel + nvoxel). Bytes: the RTM streams from
+    HBM ``reads`` times per iteration (1 fused, 2 two-matmul) and
+    dominates; the per-frame vectors ride along at fp32."""
+    flops = 4.0 * batch * npixel * nvoxel
+    vec_bytes = 4.0 * batch * (npixel + nvoxel)
+    bytes_per_iter = float(reads) * npixel * nvoxel * itemsize + vec_bytes
+    return flops, bytes_per_iter
+
+
+def utilization(flops_per_iter: float, bytes_per_iter: float,
+                iter_s: float, peaks: Dict[str, object]) -> dict:
+    """Achieved-vs-peak fractions of the MXU and HBM rooflines.
+
+    ``bound`` compares the program's arithmetic intensity (FLOPs per
+    byte) against the device's ridge intensity (peak FLOP/s per peak
+    byte/s): below the ridge the roofline says HBM bandwidth is the
+    wall, above it the MXU is."""
+    peak_f = float(peaks["mxu_flops_s"])
+    peak_b = float(peaks["hbm_bytes_s"])
+    achieved_f = float(flops_per_iter) * float(iter_s)
+    achieved_b = float(bytes_per_iter) * float(iter_s)
+    ai = (float(flops_per_iter) / float(bytes_per_iter)
+          if bytes_per_iter else 0.0)
+    ridge = peak_f / peak_b if peak_b else 0.0
+    return {
+        "flops_per_iter": round(float(flops_per_iter), 1),
+        "bytes_per_iter": round(float(bytes_per_iter), 1),
+        "achieved_tflops": round(achieved_f / 1e12, 6),
+        "achieved_gbs": round(achieved_b / 1e9, 3),
+        "mxu_util": round(achieved_f / peak_f, 6) if peak_f else 0.0,
+        "hbm_util": round(achieved_b / peak_b, 6) if peak_b else 0.0,
+        "arithmetic_intensity": round(ai, 3),
+        "ridge_intensity": round(ridge, 3),
+        "bound": "hbm" if ai < ridge else "mxu",
+        "peaks": {
+            "per_device_tflops": peaks["per_device_tflops"],
+            "per_device_hbm_gbs": peaks["per_device_hbm_gbs"],
+            "ndev": peaks["ndev"],
+            "source": peaks["source"],
+        },
+    }

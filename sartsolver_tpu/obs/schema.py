@@ -10,7 +10,12 @@ One schema covers every machine-facing JSON this project emits:
   the historical ``{metric, value, unit, vs_baseline, detail}`` shape
   plus the shared ``type``/``schema`` envelope, so BENCH artifacts and
   metrics artifacts validate with the same code and future regression
-  tooling (``sartsolve metrics --diff``) consumes both.
+  tooling (``sartsolve metrics --diff``) consumes both;
+- compile-audit ``cost`` goldens (``analysis/goldens/*.cost.json``):
+  static FLOP/bytes attribution of one compiled entry point;
+- live-introspection files (``obs/flight.py``): the SIGUSR1 ``status``
+  snapshot and the crash-bundle ``flight`` record, so
+  ``sartsolve metrics --check`` validates them too.
 
 Every record carries ``type`` (the discriminator); ``meta`` and ``bench``
 carry ``schema`` (the version of this vocabulary). Validation is
@@ -32,7 +37,8 @@ from typing import Dict, List, Optional, Tuple
 
 SCHEMA_VERSION = 1
 
-RECORD_TYPES = ("meta", "frame", "event", "metric", "summary", "bench")
+RECORD_TYPES = ("meta", "frame", "event", "metric", "summary", "bench",
+                "cost", "status", "flight")
 
 _NUMBER = (int, float)
 
@@ -127,6 +133,45 @@ def validate_record(rec: object) -> List[str]:
         _need(rec, errors, "unit", str)
         _need(rec, errors, "vs_baseline", _NUMBER)
         _need(rec, errors, "detail", dict)
+    elif rtype == "cost":
+        # static cost attribution of one compiled entry point
+        # (analysis/audit.py cost goldens; docs/OBSERVABILITY.md §8).
+        # flops/bytes nullable: a backend without cost_analysis support
+        # still records the memory_analysis half (and vice versa).
+        version = _need(rec, errors, "schema", int)
+        if version is not None and version > SCHEMA_VERSION:
+            errors.append(
+                f"schema version {version} is newer than this tool's "
+                f"{SCHEMA_VERSION}"
+            )
+        _need(rec, errors, "entry", str)
+        _need(rec, errors, "backend", str)
+        for key in ("flops", "bytes_accessed", "argument_bytes",
+                    "output_bytes", "temp_bytes", "peak_bytes"):
+            _need(rec, errors, key, _NUMBER, nullable=True)
+    elif rtype == "status":
+        # live status snapshot (obs/flight.py SIGUSR1 dump)
+        version = _need(rec, errors, "schema", int)
+        if version is not None and version > SCHEMA_VERSION:
+            errors.append(
+                f"schema version {version} is newer than this tool's "
+                f"{SCHEMA_VERSION}"
+            )
+        _need(rec, errors, "unix", _NUMBER)
+        _need(rec, errors, "frames_done", int)
+        _need(rec, errors, "beacon_ages", dict)
+        _need(rec, errors, "metrics", list)
+    elif rtype == "flight":
+        # crash bundle (obs/flight.py): status snapshot + event ring
+        version = _need(rec, errors, "schema", int)
+        if version is not None and version > SCHEMA_VERSION:
+            errors.append(
+                f"schema version {version} is newer than this tool's "
+                f"{SCHEMA_VERSION}"
+            )
+        _need(rec, errors, "reason", str)
+        _need(rec, errors, "status", dict)
+        _need(rec, errors, "ring", list)
     return errors
 
 
@@ -247,6 +292,38 @@ def make_summary_record(frames: int, by_status: Dict[str, int],
                         **extra) -> dict:
     rec = {"type": "summary", "frames": int(frames),
            "by_status": {str(k): int(v) for k, v in by_status.items()}}
+    rec.update(extra)
+    return rec
+
+
+def make_cost_record(entry: str, backend: str, *,
+                     flops: Optional[float] = None,
+                     bytes_accessed: Optional[float] = None,
+                     argument_bytes: Optional[float] = None,
+                     output_bytes: Optional[float] = None,
+                     temp_bytes: Optional[float] = None,
+                     peak_bytes: Optional[float] = None,
+                     **extra) -> dict:
+    """Static cost attribution of one compiled entry point: XLA's
+    ``cost_analysis()`` (flops, bytes accessed) plus ``memory_analysis()``
+    (argument/output/temp bytes; ``peak_bytes`` is their sum — the
+    program's device-memory high water). Written as the compile-audit
+    cost goldens and consumed by ``obs/roofline.py``."""
+    def num(v):
+        return None if v is None else float(v)
+
+    rec = {
+        "type": "cost",
+        "schema": SCHEMA_VERSION,
+        "entry": str(entry),
+        "backend": str(backend),
+        "flops": num(flops),
+        "bytes_accessed": num(bytes_accessed),
+        "argument_bytes": num(argument_bytes),
+        "output_bytes": num(output_bytes),
+        "temp_bytes": num(temp_bytes),
+        "peak_bytes": num(peak_bytes),
+    }
     rec.update(extra)
     return rec
 

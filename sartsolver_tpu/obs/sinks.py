@@ -46,6 +46,68 @@ def _prom_labels(labels: dict) -> str:
     return "{" + items + "}"
 
 
+# HELP text per registry metric name. Strict exposition-format scrapers
+# (promtool check metrics, OpenMetrics parsers) warn on HELP-less
+# families, so every emitted family gets a line — names missing here
+# fall back to a generic pointer at the docs.
+_HELP = {
+    "frames_total": "Completed frames by final status.",
+    "frame_failures_total": "Frames recorded FAILED, by error class.",
+    "frame_solve_ms": "Wall-clock per solved frame, milliseconds.",
+    "frame_iterations": "Solver iterations per frame.",
+    "iterations_to_converge":
+        "Solver iterations of SUCCESS frames (convergence behavior).",
+    "last_convergence": "Convergence measure of the last solved frame.",
+    "availability_events_total":
+        "Degradations/recoveries noted by the resilience layer.",
+    "frames_prefetched_total": "Frames read ahead by the prefetcher.",
+    "bytes_ingested_total": "Bytes read from input files, by source.",
+    "frames_written_total": "Solution rows handed to the writer.",
+    "bytes_written_total": "Solution bytes flushed to the output file.",
+    "prefetch_queue_depth": "Prefetch queue high-water mark.",
+    "writer_queue_depth": "Async-writer queue high-water mark.",
+    "frame_group_size": "Active solve group size (OOM ladder).",
+    "oom_degradations_total": "Group-size halvings forced by device OOM.",
+    "sched_lane_occupancy": "Live occupied-lane fraction (scheduler).",
+    "sched_stride_occupancy": "Per-stride occupied-lane fraction.",
+    "sched_lanes_retired_total": "Lanes retired on convergence.",
+    "sched_lanes_backfilled_total": "Lanes refilled with waiting frames.",
+    "sched_strides_total": "Scheduler strides dispatched.",
+    "sdc_detected_total": "ABFT checksum mismatches (integrity layer).",
+    "integrity_recomputes_total": "Frame recomputes after an SDC trip.",
+    "stripe_digest_mismatch_total": "RTM stripe digest mismatches.",
+    "nonfinite_pixels_total": "Non-finite measurement pixels dropped.",
+    "fused_panel_count": "Panels per sweep in the panel-psum plan.",
+    "fused_panel_voxels": "Voxels per panel in the panel-psum plan.",
+    "collectives_planned_total":
+        "Collectives in the compiled sweep, by site.",
+    "fault_trips_total": "Injected faults tripped (SART_FAULT).",
+    "phase_seconds": "Wall-clock per pipeline phase (--timing view).",
+}
+
+# Histogram sub-series: what each exported moment is.
+_HIST_SUFFIX = {
+    "_count": "sample count",
+    "_sum": "sum of samples",
+    "_min": "smallest sample",
+    "_max": "largest sample",
+}
+
+
+def _help_text(reg_name: str, suffix: str = "") -> str:
+    base = _HELP.get(reg_name)
+    if base is None:
+        if reg_name.startswith("retry_"):
+            base = "Retry outcomes by site (resilience/retry.py)."
+        else:
+            base = f"sartsolver_tpu metric {reg_name} " \
+                   "(docs/OBSERVABILITY.md)."
+    if suffix:
+        return f"{base[:-1] if base.endswith('.') else base} " \
+               f"({_HIST_SUFFIX[suffix]})."
+    return base
+
+
 def render_prometheus(snapshot: Iterable[dict]) -> str:
     """Prometheus text exposition of a registry snapshot.
 
@@ -55,37 +117,45 @@ def render_prometheus(snapshot: Iterable[dict]) -> str:
     first (first-registration order), not emitted in raw registry order:
     label-sets of one family registered at different times (e.g. a
     ``failed`` status appearing mid-run) must still form one contiguous
-    block under a single ``# TYPE`` line — the exposition-format rule
-    strict scrapers enforce.
+    block under single ``# HELP``/``# TYPE`` lines — the
+    exposition-format rules strict scrapers enforce (and HELP-less
+    families draw warnings from them, so every family carries one).
     """
     families: dict = {}  # name -> [line, ...], insertion-ordered
     typed: dict = {}
 
-    def emit(name: str, mtype: str, labels: dict, value) -> None:
+    def emit(name: str, mtype: str, labels: dict, value,
+             help_text: str) -> None:
         if value is None:
             return
         if name not in typed:
             typed[name] = mtype
-            families[name] = [f"# TYPE {name} {mtype}"]
+            families[name] = [
+                f"# HELP {name} {help_text}",
+                f"# TYPE {name} {mtype}",
+            ]
         families[name].append(
             f"{name}{_prom_labels(labels)} {float(value):g}"
         )
 
     for snap in snapshot:
         kind, labels = snap["kind"], snap["labels"]
+        help_ = _help_text(snap["name"])
         if kind == "counter":
             emit(_prom_name(snap["name"], "_total")
                  if not snap["name"].endswith("_total")
                  else _prom_name(snap["name"]),
-                 "counter", labels, snap["value"])
+                 "counter", labels, snap["value"], help_)
         elif kind == "gauge":
-            emit(_prom_name(snap["name"]), "gauge", labels, snap["value"])
+            emit(_prom_name(snap["name"]), "gauge", labels,
+                 snap["value"], help_)
         elif kind == "histogram":
             base = _prom_name(snap["name"])
-            emit(base + "_count", "counter", labels, snap["count"])
-            emit(base + "_sum", "counter", labels, snap["sum"])
-            emit(base + "_min", "gauge", labels, snap["min"])
-            emit(base + "_max", "gauge", labels, snap["max"])
+            for suffix, mtype in (("_count", "counter"),
+                                  ("_sum", "counter"),
+                                  ("_min", "gauge"), ("_max", "gauge")):
+                emit(base + suffix, mtype, labels, snap[suffix[1:]],
+                     _help_text(snap["name"], suffix))
     lines: List[str] = [
         line for family in families.values() for line in family
     ]

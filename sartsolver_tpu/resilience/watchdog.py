@@ -95,12 +95,18 @@ _serial = 0
 # the most recent beacon is always frame.done itself
 _frames_done = 0
 _last_work_phase = "start"
+# last beacon per phase: phase -> (serial, monotonic time). The SIGUSR1
+# status snapshot (obs/flight.py) reads per-phase ages off this — "the
+# prefetcher last moved 0.1 s ago but the dispatch is 40 s stale" is the
+# attribution the single _last tuple cannot give.
+_last_by_phase: dict = {}
 
-# Observability tap (obs/trace.py): when a trace sink is active, every
-# beacon is mirrored into the trace buffer as a phase span. One global
-# None-check when disabled — beacons stay nanoseconds, and NOTHING here
-# is ever traced (the compile-audit goldens pin that).
-_tap: Optional[Callable[[str, int, float, int], None]] = None
+# Observability taps (obs/trace.py spans, obs/flight.py ring): every
+# beacon is mirrored into each installed tap. One global emptiness check
+# when disabled — beacons stay nanoseconds, and NOTHING here is ever
+# traced (the compile-audit goldens pin that).
+_taps: dict = {}
+_tap_seq: Tuple[Callable[[str, int, float, int], None], ...] = ()
 
 # Threads that volunteered for async interruption (prefetcher / async
 # writer workers — they catch the exception and degrade their stream).
@@ -108,13 +114,32 @@ _tap: Optional[Callable[[str, int, float, int], None]] = None
 _interruptible: "weakref.WeakSet[threading.Thread]" = weakref.WeakSet()
 
 
+def add_beacon_tap(
+    key: str, tap: Callable[[str, int, float, int], None]
+) -> None:
+    """Install a keyed beacon observer. Taps must be cheap and
+    exception-free — they run inside every beacon."""
+    global _tap_seq
+    _taps[key] = tap
+    _tap_seq = tuple(_taps.values())
+
+
+def remove_beacon_tap(key: str) -> None:
+    global _tap_seq
+    _taps.pop(key, None)
+    _tap_seq = tuple(_taps.values())
+
+
 def set_beacon_tap(
     tap: Optional[Callable[[str, int, float, int], None]]
 ) -> None:
-    """Install (or with None remove) the beacon observer. The tap must be
-    cheap and exception-free — it runs inside every beacon."""
-    global _tap
-    _tap = tap
+    """The trace buffer's single-slot API (obs/trace.py), kept as a view
+    over the keyed taps: install (or with None remove) the ``trace``
+    tap without touching any other observer (the flight ring)."""
+    if tap is None:
+        remove_beacon_tap("trace")
+    else:
+        add_beacon_tap("trace", tap)
 
 
 def frames_done() -> int:
@@ -126,14 +151,15 @@ def beacon(phase: str) -> None:
     """Announce the start of host-side work in ``phase``.
 
     Called from multiple threads; always recorded (so a watchdog can
-    attach mid-run), costs one clock read + tuple assignment when no
-    heartbeat file or trace tap is configured.
+    attach mid-run), costs one clock read + two dict/tuple assignments
+    when no heartbeat file or tap is configured.
     """
     global _last, _serial, _frames_done, _last_work_phase
     _serial += 1
     now = time.monotonic()
     ident = threading.get_ident()
     _last = (phase, _serial, now, ident)
+    _last_by_phase[phase] = (_serial, now)
     if phase == PHASE_FRAME_DONE:
         _frames_done += 1
         path = os.environ.get("SART_HEARTBEAT_FILE")
@@ -141,17 +167,100 @@ def beacon(phase: str) -> None:
             _write_heartbeat(path)
     else:
         _last_work_phase = phase
-    tap = _tap
-    if tap is not None:
-        try:
-            tap(phase, _serial, now, ident)
-        except Exception:  # observability must never hurt the run
-            pass
+    taps = _tap_seq
+    if taps:
+        for tap in taps:
+            try:
+                tap(phase, _serial, now, ident)
+            except Exception:  # observability must never hurt the run
+                pass
 
 
 def last_beacon() -> Tuple[str, int, float, int]:
     """The most recent beacon (phase, serial, monotonic time, thread id)."""
     return _last
+
+
+def beacon_ages() -> dict:
+    """Seconds since the last beacon of each phase seen so far (the
+    SIGUSR1 status snapshot's per-phase staleness table).
+
+    Worker threads insert first-occurrence phases concurrently; a dict
+    iteration racing such an insert raises RuntimeError, which would
+    silently cost the crash bundle its snapshot — retry the copy a few
+    times (each attempt is atomic-or-raises under the GIL)."""
+    items = []
+    for _ in range(4):
+        try:
+            items = list(_last_by_phase.items())
+            break
+        except RuntimeError:  # insert raced the copy; go again
+            continue
+    now = time.monotonic()
+    return {
+        phase: round(now - t, 3)
+        for phase, (_serial_, t) in sorted(items)
+    }
+
+
+# Live scheduler view (sched/scheduler.py registers a provider while the
+# continuous batcher drives the run): occupancy + in-flight lane serials
+# for the heartbeat line and the SIGUSR1 status snapshot. A provider
+# must be cheap and exception-tolerant — it runs inside the per-frame
+# heartbeat write.
+_sched_status: Optional[Callable[[], Optional[dict]]] = None
+
+# Crash hook (obs/flight.py): called with a reason string immediately
+# before the stage-3 ``os._exit`` so the flight recorder can flush its
+# crash bundle — the one abort path no ``finally`` block survives.
+_crash_hook: Optional[Callable[[str], None]] = None
+
+
+def set_sched_status_provider(
+    provider: Optional[Callable[[], Optional[dict]]]
+) -> None:
+    global _sched_status
+    _sched_status = provider
+
+
+def sched_status() -> Optional[dict]:
+    """The live scheduler view ({occupancy, lanes, strides}), or None
+    when the continuous batcher is not driving."""
+    provider = _sched_status
+    if provider is None:
+        return None
+    try:
+        return provider()
+    except Exception:  # observability must never hurt the run
+        return None
+
+
+def set_crash_hook(hook: Optional[Callable[[str], None]]) -> None:
+    global _crash_hook
+    _crash_hook = hook
+
+
+def _fire_crash_hook(reason: str, timeout: float = 5.0) -> None:
+    """Run the crash hook in a bounded daemon thread. The hook writes a
+    file, and the filesystem may be EXACTLY what is wedged — the hard
+    abort must reach ``os._exit`` whether or not the bundle lands, so
+    the write gets ``timeout`` seconds and is then abandoned."""
+    hook = _crash_hook
+    if hook is None:
+        return
+
+    def run() -> None:
+        try:
+            hook(reason)
+        except Exception:  # the bundle must never mask the abort
+            pass
+
+    t = threading.Thread(target=run, name="sart-crash-hook", daemon=True)
+    try:
+        t.start()
+        t.join(timeout)
+    except Exception:
+        pass
 
 
 def _write_heartbeat(path: str) -> None:
@@ -160,20 +269,34 @@ def _write_heartbeat(path: str) -> None:
 
     The file carries WHERE the run is, not just that it is alive: the
     last pipeline phase that ran before this frame completed, the
-    completed-frame counter and the beacon serial, one ``key=value`` line
-    an external supervisor can parse without any schema machinery. The
-    mtime contract is unchanged — still one touch per completed frame —
-    so ``find -mmin``-style liveness probes keep working. Published via
-    temp-file + rename: the supervisor reads at arbitrary instants, and
-    an in-place truncating write would expose an empty/partial file
-    between the truncate and the write.
+    completed-frame counter and the beacon serial — plus, when the
+    continuous-batching scheduler is driving (the default batched path),
+    ``occupancy=`` and the in-flight lane serials, so a supervisor sees
+    lane health, not just frame count — one ``key=value`` line parseable
+    without any schema machinery. The mtime contract is unchanged —
+    still one touch per completed frame — so ``find -mmin``-style
+    liveness probes keep working. Published via temp-file + rename: the
+    supervisor reads at arbitrary instants, and an in-place truncating
+    write would expose an empty/partial file between the truncate and
+    the write.
     """
     try:
+        sched = sched_status()
+        extra = ""
+        if sched:
+            occ = sched.get("occupancy")
+            if occ is not None:
+                extra += f" occupancy={float(occ):.3f}"
+            lanes = sched.get("lanes")
+            if lanes is not None:
+                extra += " lanes=" + (
+                    ",".join(str(s) for s in lanes) if lanes else "-"
+                )
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
             f.write(
                 f"phase={_last_work_phase} frames={_frames_done} "
-                f"serial={_serial} unix={time.time():.3f}\n"
+                f"serial={_serial}{extra} unix={time.time():.3f}\n"
             )
         os.replace(tmp, path)
     except OSError:
@@ -369,6 +492,13 @@ class Watchdog:
                 )
                 dump_stacks()
                 self.hard_aborted = True
+                # flush the flight recorder's crash bundle (obs/flight.py)
+                # NOW: os._exit skips every finally block, so this hook is
+                # the bundle's only chance on the hard-abort path
+                _fire_crash_hook(
+                    f"watchdog hard abort: no progress for {stalled:.1f}s "
+                    f"(last beacon: phase {cur[0]!r})"
+                )
                 if self._hard_exit:
                     # os._exit: no atexit/finally — anything those would
                     # flush is exactly what is wedged; the solution file

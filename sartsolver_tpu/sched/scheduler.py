@@ -51,6 +51,7 @@ histogram.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -60,6 +61,7 @@ import numpy as np
 
 from sartsolver_tpu.config import SDC_DETECTED
 from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.resilience import watchdog
 from sartsolver_tpu.resilience.degrade import (
     dispatch_guarded,
     is_resource_exhausted,
@@ -143,6 +145,7 @@ class ContinuousBatcher:
         isolate: bool = True,
         refill_quantum: Optional[int] = None,
         integrity_policy=None,
+        step_trace: bool = False,
     ):
         if lanes < 1:
             raise ValueError("Lane count must be positive.")
@@ -170,6 +173,11 @@ class ContinuousBatcher:
         self._stop_check = stop_check
         self._on_event = on_event
         self._isolate = isolate
+        # --profile_dir: wrap every stride dispatch in a
+        # jax.profiler.StepTraceAnnotation so the XLA device trace
+        # aligns with stride boundaries instead of one undifferentiated
+        # blob; zero-cost (a shared nullcontext) when off
+        self._step_trace = bool(step_trace)
         registry = obs_metrics.get_registry()
         self._occ_gauge = registry.gauge("sched_lane_occupancy")
         self._occ_hist = registry.histogram("sched_stride_occupancy")
@@ -199,6 +207,32 @@ class ContinuousBatcher:
         if self._on_event is not None:
             self._on_event(message)
 
+    # ---- live introspection ----------------------------------------------
+
+    def _live_status(self) -> Optional[dict]:
+        """Occupancy + in-flight lane serials for the heartbeat file and
+        the SIGUSR1 status snapshot (watchdog.set_sched_status_provider).
+        Reads the run's own bookkeeping under the GIL — cheap enough to
+        run inside every heartbeat write."""
+        occupied = getattr(self, "_occupied", None)
+        stats = getattr(self, "_stats", None)
+        if occupied is None or stats is None:
+            return None
+        return {
+            "occupancy": round(stats.occupancy, 3),
+            "lanes": sorted(slot.seq for slot in occupied.values()),
+            "strides": stats.strides,
+            "frames_emitted": stats.frames,
+        }
+
+    def _step_span(self, step: int):
+        if not self._step_trace:
+            return contextlib.nullcontext()
+        import jax.profiler
+
+        return jax.profiler.StepTraceAnnotation("sched.stride",
+                                                step_num=step)
+
     # ---- main loop -------------------------------------------------------
 
     def run(self, items) -> SchedRunStats:
@@ -206,6 +240,16 @@ class ContinuousBatcher:
         stream until it is drained (or a stop request truncates it).
         Returns the run stats; ``stats.leftover`` is non-None exactly
         when a device OOM forced the classic-loop fallback."""
+        # publish the live lane view for the duration of the run: the
+        # heartbeat line gains occupancy= / lanes= and SIGUSR1 snapshots
+        # see the scheduler (docs/OBSERVABILITY.md §9)
+        watchdog.set_sched_status_provider(self._live_status)
+        try:
+            return self._run(items)
+        finally:
+            watchdog.set_sched_status_provider(None)
+
+    def _run(self, items) -> SchedRunStats:
         solver = self._solver
         B = self._lanes
         stats = self._stats = SchedRunStats()
@@ -215,7 +259,7 @@ class ContinuousBatcher:
         it = iter(items)
         exhausted = False
         free = deque(range(B))
-        occupied = {}  # lane index -> _Slot
+        occupied = self._occupied = {}  # lane index -> _Slot
         self._sdc_retry = deque()  # slots awaiting their SDC recompute
         seq = 0
         t_last = time.perf_counter()
@@ -279,10 +323,11 @@ class ContinuousBatcher:
                 # cli.py's dispatch_guarded call: dispatch-phase beacon +
                 # solve.dispatch trace span (ladder=None — the fixed lane
                 # count cannot halve, OOM handling is the leftover path)
-                dispatch_guarded(
-                    lambda: solver.sched_step(lane_state, refills),
-                    ladder=None,
-                )
+                with self._step_span(stats.strides):
+                    dispatch_guarded(
+                        lambda: solver.sched_step(lane_state, refills),
+                        ladder=None,
+                    )
             except RECOVERABLE_FRAME_ERRORS as err:
                 if is_resource_exhausted(err):
                     # the one failure the scheduler cannot absorb at a

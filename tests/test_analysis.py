@@ -395,8 +395,10 @@ def test_audit_detects_violations_and_golden_drift(tmp_path):
     assert updated.status == "updated"
     ok = run_entry(entry, goldens_dir=scratch)
     assert ok.status == "ok", ok.format()
-    # corrupt the golden -> mismatch with a readable diff
-    path = os.path.join(scratch, os.listdir(scratch)[0])
+    # corrupt the op-histogram golden (NOT the cost golden, which now
+    # sits beside it) -> mismatch with a readable diff
+    path = next(os.path.join(scratch, f) for f in os.listdir(scratch)
+                if not f.endswith(".cost.json"))
     with open(path) as fh:
         golden = json.load(fh)
     golden["histogram"]["dot"] = golden["histogram"].get("dot", 0) + 7
@@ -405,6 +407,144 @@ def test_audit_detects_violations_and_golden_drift(tmp_path):
     drift = run_entry(entry, goldens_dir=scratch)
     assert drift.status == "golden-mismatch"
     assert any("dot" in v for v in drift.violations)
+
+
+# ---------------------------------------------------------------------------
+# cost/memory goldens (performance observatory, docs/OBSERVABILITY.md §8)
+# ---------------------------------------------------------------------------
+
+
+def test_every_entry_has_a_cost_golden():
+    """Acceptance: every audited entry point carries a committed cost
+    record that parses as the versioned obs ``cost`` schema."""
+    import jax
+
+    from sartsolver_tpu.analysis.audit import GOLDENS_DIR
+    from sartsolver_tpu.analysis.registry import load_registered_entries
+    from sartsolver_tpu.obs import schema
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("cost goldens are checked in for the cpu backend")
+    for name in load_registered_entries():
+        path = os.path.join(GOLDENS_DIR, f"{name}.cpu.cost.json")
+        assert os.path.exists(path), f"{name} has no cost golden"
+        with open(path) as fh:
+            rec = json.load(fh)
+        assert schema.validate_record(rec) == [], name
+        assert rec["entry"] == name
+        # CPU XLA implements both analysis halves: a null here means the
+        # extraction silently lost a capability
+        assert rec["flops"] is not None and rec["flops"] > 0, name
+        assert rec["bytes_accessed"] is not None, name
+        assert rec["peak_bytes"] is not None, name
+
+
+def test_diff_cost_band_and_null_semantics():
+    """The tolerance band gates BOTH directions, and a null on exactly
+    one side is a drift (a capability change is a re-baseline, never a
+    silent pass)."""
+    from sartsolver_tpu.analysis.audit import diff_cost
+
+    golden = {"flops": 1000.0, "bytes_accessed": 500.0,
+              "argument_bytes": None, "output_bytes": 10.0,
+              "temp_bytes": 1.0, "peak_bytes": 11.0}
+    same = dict(golden)
+    assert diff_cost(golden, same, rtol=0.5) == []
+    # inside the band: jitter passes
+    jitter = dict(golden, flops=1400.0)
+    assert diff_cost(golden, jitter, rtol=0.5) == []
+    # the silent 2x growth the tentpole exists to catch
+    grown = dict(golden, flops=2100.0)
+    msgs = diff_cost(golden, grown, rtol=0.5)
+    assert len(msgs) == 1 and "flops" in msgs[0] and "band" in msgs[0]
+    # an unexplained halving trips too (work traced away)
+    shrunk = dict(golden, bytes_accessed=100.0)
+    assert any("bytes_accessed" in m for m in
+               diff_cost(golden, shrunk, rtol=0.5))
+    # null-on-one-side is a drift with a re-baseline hint
+    lost = dict(golden, flops=None)
+    msgs = diff_cost(golden, lost, rtol=0.5)
+    assert any("null on one side" in m for m in msgs)
+    # null on BOTH sides is agreement (backend without that half)
+    assert diff_cost(dict(golden, flops=None),
+                     dict(golden, flops=None), rtol=0.5) == []
+
+
+def test_cost_drift_fails_audit_like_histogram_drift(tmp_path):
+    """A cost golden drifted past the entry's band fails run_entry with
+    golden-mismatch — the audit verdict, not a warning."""
+    from sartsolver_tpu.analysis.audit import run_entry
+    from sartsolver_tpu.analysis.registry import AUDIT_REGISTRY
+
+    entry = AUDIT_REGISTRY["sweep"]
+    scratch = str(tmp_path)
+    assert run_entry(entry, goldens_dir=scratch,
+                     update_goldens=True).status == "updated"
+    cost_path = os.path.join(scratch, "sweep.cpu.cost.json")
+    with open(cost_path) as fh:
+        rec = json.load(fh)
+    rec["flops"] = rec["flops"] * 4  # a silent 4x FLOP growth
+    with open(cost_path, "w") as fh:
+        json.dump(rec, fh)
+    drift = run_entry(entry, goldens_dir=scratch)
+    assert drift.status == "golden-mismatch"
+    assert any("flops" in v for v in drift.violations)
+    assert "cost drifted" in drift.detail
+    # a cost-golden deletion is golden-missing, with the re-baseline cmd
+    os.remove(cost_path)
+    gone = run_entry(entry, goldens_dir=scratch)
+    assert gone.status == "golden-missing"
+    assert "--update-cost-goldens" in gone.detail
+
+
+def test_update_cost_goldens_leaves_histograms_untouched(tmp_path):
+    """--update-cost-goldens re-baselines ONLY the cost records: the
+    op-histogram signature files stay byte-identical (mtime included is
+    too strong; bytes is the contract)."""
+    from sartsolver_tpu.analysis.audit import run_entry
+    from sartsolver_tpu.analysis.registry import AUDIT_REGISTRY
+
+    entry = AUDIT_REGISTRY["sweep"]
+    scratch = str(tmp_path)
+    run_entry(entry, goldens_dir=scratch, update_goldens=True)
+    hist_path = os.path.join(scratch, "sweep.cpu.json")
+    cost_path = os.path.join(scratch, "sweep.cpu.cost.json")
+    hist_before = open(hist_path, "rb").read()
+    # poison the histogram golden: a cost-only rebaseline must not heal
+    # (i.e. rewrite) it
+    with open(hist_path, "wb") as fh:
+        fh.write(hist_before + b"\n")
+    with open(cost_path, "w") as fh:
+        fh.write("{}")
+    rep = run_entry(entry, goldens_dir=scratch, update_cost_goldens=True)
+    assert rep.status == "updated"
+    assert open(hist_path, "rb").read() == hist_before + b"\n"
+    assert json.load(open(cost_path))["type"] == "cost"
+    # ...but a REAL histogram drift is still verified first: the
+    # cost-only rebaseline reports the mismatch and rewrites nothing
+    hist = json.loads(hist_before)
+    hist["histogram"]["dot"] = hist["histogram"].get("dot", 0) + 7
+    with open(hist_path, "w") as fh:
+        json.dump(hist, fh)
+    with open(cost_path, "w") as fh:
+        fh.write("{}")
+    rep = run_entry(entry, goldens_dir=scratch, update_cost_goldens=True)
+    assert rep.status == "golden-mismatch"
+    assert open(cost_path).read() == "{}"  # drift blocked the rewrite
+
+
+def test_audit_report_carries_cost_record():
+    """EntryReport.cost rides along with the verdict (the --json lint
+    output's attribution payload)."""
+    from sartsolver_tpu.analysis.audit import run_entry
+    from sartsolver_tpu.analysis.registry import AUDIT_REGISTRY
+    from sartsolver_tpu.obs import schema
+
+    rep = run_entry(AUDIT_REGISTRY["sweep"], skip_goldens=True)
+    assert rep.status == "ok"
+    assert rep.cost is not None
+    assert schema.validate_record(rep.cost) == []
+    assert rep.cost["entry"] == "sweep"
 
 
 def test_while_loop_required_guard():

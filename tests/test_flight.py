@@ -1,0 +1,469 @@
+"""Performance observatory: live introspection + roofline accounting
+(sartsolver_tpu/obs/flight.py, obs/roofline.py, docs/OBSERVABILITY.md
+§8-§9).
+
+Drills the gap between the per-frame heartbeat and the post-mortem
+artifact: the SIGUSR1 status snapshot (through the real CLI, poked from
+outside while an injected hang holds the run open), the crash bundle on
+the abnormal exit paths (watchdog abort, SDC quarantine, exit-4 stop,
+and the stage-3 ``os._exit`` that only the crash hook survives), the
+``sartsolve top`` renderer, and the roofline utilization math that
+``bench.py`` and the cost goldens share.
+
+``make flight`` runs exactly this module.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+from sartsolver_tpu.cli import main
+from sartsolver_tpu.obs import flight, metrics, roofline, schema
+from sartsolver_tpu.obs.cli import metrics_main, render_top, top_main
+from sartsolver_tpu.resilience import faults, shutdown, watchdog
+from sartsolver_tpu.resilience.failures import (
+    EXIT_INFRASTRUCTURE,
+    EXIT_INTERRUPTED,
+    EXIT_PARTIAL,
+    RunSummary,
+)
+from sartsolver_tpu.resilience.retry import reset_retry_stats
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    """Fresh faults/taps/providers, fast retries, bounded hang release,
+    no introspection paths leaking between tests."""
+    monkeypatch.setenv("SART_RETRY_BASE_DELAY", "0.001")
+    monkeypatch.setenv("SART_RETRY_MAX_DELAY", "0.002")
+    monkeypatch.setenv("SART_HANG_RELEASE", "60")
+    for var in ("SART_FAULT", "SART_STATUS_FILE", "SART_FLIGHT_BUNDLE",
+                "SART_FLIGHT_EVENTS", "SART_HEARTBEAT_FILE",
+                "SART_WATCHDOG_TIMEOUT", "SART_PEAK_MXU_TFLOPS",
+                "SART_PEAK_HBM_GBS"):
+        monkeypatch.delenv(var, raising=False)
+    faults.clear_faults()
+    reset_retry_stats()
+    yield
+    faults.clear_faults()
+    reset_retry_stats()
+    flight.uninstall()
+    watchdog.set_sched_status_provider(None)
+    watchdog.set_crash_hook(None)
+
+
+@pytest.fixture
+def world(tmp_path):
+    return fx.write_world(tmp_path, with_laplacian=True)
+
+
+def run_cli(paths, *extra):
+    return main([
+        "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "300", "-c", "1e-6",
+        *extra,
+    ])
+
+
+def _arm_watchdog(monkeypatch, timeout="2", grace="60"):
+    monkeypatch.setenv("SART_WATCHDOG_TIMEOUT", timeout)
+    monkeypatch.setenv("SART_WATCHDOG_GRACE", grace)
+
+
+# ---------------------------------------------------------------------------
+# roofline accounting (obs/roofline.py)
+# ---------------------------------------------------------------------------
+
+def test_device_peaks_table_and_overrides(monkeypatch):
+    v5e = roofline.device_peaks("tpu", "TPU v5 lite", ndev=4)
+    assert v5e["per_device_hbm_gbs"] == 819.0
+    assert v5e["hbm_bytes_s"] == 819.0e9 * 4
+    assert v5e["source"].startswith("table:")
+    cpu = roofline.device_peaks("cpu", "cpu")
+    assert cpu["source"] == "cpu"
+    unknown = roofline.device_peaks("tpu", "TPU v99")
+    assert unknown["source"] == "default"
+    monkeypatch.setenv("SART_PEAK_MXU_TFLOPS", "100")
+    monkeypatch.setenv("SART_PEAK_HBM_GBS", "1000")
+    over = roofline.device_peaks("tpu", "TPU v5 lite", ndev=2)
+    assert over["source"] == "env"
+    assert over["mxu_flops_s"] == 100e12 * 2
+    assert over["hbm_bytes_s"] == 1000e9 * 2
+
+
+def test_utilization_math_and_bound():
+    peaks = {"mxu_flops_s": 1e12, "hbm_bytes_s": 1e11,
+             "per_device_tflops": 1.0, "per_device_hbm_gbs": 100.0,
+             "ndev": 1, "source": "test"}
+    # 1e9 FLOP + 1e9 bytes at 50 iter/s: 5% of the MXU, 50% of HBM —
+    # intensity 1 flop/byte, ridge 10 -> HBM-bound
+    u = roofline.utilization(1e9, 1e9, 50.0, peaks)
+    assert u["mxu_util"] == pytest.approx(0.05)
+    assert u["hbm_util"] == pytest.approx(0.5)
+    assert u["arithmetic_intensity"] == pytest.approx(1.0)
+    assert u["ridge_intensity"] == pytest.approx(10.0)
+    assert u["bound"] == "hbm"
+    # 100 flops/byte is above the ridge: the MXU is the wall
+    assert roofline.utilization(1e11, 1e9, 1.0, peaks)["bound"] == "mxu"
+
+
+def test_sweep_cost_model_scales_with_reads():
+    # the fused sweep reads the RTM once per iteration, the two-matmul
+    # path twice: same FLOPs, ~half the bytes
+    P, V, B = 1000, 2000, 4
+    flops1, bytes1 = roofline.sweep_cost_model(P, V, B, 4, reads=1)
+    flops2, bytes2 = roofline.sweep_cost_model(P, V, B, 4, reads=2)
+    assert flops1 == flops2 == 4.0 * B * P * V
+    assert bytes2 - bytes1 == P * V * 4
+    # int8 storage quarters the dominant term
+    _, bytes_i8 = roofline.sweep_cost_model(P, V, B, 1, reads=1)
+    assert bytes_i8 < bytes1 / 2
+
+
+def test_compiled_cost_numbers_tolerant_extraction():
+    jax = pytest.importorskip("jax")
+    compiled = jax.jit(lambda x: x @ x).lower(
+        np.ones((16, 16), np.float32)).compile()
+    out = roofline.compiled_cost_numbers(compiled)
+    # CPU jaxlib implements both halves; every figure lands non-null
+    assert out["argument_bytes"] == 16 * 16 * 4
+    assert out["output_bytes"] == 16 * 16 * 4
+    assert out["flops"] and out["flops"] >= 2 * 16 ** 3 * 0.5
+    # and nothing blows up on an object with neither API
+    class _Bare:
+        pass
+    bare = roofline.compiled_cost_numbers(_Bare())
+    assert all(v is None for v in bare.values())
+
+
+# ---------------------------------------------------------------------------
+# flight ring + status snapshot
+# ---------------------------------------------------------------------------
+
+def test_flight_ring_is_bounded():
+    rec = flight.FlightRecorder(max_events=4)
+    for i in range(10):
+        rec.record("event", i=i)
+    tail = rec.snapshot()
+    assert len(tail) == 4
+    assert [e["i"] for e in tail] == [6, 7, 8, 9]  # newest kept
+    assert rec.total == 10
+
+
+def test_flight_ring_taps_beacons():
+    rec = flight.install(flight.FlightRecorder(max_events=16))
+    try:
+        watchdog.beacon("solve.dispatch")
+        flight.record_event("event", "ladder engaged")
+    finally:
+        flight.uninstall()
+    kinds = [e["kind"] for e in rec.snapshot()]
+    assert "beacon" in kinds and "event" in kinds
+    beacon = next(e for e in rec.snapshot() if e["kind"] == "beacon")
+    assert beacon["phase"] == "solve.dispatch"
+    # uninstalled: no longer fed
+    n = rec.total
+    watchdog.beacon("solve.dispatch")
+    assert rec.total == n
+
+
+def test_status_snapshot_validates_and_carries_sched(tmp_path):
+    watchdog.beacon("solve.dispatch")
+    watchdog.set_sched_status_provider(
+        lambda: {"occupancy": 0.5, "lanes": [1], "strides": 3}
+    )
+    try:
+        rec = flight.write_status(str(tmp_path / "s.json"))
+    finally:
+        watchdog.set_sched_status_provider(None)
+    assert rec["type"] == "status"
+    assert schema.validate_record(rec) == []
+    assert rec["sched"]["occupancy"] == 0.5
+    assert rec["last_beacon"]["phase"] == "solve.dispatch"
+    assert rec["beacon_ages"]["solve.dispatch"] >= 0
+    on_disk = json.load(open(tmp_path / "s.json"))
+    assert on_disk == rec
+    # the snapshot file passes `sartsolve metrics --check`
+    assert metrics_main(["--check", str(tmp_path / "s.json")]) == 0
+
+
+def test_sigusr1_handler_in_process(tmp_path, capsys):
+    path = str(tmp_path / "status.json")
+    prev = flight.install_status_handler(path)
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        deadline = time.time() + 5
+        while not os.path.exists(path) and time.time() < deadline:
+            time.sleep(0.02)
+    finally:
+        flight.uninstall_status_handler(prev)
+    rec = json.load(open(path))
+    assert rec["type"] == "status" and schema.validate_record(rec) == []
+    assert "sartsolve status:" in capsys.readouterr().err
+
+
+def test_crash_bundle_roundtrip(tmp_path):
+    flight.install(flight.FlightRecorder(max_events=8))
+    watchdog.beacon("ingest.rtm")
+    summary = RunSummary()
+    summary.record_status(0, 1.5)
+    summary.record_status(-3, 2.5)
+    path = str(tmp_path / "crash.json")
+    assert flight.write_crash_bundle(path, "watchdog abort: test",
+                                     summary) is True
+    rec = json.load(open(path))
+    assert rec["type"] == "flight"
+    assert schema.validate_record(rec) == []
+    assert rec["reason"] == "watchdog abort: test"
+    assert any(e["kind"] == "beacon" for e in rec["ring"])
+    assert rec["partial"]["frames"] == 2
+    assert rec["partial"]["by_status"]["failed"] == 1
+    assert rec["partial"]["failed_times"] == [2.5]
+    assert metrics_main(["--check", path]) == 0
+    # a failed write is a False, never a raise (abort paths call this)
+    assert flight.write_crash_bundle(
+        str(tmp_path / "no/such/dir/x.json"), "r") is False
+
+
+# ---------------------------------------------------------------------------
+# crash bundles through the real CLI
+# ---------------------------------------------------------------------------
+
+def _read_bundle(paths):
+    path = paths["output"] + ".crash.json"
+    assert os.path.exists(path), "crash bundle missing"
+    rec = json.load(open(path))
+    assert rec["type"] == "flight"
+    assert schema.validate_record(rec) == []
+    return rec
+
+
+def test_cli_watchdog_abort_writes_crash_bundle(world, monkeypatch,
+                                                capsys):
+    """Abnormal-exit leg 1: a hang before the frame loop exists (the
+    Laplacian staging device.put) is interrupted by the watchdog and
+    aborts exit 3 — and the flight bundle lands next to the output with
+    the abort reason and the ring's beacon tail."""
+    paths, *_ = world
+    _arm_watchdog(monkeypatch)
+    faults.inject(faults.SITE_DEVICE_PUT, "hang", count=1)
+    rc = run_cli(paths, "-l", paths["laplacian"], "-b", "0.001")
+    assert rc == EXIT_INFRASTRUCTURE
+    assert "crash bundle written" in capsys.readouterr().err
+    rec = _read_bundle(paths)
+    assert rec["reason"].startswith("watchdog abort:")
+    assert any(e["kind"] == "beacon" for e in rec["ring"])
+    assert rec["status"]["frames_done"] >= 0
+
+
+def test_cli_quarantine_writes_crash_bundle(world, monkeypatch, capsys):
+    """Abnormal-exit leg 2: an SDC quarantine (resident corruption the
+    recompute reproduces) exits 3 with a bundle whose partial accounting
+    shows the terminal frames an operator must distrust."""
+    paths, *_ = world
+    monkeypatch.setenv("SART_FAULT", "device.buffer:corrupt:1:1")
+    faults.reset()
+    rc = run_cli(paths, "--integrity")
+    assert rc == EXIT_INFRASTRUCTURE
+    assert "Quarantined" in capsys.readouterr().err
+    rec = _read_bundle(paths)
+    assert rec["reason"].startswith("SDC quarantine:")
+    assert rec["partial"]["by_status"].get("failed", 0) >= 1
+
+
+def test_cli_stop_writes_crash_bundle(world, monkeypatch, capsys):
+    """Abnormal-exit leg 3: a graceful stop that truncated the run
+    (exit 4) records where it stopped — triage before the requeue."""
+    paths, *_ = world
+    monkeypatch.setattr(shutdown, "stop_requested", lambda: True)
+    rc = run_cli(paths)
+    assert rc == EXIT_INTERRUPTED
+    assert "resumable" in capsys.readouterr().err
+    rec = _read_bundle(paths)
+    assert rec["reason"].startswith("interrupted by")
+
+
+def test_cli_clean_run_writes_no_introspection_files(world):
+    """Disabled-path identity: a healthy, unsignaled run leaves no
+    status file and no crash bundle behind."""
+    paths, *_ = world
+    assert run_cli(paths) == 0
+    assert not os.path.exists(paths["output"] + ".crash.json")
+    assert not os.path.exists(paths["output"] + ".status.json")
+
+
+def test_crash_hook_survives_hard_abort_in_subprocess(tmp_path):
+    """The stage-3 ``os._exit(3)`` skips every finally block — the
+    watchdog's crash hook is the bundle's only chance, and it must land
+    before the process dies."""
+    bundle = str(tmp_path / "hard.crash.json")
+    code = (
+        "import time\n"
+        "from sartsolver_tpu.obs import flight\n"
+        "from sartsolver_tpu.resilience import watchdog\n"
+        "flight.install()\n"
+        "watchdog.set_crash_hook(\n"
+        f"    lambda reason: flight.write_crash_bundle({bundle!r}, reason))\n"
+        "watchdog.beacon('ingest.rtm')\n"
+        "wd = watchdog.Watchdog(timeout=0.3, grace=0.3, poll=0.05)\n"
+        "wd.start()\n"
+        "time.sleep(60)\n"  # C-level stall: only the hard abort ends it
+        "print('unreachable')\n"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=120,
+    )
+    assert proc.returncode == EXIT_INFRASTRUCTURE
+    assert "unreachable" not in proc.stdout
+    assert "crash bundle written" in proc.stderr
+    rec = json.load(open(bundle))
+    assert rec["type"] == "flight"
+    assert "watchdog hard abort" in rec["reason"]
+    assert any(e.get("phase") == "ingest.rtm" for e in rec["ring"])
+
+
+def test_cli_sigusr1_snapshot_through_real_cli(world, tmp_path):
+    """The headline drill: poke a LIVE run (held open by an injected
+    hang at solve.dispatch) with ``kill -USR1`` from outside and read
+    the snapshot it publishes — no restart, no extra flags."""
+    paths, *_ = world
+    status = str(tmp_path / "live.status.json")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SART_FAULT"] = "solve.dispatch:hang:1:1"
+    env["SART_HANG_RELEASE"] = "45"
+    env["SART_WATCHDOG_TIMEOUT"] = "8"
+    env["SART_WATCHDOG_GRACE"] = "120"
+    env["SART_STATUS_FILE"] = status
+    # Pre-ignore SIGUSR1 so signals sent before the CLI installs its
+    # handler are harmless (the default action would kill the child),
+    # and announce readiness — a signal during interpreter startup,
+    # before even SIG_IGN is in place, would still be fatal. main() then
+    # replaces SIG_IGN with the real snapshot handler.
+    ready = str(tmp_path / "ready")
+    wrapper = (
+        "import signal, sys\n"
+        "signal.signal(signal.SIGUSR1, signal.SIG_IGN)\n"
+        f"open({ready!r}, 'w').write('ready')\n"
+        "from sartsolver_tpu.cli import main\n"
+        "sys.exit(main(sys.argv[1:]))\n"
+    )
+    cmd = [
+        sys.executable, "-c", wrapper, "-o", paths["output"],
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "40", "-c", "1e-12",
+    ]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    got_snapshot = False
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and proc.poll() is None:
+            if os.path.exists(ready):
+                break
+            time.sleep(0.05)
+        # poke until a snapshot lands: the injected hang holds the first
+        # solve open for the watchdog's 8 s, so the live window is wide
+        while time.time() < deadline and proc.poll() is None:
+            proc.send_signal(signal.SIGUSR1)
+            time.sleep(0.25)
+            if os.path.exists(status):
+                got_snapshot = True
+                break
+        _, stderr = proc.communicate(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+    assert got_snapshot, f"no snapshot appeared; stderr:\n{stderr}"
+    rec = json.load(open(status))
+    assert rec["type"] == "status"
+    assert schema.validate_record(rec) == []
+    assert rec["pid"] == proc.pid
+    assert "sartsolve status:" in stderr
+    # the hung frame failed through the watchdog, the rest solved
+    assert proc.returncode == EXIT_PARTIAL
+
+
+# ---------------------------------------------------------------------------
+# `sartsolve top`
+# ---------------------------------------------------------------------------
+
+def test_top_renders_status_snapshot(tmp_path, capsys):
+    path = str(tmp_path / "s.json")
+    watchdog.beacon("solve.dispatch")
+    watchdog.set_sched_status_provider(
+        lambda: {"occupancy": 0.75, "lanes": [2, 5], "strides": 9}
+    )
+    try:
+        flight.write_status(path)
+    finally:
+        watchdog.set_sched_status_provider(None)
+    assert main(["top", path, "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "frames_done" in out
+    assert "solve.dispatch" in out
+    assert "occupancy 0.75" in out
+    assert "2,5" in out
+
+
+def test_top_renders_heartbeat_and_prom(tmp_path, capsys):
+    hb = tmp_path / "hb"
+    hb.write_text("phase=solve.dispatch frames=7 serial=21 "
+                  "occupancy=0.875 lanes=1,3 unix=1.5\n")
+    assert top_main([str(hb), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "solve.dispatch" in out and "0.875" in out
+    r = metrics.MetricsRegistry()
+    r.counter("frames_total", status="converged").inc(4)
+    from sartsolver_tpu.obs import sinks
+    prom = tmp_path / "run.prom"
+    prom.write_text(sinks.render_prometheus(r.snapshot()))
+    assert top_main([str(prom), "--once"]) == 0
+    out = capsys.readouterr().out
+    assert "sart_frames_total" in out and "4" in out
+
+
+def test_top_unrecognized_and_missing_paths_fail_in_once_mode(
+        tmp_path, capsys):
+    """--once is the scripting probe: a screen that could not render
+    (missing file, garbage content) must exit 1, not report healthy."""
+    junk = tmp_path / "junk"
+    junk.write_text("what even is this\n")
+    assert top_main([str(junk), "--once"]) == 1
+    assert "unrecognized" in capsys.readouterr().out
+    assert top_main([str(tmp_path / "gone"), "--once"]) == 1
+    assert "gone" in capsys.readouterr().out
+
+
+def test_top_caps_body_lines(tmp_path):
+    r = metrics.MetricsRegistry()
+    for i in range(50):
+        r.gauge(f"g{i:02d}").set(i)
+    from sartsolver_tpu.obs import sinks
+    prom = tmp_path / "big.prom"
+    prom.write_text(sinks.render_prometheus(r.snapshot()))
+    screen = render_top(str(prom), max_lines=10)
+    lines = screen.splitlines()
+    assert len(lines) == 11  # 10 + the "+N more" marker
+    assert "more" in lines[-1]

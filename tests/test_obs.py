@@ -97,8 +97,8 @@ def test_gauge_set_max_is_high_water():
 
 def test_prometheus_families_are_contiguous():
     """All samples of one metric family must form one block under its
-    single # TYPE line, whatever order label-sets registered in (strict
-    scrapers reject interleaved families)."""
+    single # HELP/# TYPE pair, whatever order label-sets registered in
+    (strict scrapers reject interleaved families)."""
     r = metrics.MetricsRegistry()
     r.counter("frames_total", status="converged").inc(3)
     r.gauge("depth").set(1)
@@ -106,8 +106,32 @@ def test_prometheus_families_are_contiguous():
     text = sinks.render_prometheus(r.snapshot())
     lines = text.splitlines()
     fam = [i for i, ln in enumerate(lines) if "sart_frames_total" in ln]
-    assert fam == list(range(fam[0], fam[0] + 3))  # TYPE + 2 samples
+    assert fam == list(range(fam[0], fam[0] + 4))  # HELP + TYPE + 2 samples
     assert lines.count("# TYPE sart_frames_total counter") == 1
+
+
+def test_prometheus_every_family_has_help():
+    """Exposition-format satellite: strict scrapers warn on HELP-less
+    families, so every # TYPE line is immediately preceded by a # HELP
+    line for the same family — curated text for the known metrics, a
+    docs pointer for anything new."""
+    r = metrics.MetricsRegistry()
+    r.counter("frames_total", status="converged").inc(3)
+    r.gauge("prefetch_queue_depth").set(2)
+    r.histogram("frame_solve_ms").observe(12.5)
+    r.counter("retry_success_total", site="hdf5.frame_read").inc()
+    r.counter("somebody_elses_metric").inc()  # fallback text path
+    lines = sinks.render_prometheus(r.snapshot()).splitlines()
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE "):
+            family = line.split()[2]
+            assert i > 0 and lines[i - 1].startswith(f"# HELP {family} "), \
+                f"family {family} has no HELP line"
+            # HELP carries text, not just the name
+            assert len(lines[i - 1].split(" ", 3)[3]) > 4
+    # curated text survives the suffixing of histogram sub-series
+    assert any(ln.startswith("# HELP sart_frame_solve_ms_count ")
+               and "sample count" in ln for ln in lines)
 
 
 def test_registry_merge_semantics():
@@ -377,6 +401,96 @@ def test_diff_bench_artifacts_threshold(tmp_path, capsys):
                          str(new), str(old)]) == 0
 
 
+def _write_artifact(path, records):
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+def test_diff_missing_bench_section_is_loud_skip(tmp_path, capsys):
+    """Edge case: the baseline has a bench section, the new artifact does
+    not (or vice versa). The gate cannot run — and must say so on stderr
+    instead of silently passing as 'no regression'."""
+    with_bench = tmp_path / "with.json"
+    without = tmp_path / "without.json"
+    _write_artifact(with_bench, [schema.make_bench_record(
+        "sart_iter_s", 100.0, "iter/s", 1.0, {})])
+    # a bare summary-less artifact: individually valid records, no bench
+    _write_artifact(without, [{"type": "metric", "kind": "counter",
+                               "name": "frames_total", "labels": {},
+                               "value": 4}])
+    assert metrics_main(["--diff", "--threshold", "5",
+                         str(with_bench), str(without)]) == 0
+    err = capsys.readouterr().err
+    assert "bench section missing from the new artifact" in err
+    assert "gate skipped" in err
+    capsys.readouterr()
+    assert metrics_main(["--diff", "--threshold", "5",
+                         str(without), str(with_bench)]) == 0
+    assert ("bench section missing from the baseline artifact"
+            in capsys.readouterr().err)
+
+
+def test_diff_zero_baseline_rate_is_loud_skip(tmp_path, capsys):
+    """Edge case: a zero-valued baseline rate. No ZeroDivisionError, no
+    silent pass — the ratio gate skips with a note."""
+    zero = tmp_path / "zero.json"
+    live = tmp_path / "live.json"
+    _write_artifact(zero, [schema.make_bench_record(
+        "sart_iter_s", 0.0, "iter/s", 0.0, {})])
+    _write_artifact(live, [schema.make_bench_record(
+        "sart_iter_s", 50.0, "iter/s", 0.5, {})])
+    assert metrics_main(["--diff", "--threshold", "5",
+                         str(zero), str(live)]) == 0
+    assert ("baseline bench headline value is zero"
+            in capsys.readouterr().err)
+
+
+def test_diff_one_sided_histogram_is_loud_skip(world, tmp_path, capsys):
+    """Edge case: a histogram family present in only one artifact (e.g.
+    iterations_to_converge absent because every frame failed) is noted,
+    not compared and not a crash."""
+    paths, *_ = world
+    a = str(tmp_path / "a.jsonl")
+    assert run_cli(paths, "--metrics_out", a) == 0
+    stripped = [r for r in _records(a)
+                if r.get("name") != "iterations_to_converge"]
+    b = tmp_path / "b.jsonl"
+    _write_artifact(b, stripped)
+    capsys.readouterr()
+    assert metrics_main(["--diff", "--threshold", "5", a, str(b)]) == 0
+    err = capsys.readouterr().err
+    assert ("histogram iterations_to_converge missing from the new "
+            "artifact" in err)
+
+
+def test_diff_roofline_gate_trips_on_utilization_drop(tmp_path, capsys):
+    """The tentpole's BENCH gate: detail.roofline mxu/hbm utilization
+    are rates — a drop past the threshold exits 2 even when the raw
+    headline is unchanged (a faster chip can hide an efficiency loss in
+    iter/s; the utilization fraction cannot)."""
+    def bench(mxu, hbm):
+        return [schema.make_bench_record(
+            "sart_iter_s", 100.0, "iter/s", 1.0,
+            {"roofline": {"mxu_util": mxu, "hbm_util": hbm,
+                          "bound": "hbm"}})]
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    _write_artifact(old, bench(0.40, 0.60))
+    _write_artifact(new, bench(0.40, 0.30))  # hbm utilization halved
+    assert metrics_main(["--diff", "--threshold", "10",
+                         str(old), str(new)]) == 2
+    out = capsys.readouterr()
+    assert "roofline hbm_util" in out.out
+    assert "utilization regression" in out.err
+    # same direction but inside the band: passes
+    ok = tmp_path / "ok.json"
+    _write_artifact(ok, bench(0.40, 0.58))
+    assert metrics_main(["--diff", "--threshold", "10",
+                         str(old), str(ok)]) == 0
+    # improvement never trips
+    assert metrics_main(["--diff", "--threshold", "10",
+                         str(new), str(old)]) == 0
+
+
 def test_record_buffers_skipped_when_disabled():
     """With no sink configured the typed record lists must not grow
     (unbounded host memory on long runs); the registry aggregates the
@@ -621,6 +735,43 @@ def test_cli_heartbeat_content(world, tmp_path, monkeypatch):
     content = open(hb).read()
     assert f"frames={base + len(times)}" in content
     assert content.startswith("phase=")
+    # serial path: no scheduler, so no occupancy key leaks in
+    assert "occupancy=" not in content
+
+
+def test_heartbeat_occupancy_when_scheduler_drives(tmp_path, monkeypatch):
+    """Satellite: while the continuous batcher drives, the heartbeat
+    line gains occupancy= and the in-flight lane serials — a supervisor
+    reading it sees lane health, not just a frame counter."""
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("SART_HEARTBEAT_FILE", hb)
+    watchdog.set_sched_status_provider(
+        lambda: {"occupancy": 0.75, "lanes": [3, 7], "strides": 12}
+    )
+    try:
+        watchdog.beacon(watchdog.PHASE_DISPATCH)
+        watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+    finally:
+        watchdog.set_sched_status_provider(None)
+    content = open(hb).read()
+    assert "occupancy=0.750" in content
+    assert "lanes=3,7" in content
+    # still one parseable key=value line
+    assert all("=" in tok for tok in content.split())
+
+
+def test_cli_heartbeat_occupancy_on_sched_path(world, tmp_path,
+                                               monkeypatch):
+    """Through the real CLI: the default batched path is the scheduler,
+    and its heartbeat lines carry the lane view (the last write happens
+    at the final frame's retirement, while the provider is installed)."""
+    paths, *_ = world
+    hb = str(tmp_path / "hb")
+    monkeypatch.setenv("SART_HEARTBEAT_FILE", hb)
+    assert run_cli(paths, "--no_guess", "--batch_frames", "2") == 0
+    content = open(hb).read()
+    assert "occupancy=" in content
+    assert "lanes=" in content
 
 
 # ---------------------------------------------------------------------------
