@@ -85,6 +85,15 @@ def build_lint_parser() -> argparse.ArgumentParser:
     p.add_argument("--severity", default="",
                    help="Per-rule severity overrides, e.g. "
                         "'SL004=error,SL003=off'.")
+    p.add_argument("--select", default="",
+                   help="Comma-separated rule-id prefixes to run, e.g. "
+                        "'SL1' for the concurrency family or "
+                        "'SL001,SL1' to mix ids and families (default: "
+                        "all rules). Lets CI stage a new family without "
+                        "churning existing gates.")
+    p.add_argument("--ignore", default="",
+                   help="Comma-separated rule-id prefixes to skip, e.g. "
+                        "'SL1'; applied after --select.")
     p.add_argument("--json", dest="json_", action="store_true",
                    help="Machine-readable output (findings + audit reports).")
     p.add_argument("--list-rules", action="store_true",
@@ -94,27 +103,71 @@ def build_lint_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _parse_rule_prefixes(spec: str, flag: str, known: set) -> List[str]:
+    """Parse a ``--select``/``--ignore`` prefix list. Each entry must be
+    a rule-id prefix (``SL``, ``SL1``, ``SL101``) matching at least one
+    known rule — a typo'd family that silently selects nothing would
+    make a CI gate vacuous."""
+    from sartsolver_tpu.config import SartInputError
+
+    out: List[str] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if not (part.startswith("SL") and part[2:].isdigit()
+                or part == "SL"):
+            raise SartInputError(
+                f"Unable to parse {flag} entry {part!r}; expected a rule-"
+                "id prefix like 'SL1' or 'SL101'."
+            )
+        if not any(rule_id.startswith(part) for rule_id in known):
+            raise SartInputError(
+                f"{flag} prefix {part!r} matches no known rule; known: "
+                f"{', '.join(sorted(known))}."
+            )
+        out.append(part)
+    return out
+
+
 def lint_main(argv: Optional[List[str]] = None) -> int:
     args = build_lint_parser().parse_args(argv)
 
     from sartsolver_tpu.analysis.rules import ALL_RULES, lint_paths
     from sartsolver_tpu.config import SartInputError, parse_severity_overrides
 
+    known = {rule.id for rule in ALL_RULES}
     try:
         overrides = parse_severity_overrides(args.severity)
-        known = {rule.id for rule in ALL_RULES}
         unknown = sorted(set(overrides) - known)
         if unknown:
             raise SartInputError(
                 f"Unknown rule id(s) in --severity: {', '.join(unknown)}; "
                 f"known rules: {', '.join(sorted(known))}."
             )
+        select = _parse_rule_prefixes(args.select, "--select", known)
+        ignore = _parse_rule_prefixes(args.ignore, "--ignore", known)
     except SartInputError as err:
         print(err, file=sys.stderr)
         return 1
 
+    active_rules = tuple(
+        rule for rule in ALL_RULES
+        if (not select or any(rule.id.startswith(p) for p in select))
+        and not any(rule.id.startswith(p) for p in ignore)
+    )
+    if (select or ignore) and not active_rules:
+        # each prefix was individually valid but their combination
+        # selects nothing (--ignore SL, or --select X --ignore X): a
+        # gate running zero rules would pass forever — same loud-failure
+        # contract as an unknown prefix
+        print("sartsolve lint: --select/--ignore left no rules to run "
+              f"(select={','.join(select) or '-'} "
+              f"ignore={','.join(ignore) or '-'}).", file=sys.stderr)
+        return 1
+
     if args.list_rules:
-        for rule in ALL_RULES:
+        for rule in active_rules:
             print(f"{rule.id} [{rule.severity}] {rule.title}")
             print(f"       fix: {rule.hint}")
         return 0
@@ -135,7 +188,8 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
             paths.append(os.path.dirname(os.path.abspath(
                 sartsolver_tpu.__file__)))
         if paths:
-            findings = lint_paths(paths, severity_overrides=overrides)
+            findings = lint_paths(paths, rules=active_rules,
+                                  severity_overrides=overrides)
 
     # ---- compile audit ---------------------------------------------------
     reports = []
@@ -164,6 +218,12 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
             "audit": [dataclasses.asdict(r) for r in reports],
             "errors": n_err,
             "warnings": n_warn,
+            # which rules actually ran, and why (the --select/--ignore
+            # filters applied): CI staging a new family can assert the
+            # gate saw what it meant to enable
+            "rules": [r.id for r in active_rules],
+            "select": select,
+            "ignore": ignore,
         }, indent=1))
     else:
         for f in findings:

@@ -806,19 +806,29 @@ class BroadExceptDeviceCode(Rule):
                     )
 
 
-ALL_RULES: Tuple[Rule, ...] = (
+JAX_RULES: Tuple[Rule, ...] = (
     TracerControlFlow(), HostSyncInLoop(), ImplicitDtype(),
     MissingDonation(), StaticArgCandidate(), BroadExceptDeviceCode(),
 )
 
+# Filled in at the bottom of this module: JAX_RULES plus the SL1xx
+# concurrency family (analysis/concurrency.py imports the engine from
+# here, so the aggregation has to happen after everything it needs is
+# defined).
+ALL_RULES: Tuple[Rule, ...] = JAX_RULES
+
 
 def lint_source(
     path: str, src: str, *,
-    rules: Sequence[Rule] = ALL_RULES,
+    rules: Optional[Sequence[Rule]] = None,
     severity_overrides: Optional[Dict[str, str]] = None,
 ) -> List[Finding]:
     """Lint one file's source; returns unsuppressed findings in line
-    order. ``severity_overrides`` maps rule id -> severity (or "off")."""
+    order. ``severity_overrides`` maps rule id -> severity (or "off");
+    ``rules=None`` runs the full catalogue (resolved at call time, so
+    the concurrency family registered below is included)."""
+    if rules is None:
+        rules = ALL_RULES
     overrides = severity_overrides or {}
     try:
         model = ModuleModel(path, src)
@@ -881,3 +891,13 @@ def lint_paths(
             continue
         findings.extend(lint_source(f, src, **kw))
     return findings
+
+
+# ---- concurrency family (SL101..) ----------------------------------------
+# Imported last: concurrency.py needs Rule/ModuleModel/Finding from above.
+# Import order is safe either way round — importing concurrency directly
+# first triggers the analysis package __init__, which imports this module
+# before any submodule body runs.
+from sartsolver_tpu.analysis.concurrency import CONCURRENCY_RULES  # noqa: E402
+
+ALL_RULES = JAX_RULES + CONCURRENCY_RULES

@@ -448,7 +448,20 @@ def main(argv: Optional[List[str]] = None) -> int:
     # status poke (pkill -USR1 across a pod must be a read-only query,
     # never fatal). Writes are atomic renames and the record carries the
     # pid — whichever process was poked last owns the file's content.
+    # The handler snapshots with blocking=False: it runs between
+    # bytecodes of the main thread, which may be mid-record_frame
+    # holding the very metric lock a blocking snapshot would wait on
+    # forever (obs/flight.py; the signal-under-lock drill pins this).
     prev_usr1 = obs_flight.install_status_handler(status_path)
+    from sartsolver_tpu.utils import locking
+
+    if locking.debug_enabled():
+        # drill/triage mode (docs/RESILIENCE.md runbook): every named
+        # lock feeds the acquisition-order detector — real per-acquire
+        # cost, so an armed production run should be a conscious choice
+        print("sartsolve: SART_LOCK_DEBUG=1 — lock-order detector armed "
+              "(acquisition-order cycles raise LockOrderViolation; hold "
+              "times land in lock_hold_seconds)", file=sys.stderr)
     abort = {"reason": None}
     if flight_primary:
         watchdog.set_crash_hook(

@@ -10,16 +10,19 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
 
 import numpy as np
+
+from sartsolver_tpu.utils.locking import named_lock
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "sartrt.cpp")
 _SO = os.path.join(_HERE, "libsartrt.so")
 
-_lock = threading.Lock()
+# serializes the one-time build+load; deliberately held across the g++
+# subprocess — a second caller must wait for the build, not race it
+_lock = named_lock("native.build")
 _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 

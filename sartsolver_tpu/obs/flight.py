@@ -39,13 +39,17 @@ import json
 import os
 import signal
 import sys
-import threading
 import time
 from collections import deque
 from typing import List, Optional
 
 from sartsolver_tpu.obs import metrics, schema
 from sartsolver_tpu.resilience import watchdog
+from sartsolver_tpu.utils.locking import (
+    named_lock,
+    stale_read,
+    suppress_instrumentation,
+)
 
 
 class FlightRecorder:
@@ -62,9 +66,9 @@ class FlightRecorder:
                 print(f"sartsolve: ignoring malformed SART_FLIGHT_EVENTS="
                       f"{raw!r} (using 512)", file=sys.stderr)
                 max_events = 512
-        self._ring: deque = deque(maxlen=max(int(max_events), 1))
-        self._lock = threading.Lock()
-        self.total = 0  # appended over the run (ring length is the tail)
+        self._lock = named_lock("obs.flight.ring")
+        self._ring: deque = deque(maxlen=max(int(max_events), 1))  # guarded by: self._lock
+        self.total = 0  # guarded by: self._lock
 
     def record(self, kind: str, **data) -> None:
         entry = {"unix": round(time.time(), 3), "kind": str(kind)}
@@ -80,9 +84,21 @@ class FlightRecorder:
         what the run was doing right before it died."""
         self.record("beacon", phase=phase, serial=serial, tid=ident)
 
-    def snapshot(self) -> List[dict]:
-        with self._lock:
-            return list(self._ring)
+    def snapshot(self, blocking: bool = True) -> List[dict]:
+        """Ring contents, oldest first. ``blocking=False`` is for signal
+        context and the crash hook: a ring lock held by the interrupted
+        (or wedged) thread degrades to a lock-free stale read — the
+        report must never hang on the state it is reporting."""
+        if self._lock.acquire(blocking=blocking):
+            try:
+                return list(self._ring)
+            finally:
+                self._lock.release()
+        # lock-free stale fallback (utils/locking.stale_read)
+        return stale_read(
+            lambda: list(self._ring),  # sart-lint: disable=SL101
+            default=[],
+        )
 
 
 # Module-global active recorder; None = not installed (library callers).
@@ -128,8 +144,16 @@ def default_bundle_path(output_file: str) -> str:
         or f"{output_file}.crash.json"
 
 
-def status_snapshot(**extra) -> dict:
-    """The live one-shot view as a versioned obs ``status`` record."""
+def status_snapshot(blocking: bool = True, **extra) -> dict:
+    """The live one-shot view as a versioned obs ``status`` record.
+
+    ``blocking=False`` is mandatory from signal context (the SIGUSR1
+    handler) and the watchdog crash hook: the metric/ring locks may be
+    held by the very thread the handler interrupted — or by a wedged
+    one — and a blocking acquire there self-deadlocks the run the
+    snapshot was meant to describe. The non-blocking form degrades a
+    held lock to a stale read (pinned by the signal-under-lock drill in
+    ``tests/test_concurrency.py``)."""
     phase, serial, t, _ident = watchdog.last_beacon()
     now = time.monotonic()
     rec = {
@@ -145,7 +169,7 @@ def status_snapshot(**extra) -> dict:
         },
         "beacon_ages": watchdog.beacon_ages(),
         "sched": watchdog.sched_status(),
-        "metrics": metrics.get_registry().snapshot(),
+        "metrics": metrics.get_registry().snapshot(blocking=blocking),
     }
     rec.update(extra)
     return rec
@@ -159,10 +183,10 @@ def _write_json_atomic(path: str, payload: dict) -> None:
     os.replace(tmp, path)
 
 
-def write_status(path: str, **extra) -> dict:
+def write_status(path: str, blocking: bool = True, **extra) -> dict:
     """Snapshot + atomic publish (the SIGUSR1 dump / ``sartsolve top``
     source). Returns the record; raises only OSError from the write."""
-    rec = status_snapshot(**extra)
+    rec = status_snapshot(blocking=blocking, **extra)
     _write_json_atomic(path, rec)
     return rec
 
@@ -177,9 +201,17 @@ def install_status_handler(path: str):
     def handler(_signum, _frame):
         # runs between bytecodes of the main thread: keep it short,
         # allocation-light, and absolutely exception-free — a failed
-        # snapshot must never kill a healthy run
+        # snapshot must never kill a healthy run. blocking=False is
+        # load-bearing: the interrupted bytecode may be inside
+        # record_frame holding a metric lock, and a blocking snapshot
+        # would wait on a lock whose owner cannot run until this
+        # handler returns (self-deadlock; lint rule SL103's hazard).
+        # suppress_instrumentation is the armed-detector half of the
+        # same contract: without it each handler-side lock RELEASE
+        # would record a hold time through a blocking registry acquire
         try:
-            rec = write_status(path)
+            with suppress_instrumentation():
+                rec = write_status(path, blocking=False)
             lb = rec["last_beacon"]
             line = (
                 f"sartsolve status: frames={rec['frames_done']} "
@@ -218,14 +250,33 @@ def write_crash_bundle(path: str, reason: str, summary=None) -> bool:
     a second failure must not mask the first. Returns True when the
     bundle landed."""
     try:
+        # blocking=False throughout (+ detector bookkeeping suppressed,
+        # which would otherwise block in hold-recording on release):
+        # the crash hook fires while the process may be wedged mid-phase
+        # with metric/ring locks held — the bundle settles for a stale
+        # view over hanging alongside it
+        with suppress_instrumentation():
+            return _write_crash_bundle_quiet(path, reason, summary)
+    except Exception as err:  # pragma: no cover - double-fault guard
+        try:
+            print(f"sartsolve: crash-bundle write failed: {err}",
+                  file=sys.stderr)
+        except Exception:
+            pass
+        return False
+
+
+def _write_crash_bundle_quiet(path: str, reason: str, summary) -> bool:
+    try:
         rec = {
             "type": "flight",
             "schema": schema.SCHEMA_VERSION,
             "unix": round(time.time(), 3),
             "pid": os.getpid(),
             "reason": str(reason),
-            "status": status_snapshot(),
-            "ring": _recorder.snapshot() if _recorder is not None else [],
+            "status": status_snapshot(blocking=False),
+            "ring": (_recorder.snapshot(blocking=False)
+                     if _recorder is not None else []),
         }
         if _recorder is not None:
             rec["ring_total"] = _recorder.total

@@ -16,12 +16,24 @@ afterwards. Registration order is preserved — snapshots list instruments
 first-registered-first, which is what gives the phase summary its stable
 insertion ordering; instruments present only on a *remote* host are
 appended in name order during a merge (insertion-then-name).
+
+Concurrency: every lock here comes from
+:func:`sartsolver_tpu.utils.locking.named_lock` (raw ``threading.Lock``
+in production, the lock-order detector under ``SART_LOCK_DEBUG=1``), and
+every ``snapshot`` takes ``blocking=False`` for signal context: the
+SIGUSR1 status handler runs between bytecodes of the main thread, which
+may be mid-``inc``/``observe`` holding the very lock a blocking snapshot
+would wait on forever (a self-deadlock — the hazard lint rule SL103
+exists for). The non-blocking path falls back to a lock-free stale read:
+single-field staleness or a torn multi-field view is acceptable for a
+status dump, a hang is not.
 """
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Iterable, List, Optional, Tuple
+
+from sartsolver_tpu.utils.locking import named_lock, stale_read
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -34,9 +46,22 @@ class _Instrument:
     def __init__(self, name: str, labels: Dict[str, str]):
         self.name = name
         self.labels = {str(k): str(v) for k, v in labels.items()}
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.instrument")
 
-    def snapshot(self) -> dict:
+    def snapshot(self, blocking: bool = True) -> dict:
+        """Instrument state as a JSON-serializable dict. With
+        ``blocking=False`` (signal context) a held lock degrades to a
+        lock-free stale read instead of a self-deadlock."""
+        if self._lock.acquire(blocking=blocking):
+            try:
+                return self._snapshot_locked()
+            finally:
+                self._lock.release()
+        # stale fallback: field reads are GIL-atomic; a torn multi-field
+        # view only mis-states a histogram by one in-flight observation
+        return self._snapshot_locked()
+
+    def _snapshot_locked(self) -> dict:
         raise NotImplementedError
 
     def merge(self, snap: dict) -> None:
@@ -50,7 +75,7 @@ class Counter(_Instrument):
 
     def __init__(self, name: str, labels: Dict[str, str]):
         super().__init__(name, labels)
-        self.value = 0.0
+        self.value = 0.0  # guarded by: self._lock
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
@@ -58,7 +83,7 @@ class Counter(_Instrument):
         with self._lock:
             self.value += amount
 
-    def snapshot(self) -> dict:
+    def _snapshot_locked(self) -> dict:
         return {"kind": self.kind, "name": self.name, "labels": self.labels,
                 "value": self.value}
 
@@ -74,7 +99,7 @@ class Gauge(_Instrument):
 
     def __init__(self, name: str, labels: Dict[str, str]):
         super().__init__(name, labels)
-        self.value = 0.0
+        self.value = 0.0  # guarded by: self._lock
 
     def set(self, value: float) -> None:
         with self._lock:
@@ -90,7 +115,7 @@ class Gauge(_Instrument):
             if value > self.value:
                 self.value = value
 
-    def snapshot(self) -> dict:
+    def _snapshot_locked(self) -> dict:
         return {"kind": self.kind, "name": self.name, "labels": self.labels,
                 "value": self.value}
 
@@ -113,10 +138,10 @@ class Histogram(_Instrument):
 
     def __init__(self, name: str, labels: Dict[str, str]):
         super().__init__(name, labels)
-        self.count = 0
-        self.sum = 0.0
-        self.min: Optional[float] = None
-        self.max: Optional[float] = None
+        self.count = 0  # guarded by: self._lock
+        self.sum = 0.0  # guarded by: self._lock
+        self.min: Optional[float] = None  # guarded by: self._lock
+        self.max: Optional[float] = None  # guarded by: self._lock
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -126,7 +151,7 @@ class Histogram(_Instrument):
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
 
-    def snapshot(self) -> dict:
+    def _snapshot_locked(self) -> dict:
         return {"kind": self.kind, "name": self.name, "labels": self.labels,
                 "count": self.count, "sum": self.sum,
                 "min": self.min, "max": self.max}
@@ -151,13 +176,15 @@ class MetricsRegistry:
     """Thread-safe, insertion-ordered instrument store."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.metrics.registry")
         # dict preserves insertion order — the snapshot/summary ordering
-        self._instruments: Dict[Tuple[str, str, tuple], _Instrument] = {}
+        self._instruments: Dict[Tuple[str, str, tuple], _Instrument] = {}  # guarded by: self._lock
 
     def _get(self, cls, name: str, labels: Dict[str, str]) -> _Instrument:
         key = (cls.kind, name, _label_key(labels))
-        inst = self._instruments.get(key)
+        # double-checked fast path: a dict get is GIL-atomic, and a miss
+        # re-checks under the lock before inserting
+        inst = self._instruments.get(key)  # sart-lint: disable=SL101
         if inst is None:
             with self._lock:
                 inst = self._instruments.get(key)
@@ -179,11 +206,30 @@ class MetricsRegistry:
     def histogram(self, name: str, **labels: str) -> Histogram:
         return self._get(Histogram, name, labels)
 
-    def snapshot(self) -> List[dict]:
-        """Instrument states in registration order (JSON-serializable)."""
-        with self._lock:
-            instruments = list(self._instruments.values())
-        return [inst.snapshot() for inst in instruments]
+    def snapshot(self, blocking: bool = True) -> List[dict]:
+        """Instrument states in registration order (JSON-serializable).
+
+        ``blocking=False`` is the signal-context form (SIGUSR1 status
+        handler, crash bundles): a registry or instrument lock held by
+        the interrupted frame must degrade to a stale read, never a
+        self-deadlock (the lock's owner cannot run until this handler
+        returns)."""
+        if self._lock.acquire(blocking=blocking):
+            try:
+                instruments = list(self._instruments.values())
+            finally:
+                self._lock.release()
+        else:
+            instruments = self._instruments_stale()
+        return [inst.snapshot(blocking=blocking) for inst in instruments]
+
+    def _instruments_stale(self) -> List[_Instrument]:
+        # lock-free listing for signal context (the one stale-fallback
+        # convention: utils/locking.stale_read)
+        return stale_read(
+            lambda: list(self._instruments.values()),  # sart-lint: disable=SL101
+            default=[],
+        )
 
     def merge_snapshot(self, snapshot: Iterable[dict]) -> None:
         """Fold another registry's snapshot into this one (multi-host
@@ -209,7 +255,7 @@ class MetricsRegistry:
 # run (like reset_retry_stats) so artifacts account one run, not the
 # process lifetime; library modules grab handles from it lazily.
 _default = MetricsRegistry()
-_default_lock = threading.Lock()
+_default_lock = named_lock("obs.metrics.default")
 
 
 def get_registry() -> MetricsRegistry:

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from sartsolver_tpu.utils.locking import named_lock
+
 
 class _NullSpan:
     """Shared no-op context manager for the disabled path."""
@@ -80,16 +82,16 @@ class TraceBuffer:
     """
 
     def __init__(self, max_events: Optional[int] = None) -> None:
-        self._lock = threading.Lock()
-        self._events: List[dict] = []
+        self._lock = named_lock("obs.trace.buffer")
+        self._events: List[dict] = []  # guarded by: self._lock
         self._epoch = time.perf_counter()
         self._max = max_events if max_events is not None else int(
             os.environ.get("SART_TRACE_MAX_EVENTS", "1000000")
         )
-        self._dropped = 0
+        self._dropped = 0  # guarded by: self._lock
         # per-thread open phase span from the beacon stream:
         # ident -> (phase, perf_counter at its beacon)
-        self._open: Dict[int, Tuple[str, float]] = {}
+        self._open: Dict[int, Tuple[str, float]] = {}  # guarded by: self._lock
 
     def _us(self, t: float) -> float:
         return (t - self._epoch) * 1e6

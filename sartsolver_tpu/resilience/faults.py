@@ -55,12 +55,13 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import threading
 import time
 import zlib
 from typing import Dict, Optional
 
 import numpy as np
+
+from sartsolver_tpu.utils.locking import named_lock
 
 
 def site_seed(site: str) -> int:
@@ -132,7 +133,7 @@ class _Fault:
 
 # site -> armed fault; None means "not yet initialized from the env".
 _faults: Optional[Dict[str, _Fault]] = None
-_lock = threading.Lock()
+_lock = named_lock("resilience.faults")
 
 
 def parse_fault_spec(spec: str) -> Dict[str, _Fault]:

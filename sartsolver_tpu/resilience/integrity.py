@@ -56,11 +56,12 @@ from __future__ import annotations
 
 import math
 import os
-import threading
 import zlib
 from typing import List, Optional
 
 import numpy as np
+
+from sartsolver_tpu.utils.locking import named_lock
 
 
 class IntegrityError(RuntimeError):
@@ -96,7 +97,7 @@ class StripeDigestError(OSError):
 # ---------------------------------------------------------------------------
 
 _state = {"enabled": None}  # None: not configured, read SART_INTEGRITY
-_lock = threading.Lock()
+_lock = named_lock("resilience.integrity")
 
 
 def configure(enabled: bool) -> None:
@@ -108,9 +109,12 @@ def configure(enabled: bool) -> None:
 
 def env_enabled() -> bool:
     """The ``SART_INTEGRITY`` environment switch alone, ignoring any
-    :func:`configure` call — the ONE copy of the accepted-value list
-    (the CLI folds it into its per-run decision before configuring)."""
-    return os.environ.get("SART_INTEGRITY", "") in ("1", "true", "on")
+    :func:`configure` call (the CLI folds it into its per-run decision
+    before configuring). Accepted values are the shared boolean-switch
+    list (:func:`sartsolver_tpu.utils.env_truthy`)."""
+    from sartsolver_tpu.utils import env_truthy
+
+    return env_truthy("SART_INTEGRITY")
 
 
 def enabled() -> bool:

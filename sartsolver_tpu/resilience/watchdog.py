@@ -187,15 +187,11 @@ def beacon_ages() -> dict:
 
     Worker threads insert first-occurrence phases concurrently; a dict
     iteration racing such an insert raises RuntimeError, which would
-    silently cost the crash bundle its snapshot — retry the copy a few
-    times (each attempt is atomic-or-raises under the GIL)."""
-    items = []
-    for _ in range(4):
-        try:
-            items = list(_last_by_phase.items())
-            break
-        except RuntimeError:  # insert raced the copy; go again
-            continue
+    silently cost the crash bundle its snapshot — the shared
+    ``stale_read`` fallback (utils/locking.py) retries the copy."""
+    from sartsolver_tpu.utils.locking import stale_read
+
+    items = stale_read(lambda: list(_last_by_phase.items()), default=[])
     now = time.monotonic()
     return {
         phase: round(now - t, 3)

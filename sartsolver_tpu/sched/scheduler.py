@@ -212,15 +212,26 @@ class ContinuousBatcher:
     def _live_status(self) -> Optional[dict]:
         """Occupancy + in-flight lane serials for the heartbeat file and
         the SIGUSR1 status snapshot (watchdog.set_sched_status_provider).
-        Reads the run's own bookkeeping under the GIL — cheap enough to
-        run inside every heartbeat write."""
+        Reads the run's own bookkeeping under the GIL, deliberately
+        lock-free — this runs from the heartbeat thread and from signal
+        context, where blocking on the scheduler would be the SL103
+        hazard. The lane listing iterates a dict the main thread mutates
+        per retirement; a racing insert raises RuntimeError, which must
+        not silently cost the snapshot its lane view — bounded retry
+        (each attempt is atomic-or-raises under the GIL), degrading to
+        lanes=None, never an exception out of a status poke."""
+        from sartsolver_tpu.utils.locking import stale_read
+
         occupied = getattr(self, "_occupied", None)
         stats = getattr(self, "_stats", None)
         if occupied is None or stats is None:
             return None
+        lanes = stale_read(
+            lambda: sorted(slot.seq for slot in occupied.values())
+        )
         return {
             "occupancy": round(stats.occupancy, 3),
-            "lanes": sorted(slot.seq for slot in occupied.values()),
+            "lanes": lanes,
             "strides": stats.strides,
             "frames_emitted": stats.frames,
         }

@@ -1,1 +1,13 @@
-"""Host utilities: timing, logging."""
+"""Host utilities: timing, caching, prefetch/async-write workers, and
+the named-lock factory behind the runtime lock-order detector
+(``locking.py``, ``SART_LOCK_DEBUG=1``)."""
+
+import os
+
+
+def env_truthy(name: str) -> bool:
+    """The ONE accepted-value list for boolean ``SART_*`` environment
+    switches (``SART_INTEGRITY``, ``SART_LOCK_DEBUG``): a future change
+    to the accepted spellings must change every switch together, or an
+    operator value accepted by one silently leaves another unarmed."""
+    return os.environ.get(name, "") in ("1", "true", "on")

@@ -1,0 +1,345 @@
+"""Named locks with an optional runtime lock-order detector.
+
+The solver's host side has grown real concurrency the original MPI
+binary never had: a prefetch worker, an async writer, the watchdog
+monitor, a crash-hook thread, signal handlers, and the flight-recorder /
+metrics stores they all touch. Every lock in the package is created
+through :func:`named_lock`, which has two personalities:
+
+- **Production (default)** — ``SART_LOCK_DEBUG`` unset: returns a plain
+  ``threading.Lock``. Zero wrapper, zero bookkeeping, nothing imported
+  beyond the stdlib — traced programs, goldens and the disabled-path
+  byte-identity contract are untouched (pinned by
+  ``tests/test_concurrency.py``).
+- **Debug (``SART_LOCK_DEBUG=1``)** — returns an
+  :class:`_InstrumentedLock` feeding a process-global *acquisition-order
+  graph*: every blocking acquire taken while other named locks are held
+  adds ``held → wanted`` edges (lockdep-style, keyed by lock *name*, so
+  two instances of the same lock class share one node). An acquire whose
+  new edge would close a cycle raises :class:`LockOrderViolation`
+  *before blocking* — the potential deadlock is reported from the order
+  discipline alone, deterministically, without needing the losing
+  interleaving to actually occur. The violation carries both sides'
+  stacks: the acquiring thread's current hold stack and the recorded
+  stack of the thread that established the conflicting edge — and is
+  mirrored into the flight recorder (``lock_order_violation`` event), so
+  a crash bundle from a deadlock drill names the cycle. Releases feed
+  ``lock_hold_seconds{lock=<name>}`` histograms in the obs registry.
+
+The environment is read at lock-*creation* time: module-global locks
+latch the mode at import, instance locks at construction. The detector
+is a drill/triage tool (``make race``, the RESILIENCE.md runbook row),
+not a production mode — each instrumented acquire pays a graph check.
+
+Conventions the detector assumes (and ``sartsolve lint`` SL1xx checks
+statically — docs/STATIC_ANALYSIS.md):
+
+- non-blocking acquires (``acquire(blocking=False)``) skip the order
+  check — an acquire that cannot block cannot deadlock; this is exactly
+  the signal-context snapshot pattern (obs/flight.py, obs/metrics.py);
+- acquiring a lock *named the same* as one already held (the same
+  instance included) is reported as a self-cycle — no code path in this
+  package legitimately nests two locks of one class.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderViolation(RuntimeError):
+    """A blocking acquire would close a cycle in the acquisition-order
+    graph (or re-enter a held lock name): a deadlock is possible under
+    some interleaving, and this thread may be about to realize it."""
+
+
+def debug_enabled() -> bool:
+    """Whether ``SART_LOCK_DEBUG`` arms the detector (read per call; the
+    factory consults it at lock-creation time). Accepted values are the
+    shared boolean-switch list (:func:`sartsolver_tpu.utils.env_truthy`)."""
+    from sartsolver_tpu.utils import env_truthy
+
+    return env_truthy("SART_LOCK_DEBUG")
+
+
+# ---------------------------------------------------------------------------
+# global order-graph state (debug mode only)
+# ---------------------------------------------------------------------------
+
+# The graph's own lock is deliberately a RAW threading.Lock: instrumenting
+# it would recurse, and it is only ever held for dict operations.
+_graph_lock = threading.Lock()
+#: name -> set of names acquired while holding it (observed order edges)
+_graph: Dict[str, Set[str]] = {}
+#: (held_name, acquired_name) -> (thread name, stack text at first sight)
+_edge_info: Dict[Tuple[str, str], Tuple[str, str]] = {}
+
+_tls = threading.local()
+
+
+def _held_stack() -> List[Tuple["_InstrumentedLock", float]]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _in_guard() -> bool:
+    """True while this thread is inside the detector's own bookkeeping
+    (hold-histogram observation, flight-event emission): instrumented
+    locks acquired there behave raw, breaking the obvious recursion
+    (observing a hold time acquires the histogram's lock, whose release
+    would observe a hold time...)."""
+    return getattr(_tls, "guard", False)
+
+
+@contextlib.contextmanager
+def suppress_instrumentation():
+    """Run a block with the detector's bookkeeping disabled on THIS
+    thread: instrumented locks acquire raw, and releases skip the
+    hold-histogram observation.
+
+    Signal-context contract: the SIGUSR1 handler (and the crash-bundle
+    writer, whose process may be wedged) already snapshot with
+    non-blocking acquires — but under ``SART_LOCK_DEBUG=1`` each
+    *release* would otherwise record a hold time through a BLOCKING
+    registry/instrument acquire (``_record_hold``), re-introducing the
+    self-deadlock the non-blocking contract exists to eliminate. The
+    handler wraps itself in this guard instead: in handler context the
+    detector observes nothing and blocks on nothing. Pairing is safe —
+    guard-mode acquires never push onto the hold stack, so their
+    releases pop nothing and the interrupted frame's bookkeeping is
+    untouched."""
+    prev = getattr(_tls, "guard", False)
+    _tls.guard = True
+    try:
+        yield
+    finally:
+        _tls.guard = prev
+
+
+def order_graph() -> Dict[str, Set[str]]:
+    """Copy of the acquisition-order graph (drills/introspection)."""
+    with _graph_lock:
+        return {name: set(succ) for name, succ in _graph.items()}
+
+
+def reset_order_state() -> None:
+    """Drop all recorded edges (test isolation; held-lock bookkeeping is
+    thread-local and not touched)."""
+    with _graph_lock:
+        _graph.clear()
+        _edge_info.clear()
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """A path ``src -> ... -> dst`` in the edge graph, or None.
+
+    Caller holds ``_graph_lock``. Iterative DFS — the graph is tiny (one
+    node per lock *name* in the process), but recursion depth should not
+    depend on drill content.
+    """
+    if src == dst:
+        return [src]
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _graph.get(node, ()):
+            if nxt == dst:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+class _InstrumentedLock:
+    """Debug-mode lock: order tracking + hold-time accounting around a
+    raw ``threading.Lock``. API-compatible with the subset of the raw
+    lock this package uses (``acquire``/``release``/``locked``/context
+    manager)."""
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self._raw = threading.Lock()
+        # release generation: bumped on EVERY release. A hold-stack
+        # entry records the generation it was acquired under; a release
+        # from a different thread (legal for threading.Lock — ownership
+        # handoff) cannot reach the acquirer's thread-local stack, so
+        # its entry would otherwise linger forever, fabricating phantom
+        # order edges and false self-cycle violations. Entries whose
+        # generation no longer matches are dropped lazily.
+        self._gen = 0
+
+    # ---- order discipline ------------------------------------------------
+
+    def _check_order(self, held) -> None:
+        """Raise :class:`LockOrderViolation` if blocking on this lock
+        could deadlock given the edges observed so far; otherwise record
+        the new ``held -> self`` edges. Runs *before* the acquire, so
+        the report fires instead of the deadlock."""
+        # drop entries for locks released since (by another thread):
+        # they are no longer held, whatever this thread's stack says
+        held[:] = [e for e in held if e[2] == e[0]._gen]
+        for lock, _t0, _gen in held:
+            if lock.name == self.name:
+                self._violate(
+                    held, [self.name, self.name],
+                    "re-acquiring a lock name already held by this "
+                    "thread (self-deadlock for the same instance; no "
+                    "package code path legitimately nests two locks of "
+                    "one class)",
+                )
+        with _graph_lock:
+            for lock, _t0, _gen in held:
+                a, b = lock.name, self.name
+                if b in _graph.get(a, ()):
+                    continue  # edge already known
+                back = _find_path(b, a)
+                if back is not None:
+                    cycle = [a] + back  # a -> b -> ... -> a
+                    info = _edge_info.get((back[0], back[1])) \
+                        if len(back) > 1 else None
+                    self._violate(held, cycle, other=info)
+                _graph.setdefault(a, set()).add(b)
+                _edge_info[(a, b)] = (
+                    threading.current_thread().name,
+                    "".join(traceback.format_stack()[:-2]),
+                )
+
+    def _violate(self, held, cycle, reason: str = "", other=None) -> None:
+        names = " -> ".join(cycle)
+        lines = [
+            f"lock-order violation acquiring {self.name!r}: "
+            f"cycle {names}",
+        ]
+        if reason:
+            lines.append(reason)
+        lines.append(
+            f"this thread ({threading.current_thread().name}) holds: "
+            + (", ".join(e[0].name for e in held) or "<none>")
+        )
+        lines.append("this thread's acquire stack:\n"
+                     + "".join(traceback.format_stack()[:-3]))
+        if other is not None:
+            other_thread, other_stack = other
+            lines.append(
+                f"conflicting order established by thread "
+                f"{other_thread!r} at:\n{other_stack}"
+            )
+        msg = "\n".join(lines)
+        # mirror into the flight ring (crash bundles from deadlock
+        # drills carry the cycle) — under the reentrancy guard so the
+        # ring's own instrumented lock behaves raw here
+        _tls.guard = True
+        try:
+            from sartsolver_tpu.obs import flight
+
+            flight.record_event(
+                "lock_order_violation",
+                message=f"cycle {names} acquiring {self.name}",
+                cycle=list(cycle),
+                thread=threading.current_thread().name,
+            )
+        except Exception:
+            pass  # the report must never depend on the ring
+        finally:
+            _tls.guard = False
+        raise LockOrderViolation(msg)
+
+    # ---- lock API --------------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _in_guard():
+            return self._raw.acquire(blocking, timeout)
+        held = _held_stack()
+        if blocking:
+            # a non-blocking acquire cannot deadlock: the signal-context
+            # snapshot paths (obs/flight.py, obs/metrics.py) rely on
+            # exactly that and must not trip the detector
+            self._check_order(held)
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            held.append((self, time.monotonic(), self._gen))
+        return ok
+
+    def release(self) -> None:
+        held = _held_stack()
+        t0 = None
+        for i in range(len(held) - 1, -1, -1):
+            lock, when, gen = held[i]
+            if lock is self and gen == self._gen:
+                t0 = when
+                del held[i]
+                break
+        # bump BEFORE the raw release: the next acquirer (possibly
+        # already blocked) must stamp its entry with the post-release
+        # generation, and any entry left on ANOTHER thread's stack (a
+        # cross-thread handoff released here) becomes stale
+        self._gen += 1
+        self._raw.release()
+        if t0 is not None and not _in_guard():
+            self._record_hold(time.monotonic() - t0)
+
+    def _record_hold(self, dt: float) -> None:
+        _tls.guard = True
+        try:
+            from sartsolver_tpu.obs import metrics
+
+            metrics.get_registry().histogram(
+                "lock_hold_seconds", lock=self.name
+            ).observe(dt)
+        except Exception:
+            pass  # accounting must never hurt the run
+        finally:
+            _tls.guard = False
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<_InstrumentedLock {self.name!r} locked={self.locked()}>"
+
+
+def named_lock(name: str):
+    """A lock for the site ``name`` (dotted, e.g. ``obs.metrics.registry``).
+
+    ``SART_LOCK_DEBUG`` unset: a raw ``threading.Lock`` — zero overhead,
+    nothing recorded. Set: an :class:`_InstrumentedLock` wired into the
+    acquisition-order graph (module docstring). The mode latches at
+    creation time, so module-global locks pick it up at import.
+    """
+    if debug_enabled():
+        return _InstrumentedLock(name)
+    return threading.Lock()
+
+
+def stale_read(fn, attempts: int = 4, default=None):
+    """Bounded lock-free read for signal/crash context.
+
+    The ONE copy of the stale-fallback convention shared by the
+    non-blocking snapshot paths (obs/metrics.py, obs/flight.py) and the
+    scheduler's live-status provider: ``fn`` is a lock-free copy of a
+    container another thread mutates — each attempt is atomic-or-raises
+    under the GIL (an insert/append racing the copy raises
+    ``RuntimeError``) — so retry a few times and settle for ``default``
+    over either a hang or an exception out of a status poke.
+    """
+    for _ in range(attempts):
+        try:
+            return fn()
+        except RuntimeError:  # pragma: no cover - needs a mid-mutate race
+            continue
+    return default  # pragma: no cover - `attempts` consecutive races

@@ -121,6 +121,157 @@ RULE_FIXTURES = {
                 return None
         """,
     ),
+    # ---- concurrency family (docs/STATIC_ANALYSIS.md SL1xx) -------------
+    "SL101": (
+        # TP: attribute declared guarded accessed without the lock
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded by: self._lock
+
+            def bad(self):
+                self._items.append(1)
+        """,
+        # near miss: held via `with`, an acquire-if guard, or a *_locked
+        # helper
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded by: self._lock
+
+            def good(self):
+                with self._lock:
+                    self._items.append(1)
+
+            def snapshot(self):
+                if self._lock.acquire(blocking=False):
+                    try:
+                        return list(self._items)
+                    finally:
+                        self._lock.release()
+                return None
+
+            def _drain_locked(self):
+                return list(self._items)
+        """,
+    ),
+    "SL102": (
+        # TP: blocking sleep inside a lock body
+        """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                time.sleep(1)
+        """,
+        # near miss: the blocking work moved outside the lock body
+        """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def f():
+            with _lock:
+                x = 1
+            time.sleep(1)
+            return x
+        """,
+    ),
+    "SL103": (
+        # TP: a signal handler reaching a blocking `with _lock:` through
+        # a same-module call
+        """
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _snap():
+            with _lock:
+                return 1
+
+        def _handler(signum, frame):
+            _snap()
+
+        signal.signal(signal.SIGUSR1, _handler)
+        """,
+        # near miss: the handler path uses a non-blocking acquire with a
+        # stale fallback (the obs/flight.py pattern)
+        """
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _snap():
+            if _lock.acquire(blocking=False):
+                try:
+                    return 1
+                finally:
+                    _lock.release()
+            return None
+
+        def _handler(signum, frame):
+            _snap()
+
+        signal.signal(signal.SIGUSR1, _handler)
+        """,
+    ),
+    "SL104": (
+        # TP: module global rebound outside the module's lock
+        """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        def reset():
+            global _cache
+            _cache = {}
+        """,
+        # near miss: rebound under the lock
+        """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        def reset():
+            global _cache
+            with _lock:
+                _cache = {}
+        """,
+    ),
+    "SL105": (
+        # TP: Thread without an explicit daemon= choice
+        """
+        import threading
+
+        def start():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+        """,
+        # near miss: explicit daemon
+        """
+        import threading
+
+        def start():
+            t = threading.Thread(target=print, daemon=True)
+            t.start()
+            return t
+        """,
+    ),
 }
 
 
@@ -179,6 +330,223 @@ def test_broad_except_around_device_code_warns():
         """
     )
     assert not [f for f in clean if f.rule == "SL006"]
+
+
+def test_sl101_acquire_guard_covers_body_not_else():
+    """The `if lock.acquire(...):` guard holds the lock only in the `if`
+    BODY; the else branch is the failed-acquire path — a guarded access
+    there is exactly the data race the rule exists for."""
+    findings = _lint_snippet(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded by: self._lock
+
+            def snap(self):
+                if self._lock.acquire(blocking=False):
+                    try:
+                        return list(self._items)
+                    finally:
+                        self._lock.release()
+                else:
+                    return list(self._items)
+        """
+    )
+    hits = [f for f in findings if f.rule == "SL101"]
+    assert len(hits) == 1, findings
+    assert "without" in hits[0].message
+
+
+def test_sl101_declarations_stay_inside_their_class():
+    """Guarded-by declarations must not bleed across nested-class
+    boundaries: an inner class's declaration says nothing about the
+    outer class's same-named attribute (different `self`), and vice
+    versa."""
+    findings = _lint_snippet(
+        """
+        import threading
+
+        class Outer:
+            def __init__(self):
+                self._buf = []  # plain, unguarded
+
+            class Inner:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._buf = []  # guarded by: self._lock
+
+                def bad(self):
+                    return list(self._buf)
+
+            def touch(self):
+                return list(self._buf)  # Outer's _buf: not declared
+        """
+    )
+    hits = [f for f in findings if f.rule == "SL101"]
+    # exactly Inner.bad — never Outer.touch
+    assert len(hits) == 1, findings
+    assert "Inner.bad" in hits[0].message
+
+
+def test_sl101_nested_function_access_reported_once():
+    """A guarded access inside a closure within a method is one finding
+    (attributed to the closure's own pass), not one per enclosing
+    scope."""
+    findings = _lint_snippet(
+        """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded by: self._lock
+
+            def f(self):
+                def g():
+                    return list(self._items)
+                return g
+        """
+    )
+    hits = [f for f in findings if f.rule == "SL101"]
+    assert len(hits) == 1, findings
+
+
+def test_sl103_requires_a_real_signal_import():
+    """Only calls through the stdlib `signal` module (any alias) count
+    as handler registrations — a user-defined pubsub `signal(name,
+    receiver)` helper must not put every receiver's locks at error
+    severity."""
+    pubsub = _lint_snippet(
+        """
+        import threading
+
+        _lock = threading.Lock()
+
+        def signal(name, receiver):
+            return (name, receiver)
+
+        def notify():
+            with _lock:
+                return 1
+
+        signal("frame.done", notify)
+        """
+    )
+    assert not [f for f in pubsub if f.rule == "SL103"], pubsub
+    aliased = _lint_snippet(
+        """
+        import signal as sig
+        import threading
+
+        _lock = threading.Lock()
+
+        def _handler(signum, frame):
+            with _lock:
+                return 1
+
+        sig.signal(sig.SIGUSR1, _handler)
+        """
+    )
+    assert [f for f in aliased if f.rule == "SL103"], aliased
+
+
+def test_sl104_scoped_per_function():
+    """A nested function is its own scope: a same-named LOCAL must not
+    be flagged via the enclosing function's `global`, and a nested
+    function's own unlocked global rebind is exactly one finding."""
+    findings = _lint_snippet(
+        """
+        import threading
+
+        _lock = threading.Lock()
+        _cache = {}
+
+        def outer():
+            global _cache
+            with _lock:
+                _cache = {}
+
+            def helper():
+                _cache = {"local": True}  # helper's local, not the global
+                return _cache
+
+            return helper
+
+        def maker():
+            def inner():
+                global _cache
+                _cache = {}  # one defect
+            return inner
+        """
+    )
+    hits = [f for f in findings if f.rule == "SL104"]
+    assert len(hits) == 1, findings
+
+
+def test_sl102_nested_locks_one_finding_per_call():
+    """A single blocking call under nested locks is one finding, not one
+    per enclosing `with` (suppressing it must cost one comment)."""
+    findings = _lint_snippet(
+        """
+        import threading
+        import time
+
+        _a_lock = threading.Lock()
+        _b_lock = threading.Lock()
+
+        def f():
+            with _a_lock:
+                with _b_lock:
+                    time.sleep(1)
+        """
+    )
+    assert len([f for f in findings if f.rule == "SL102"]) == 1, findings
+
+
+def test_acquire_guard_must_be_the_direct_test():
+    """A negated guard selects its body on the FAILED acquire, and a
+    compound test may not evaluate the acquire at all — neither body is
+    lock-held. SL101 must flag the guarded access on the failed-acquire
+    path; SL102 must NOT flag blocking work there."""
+    findings = _lint_snippet(
+        """
+        import threading
+        import time
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = []  # guarded by: self._lock
+
+            def bad_negated(self):
+                if not self._lock.acquire(blocking=False):
+                    return list(self._items)
+                try:
+                    return list(self._items)  # sart-lint: disable=SL101
+                finally:
+                    self._lock.release()
+
+            def bad_compound(self, flag):
+                if flag and self._lock.acquire(blocking=False):
+                    return list(self._items)
+                return None
+
+        _mlock = threading.Lock()
+
+        def backoff():
+            if not _mlock.acquire(blocking=False):
+                time.sleep(0.1)  # lock NOT held: fine
+                return False
+            _mlock.release()
+            return True
+        """
+    )
+    sl101 = [f for f in findings if f.rule == "SL101"]
+    assert len(sl101) == 2, findings  # both non-held reads flagged
+    assert not [f for f in findings if f.rule == "SL102"]
 
 
 def test_inline_suppression_and_severity_override():
@@ -620,3 +988,116 @@ def test_lint_cli_json_output(tmp_path, capsys):
     assert rc == 0  # warnings don't fail
     assert payload["warnings"] == 1
     assert payload["findings"][0]["rule"] == "SL003"
+
+
+# ---------------------------------------------------------------------------
+# --select / --ignore rule-family filters (CI staging knob)
+# ---------------------------------------------------------------------------
+
+# seeds one SL003 (jnp ctor without dtype) and one SL102 (sleep under
+# lock): one finding per family, so the filters' effect is observable
+_TWO_FAMILY_SRC = _HEADER + textwrap.dedent(
+    """
+    import threading
+    import time
+
+    _lock = threading.Lock()
+
+    def b(n):
+        with _lock:
+            time.sleep(1)
+        return jnp.zeros((n, 4))
+    """
+)
+
+
+def test_lint_select_and_ignore_family_filters(tmp_path, capsys):
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    f = tmp_path / "m.py"
+    f.write_text(_TWO_FAMILY_SRC)
+
+    def rules_found(argv):
+        rc = lint_main(argv + ["--json"])
+        payload = json.loads(capsys.readouterr().out)
+        return rc, payload
+
+    _, both = rules_found([str(f)])
+    assert {x["rule"] for x in both["findings"]} == {"SL003", "SL102"}
+    assert both["select"] == [] and both["ignore"] == []
+
+    _, sl1 = rules_found([str(f), "--select", "SL1"])
+    assert {x["rule"] for x in sl1["findings"]} == {"SL102"}
+    assert sl1["select"] == ["SL1"]
+    # the metadata names exactly the rules that ran: staged-gate CI can
+    # assert the family it meant to enable was actually in effect
+    assert sl1["rules"] == ["SL101", "SL102", "SL103", "SL104", "SL105"]
+
+    _, ignored = rules_found([str(f), "--ignore", "SL1"])
+    assert {x["rule"] for x in ignored["findings"]} == {"SL003"}
+    assert ignored["ignore"] == ["SL1"]
+    assert not any(r.startswith("SL1") for r in ignored["rules"])
+
+    _, mixed = rules_found([str(f), "--select", "SL003,SL1",
+                            "--ignore", "SL104"])
+    assert {x["rule"] for x in mixed["findings"]} == {"SL003", "SL102"}
+    assert "SL104" not in mixed["rules"] and "SL103" in mixed["rules"]
+
+
+def test_lint_select_filters_list_rules(capsys):
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    assert lint_main(["--list-rules", "--select", "SL1"]) == 0
+    out = capsys.readouterr().out
+    assert "SL101" in out and "SL105" in out
+    assert "SL001" not in out
+
+
+def test_lint_rejects_vacuous_family_prefix(capsys):
+    """A typo'd family that matches nothing must fail loudly — a gate
+    silently selecting zero rules would pass forever."""
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    assert lint_main(["--list-rules", "--select", "SL9"]) == 1
+    assert "SL9" in capsys.readouterr().err
+    assert lint_main(["--list-rules", "--ignore", "bogus"]) == 1
+    assert "bogus" in capsys.readouterr().err
+
+
+def test_lint_rejects_filter_combination_selecting_nothing(capsys):
+    """Individually-valid prefixes whose combination leaves zero rules
+    (ignore-everything, or select and ignore the same family) are the
+    same vacuous gate — loud exit 1, not a forever-green lint."""
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    assert lint_main(["--list-rules", "--ignore", "SL"]) == 1
+    assert "no rules to run" in capsys.readouterr().err
+    assert lint_main(["--list-rules", "--select", "SL1",
+                      "--ignore", "SL1"]) == 1
+    assert "no rules to run" in capsys.readouterr().err
+
+
+def test_sl102_fires_inside_acquire_guard_body_only():
+    """The acquire-`if` form holds the lock in its body — blocking work
+    there is flagged like a `with` body; the else branch (failed
+    acquire) is not."""
+    flagged = _lint_snippet(
+        """
+        import threading
+        import time
+
+        _lock = threading.Lock()
+
+        def f():
+            if _lock.acquire(blocking=False):
+                try:
+                    time.sleep(1)
+                finally:
+                    _lock.release()
+            else:
+                time.sleep(2)
+        """
+    )
+    hits = [f for f in flagged if f.rule == "SL102"]
+    assert len(hits) == 1, flagged
+    assert "acquire" in hits[0].message

@@ -1,0 +1,527 @@
+"""Concurrency drills: the lock-order detector and the races it guards.
+
+Three layers (docs/STATIC_ANALYSIS.md SL1xx, docs/RESILIENCE.md runbook):
+
+- ``utils/locking.py`` unit drills — disabled-path identity (a raw
+  ``threading.Lock``, zero bookkeeping), the armed detector's
+  acquisition-order graph, the deadlock-injection drill that must trip
+  :class:`LockOrderViolation` (with both threads' stacks and a flight-
+  ring event), and hold-time histograms in obs.
+- race drills over the real shared stores, run under the armed detector
+  (``SART_LOCK_DEBUG=1``): metrics-registry and flight-ring hammers, the
+  prefetcher's close-vs-blocked-worker-put race, and the async writer's
+  error-latch vs a concurrent flush.
+- the signal-under-lock drill pinning the SIGUSR1 fix: a status poke
+  landing while the main thread holds a metric/ring lock (mid-
+  ``record_frame``) must complete via the non-blocking stale-snapshot
+  path — with the old blocking snapshot this drill deadlocks.
+
+Plus the lint wall-time budget: the SL1xx call-graph pass must keep the
+package AST lint under 10 s.
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sartsolver_tpu.obs import flight as obs_flight
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.utils import locking
+from sartsolver_tpu.utils.locking import LockOrderViolation, named_lock
+
+
+@pytest.fixture
+def lock_debug(monkeypatch):
+    """Arm the detector and hand back a fresh registry whose instrument
+    locks are instrumented (the mode latches at lock creation, so the
+    registry must be built after the env is set). Restores a raw-lock
+    registry afterwards so later tests keep the production shape."""
+    monkeypatch.setenv("SART_LOCK_DEBUG", "1")
+    locking.reset_order_state()
+    registry = obs_metrics.reset_registry()
+    yield registry
+    monkeypatch.delenv("SART_LOCK_DEBUG")
+    locking.reset_order_state()
+    obs_metrics.reset_registry()
+
+
+# ---------------------------------------------------------------------------
+# named_lock: disabled path
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("value", ["1", "true", "on"])
+def test_debug_switch_shares_the_boolean_env_vocabulary(monkeypatch, value):
+    """SART_LOCK_DEBUG accepts exactly the shared boolean-switch
+    spellings (utils.env_truthy — same list as SART_INTEGRITY); a
+    divergent vocabulary would leave an operator's value armed on one
+    switch and silently ignored on another."""
+    from sartsolver_tpu.resilience import integrity
+
+    monkeypatch.setenv("SART_LOCK_DEBUG", value)
+    monkeypatch.setenv("SART_INTEGRITY", value)
+    assert locking.debug_enabled()
+    assert integrity.env_enabled()
+    monkeypatch.setenv("SART_LOCK_DEBUG", "yes")  # not in the vocabulary
+    assert not locking.debug_enabled()
+
+
+def test_disabled_path_returns_raw_lock(monkeypatch):
+    """Zero-overhead contract: with SART_LOCK_DEBUG unset the factory
+    hands back a plain threading.Lock — no wrapper object, and using it
+    grows no order-graph state."""
+    monkeypatch.delenv("SART_LOCK_DEBUG", raising=False)
+    locking.reset_order_state()
+    lock = named_lock("drill.raw")
+    assert type(lock) is type(threading.Lock())
+    with lock:
+        pass
+    assert locking.order_graph() == {}
+    assert not locking.debug_enabled()
+
+
+def test_production_lock_sites_are_raw_by_default():
+    """The migrated sites (metrics registry/instruments, flight ring)
+    latch the production personality when the env is unset at
+    construction — the tier-1 environment never pays detector cost."""
+    assert not locking.debug_enabled()
+    raw = type(threading.Lock())
+    registry = obs_metrics.MetricsRegistry()
+    assert type(registry._lock) is raw
+    assert type(registry.counter("drill_raw_total")._lock) is raw
+    assert type(obs_flight.FlightRecorder(max_events=8)._lock) is raw
+
+
+# ---------------------------------------------------------------------------
+# named_lock: armed detector
+# ---------------------------------------------------------------------------
+
+
+def test_instrumented_lock_basics(lock_debug):
+    lock = named_lock("drill.basic")
+    assert isinstance(lock, locking._InstrumentedLock)
+    assert not lock.locked()
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+    assert lock.acquire(blocking=False)
+    assert not lock.acquire(blocking=False)  # held -> False, no raise
+    lock.release()
+
+
+def test_hold_time_histogram_lands_in_obs(lock_debug):
+    lock = named_lock("drill.hold")
+    with lock:
+        time.sleep(0.01)
+    snaps = [s for s in lock_debug.snapshot()
+             if s["name"] == "lock_hold_seconds"
+             and s["labels"].get("lock") == "drill.hold"]
+    assert len(snaps) == 1
+    assert snaps[0]["count"] == 1
+    assert snaps[0]["sum"] >= 0.01
+
+
+def test_order_graph_records_nesting(lock_debug):
+    a, b = named_lock("drill.outer"), named_lock("drill.inner")
+    with a:
+        with b:
+            pass
+    assert "drill.inner" in locking.order_graph().get("drill.outer", set())
+
+
+def test_deadlock_injection_drill_trips_detector(lock_debug):
+    """The acceptance drill: thread 1 establishes A->B, the main thread
+    then acquires B->A — a cycle that would deadlock under the losing
+    interleaving. The detector must trip at acquire time (before
+    blocking), name the cycle, carry both threads' stacks, and drop a
+    lock_order_violation event into the flight ring."""
+    ring = obs_flight.install(obs_flight.FlightRecorder(max_events=64))
+    try:
+        a, b = named_lock("drill.A"), named_lock("drill.B")
+
+        def establish():
+            with a:
+                with b:
+                    pass
+
+        t = threading.Thread(target=establish, name="drill-establisher",
+                             daemon=True)
+        t.start()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+        with b:
+            with pytest.raises(LockOrderViolation) as exc:
+                a.acquire()
+        msg = str(exc.value)
+        assert "drill.A" in msg and "drill.B" in msg
+        assert "this thread's acquire stack" in msg
+        assert "drill-establisher" in msg  # the other side's stack rode along
+        events = [e for e in ring.snapshot()
+                  if e["kind"] == "lock_order_violation"]
+        assert events and "drill.A" in events[0]["message"]
+        assert events[0]["cycle"][0] == events[0]["cycle"][-1]
+    finally:
+        obs_flight.uninstall()
+
+
+def test_same_name_reacquire_is_a_violation(lock_debug):
+    """Re-acquiring a held lock name is a self-deadlock for the same
+    instance (threading.Lock is not reentrant) and an order hazard for
+    two instances of one class — both trip."""
+    lock = named_lock("drill.self")
+    with lock:
+        with pytest.raises(LockOrderViolation):
+            lock.acquire()
+    # released cleanly by the with-exit; usable again
+    with lock:
+        pass
+
+
+def test_cross_thread_release_leaves_no_phantom_hold(lock_debug):
+    """threading.Lock allows release from another thread (ownership
+    handoff); the acquirer's thread-local hold entry can't be popped
+    from there, so it is invalidated by generation instead — no false
+    self-cycle on re-acquire, no phantom order edges afterwards."""
+    lock = named_lock("drill.handoff")
+    other = named_lock("drill.handoff.other")
+    assert lock.acquire()
+    t = threading.Thread(target=lock.release, daemon=True)
+    t.start()
+    t.join(timeout=5)
+    assert not lock.locked()
+    with lock:  # would be a false self-cycle with a phantom entry
+        pass
+    with other:  # would record a phantom handoff->other edge
+        pass
+    assert "drill.handoff.other" not in \
+        locking.order_graph().get("drill.handoff", set())
+
+
+def test_nonblocking_acquire_skips_order_check(lock_debug):
+    """acquire(blocking=False) cannot deadlock, so the signal-context
+    snapshot pattern must not trip the detector even against the
+    recorded order."""
+    a, b = named_lock("drill.nb.A"), named_lock("drill.nb.B")
+    with a:
+        with b:
+            pass
+    with b:
+        assert a.acquire(blocking=False)  # A->B recorded; no violation
+        a.release()
+
+
+# ---------------------------------------------------------------------------
+# race drills over the real shared stores (armed detector)
+# ---------------------------------------------------------------------------
+
+
+def _hammer(n_threads, worker):
+    errors = []
+
+    def run(k):
+        try:
+            worker(k)
+        except BaseException as err:  # noqa: BLE001 - drills collect all
+            errors.append(err)
+
+    threads = [threading.Thread(target=run, args=(k,), daemon=True)
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert not errors, errors
+
+
+def test_metrics_registry_hammer(lock_debug):
+    """8 writers inc/observe/set against concurrent blocking and
+    non-blocking snapshots: no violation, no lost counts."""
+    registry = lock_debug
+    per_thread = 200
+
+    def worker(k):
+        c = registry.counter("hammer_total", thread=str(k))
+        h = registry.histogram("hammer_seconds")
+        g = registry.gauge("hammer_depth")
+        for i in range(per_thread):
+            c.inc()
+            h.observe(0.001 * i)
+            g.set_max(i)
+            if i % 50 == 0:
+                registry.snapshot()
+                registry.snapshot(blocking=False)
+
+    _hammer(8, worker)
+    snaps = registry.snapshot()
+    total = sum(s["value"] for s in snaps if s["name"] == "hammer_total")
+    assert total == 8 * per_thread
+    hist = next(s for s in snaps if s["name"] == "hammer_seconds")
+    assert hist["count"] == 8 * per_thread
+
+
+def test_flight_ring_hammer(lock_debug):
+    """8 recorders against concurrent snapshots on a bounded ring: the
+    total survives, every snapshot is a valid list."""
+    ring = obs_flight.FlightRecorder(max_events=128)
+    per_thread = 300
+
+    def worker(k):
+        for i in range(per_thread):
+            ring.record("drill", thread=k, i=i)
+            if i % 60 == 0:
+                assert isinstance(ring.snapshot(), list)
+                assert isinstance(ring.snapshot(blocking=False), list)
+
+    _hammer(8, worker)
+    assert ring.total == 8 * per_thread
+    tail = ring.snapshot()
+    assert len(tail) == 128  # bounded: ring keeps the newest
+
+
+class _FakeComposite:
+    """Minimal composite for prefetcher drills: no HDF5, tunable read
+    latency so the worker can be caught blocked on a full queue."""
+
+    def __init__(self, n=64, delay=0.0):
+        self._n = n
+        self._delay = delay
+
+    def __len__(self):
+        return self._n
+
+    def frame(self, i):
+        if self._delay:
+            time.sleep(self._delay)
+        return np.full(16, float(i), np.float64)
+
+    def frame_time(self, i):
+        return float(i)
+
+    def camera_frame_time(self, i):
+        return [float(i)]
+
+
+def test_prefetcher_close_vs_blocked_put(lock_debug):
+    """The known-delicate worker race, under the armed detector: close()
+    while the worker is blocked putting into the full depth-1 queue must
+    release the thread (no deadlock, no violation)."""
+    from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+    pf = FramePrefetcher(_FakeComposite(n=64), depth=1)
+    deadline = time.monotonic() + 10
+    while pf._queue.qsize() < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)  # worker fills the queue, then blocks in put
+    assert pf._queue.qsize() >= 1
+    pf.close()
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_consume_all_under_detector(lock_debug):
+    """Full stream drain with the armed detector: the worker's metric
+    updates and beacons run instrumented end-to-end."""
+    from sartsolver_tpu.utils.prefetch import FramePrefetcher
+
+    with FramePrefetcher(_FakeComposite(n=16), depth=2) as frames:
+        got = list(frames)
+    assert len(got) == 16
+    assert [item[1] for item in got] == [float(i) for i in range(16)]
+
+
+class _LatchTestWriter:
+    """Wrapped writer whose second add fails after a real delay — wide
+    window for a concurrent close() to overlap the failing write."""
+
+    def __init__(self):
+        self.added = 0
+        self.closed = False
+
+    def add(self, solution, *rest):
+        self.added += 1
+        if self.added == 2:
+            time.sleep(0.05)
+            raise OSError("injected: output filesystem gone")
+
+    def close(self):
+        self.closed = True
+
+
+def test_asyncwriter_error_latch_vs_concurrent_flush(lock_debug):
+    """The second known-delicate race: the worker latches a write error
+    while the producer is mid-flush (close). The latch must surface as
+    the chained DeferredWriteError from close(), the worker must be
+    joined, and the wrapped writer closed — no deadlock, no violation."""
+    from sartsolver_tpu.utils.asyncwriter import (
+        AsyncSolutionWriter,
+        DeferredWriteError,
+    )
+
+    inner = _LatchTestWriter()
+    w = AsyncSolutionWriter(inner, max_pending=8)
+    sol = np.zeros(8, np.float64)
+    for i in range(4):
+        w.add(sol, 0, float(i), [float(i)])
+    with pytest.raises(DeferredWriteError) as exc:
+        w.close()
+    assert isinstance(exc.value.__cause__, OSError)
+    assert not w._thread.is_alive()
+    assert inner.closed
+    assert inner.added == 2  # the latch wrote nothing after the failure
+
+
+# ---------------------------------------------------------------------------
+# the signal-under-lock drill (SIGUSR1 fix pin)
+# ---------------------------------------------------------------------------
+
+
+needs_sigusr1 = pytest.mark.skipif(
+    not hasattr(signal, "SIGUSR1"), reason="platform has no SIGUSR1"
+)
+
+
+@needs_sigusr1
+def test_sigusr1_under_instrument_lock_completes(tmp_path):
+    """A SIGUSR1 landing while the main thread holds a metric lock —
+    exactly a signal mid-record_frame — must produce a status file via
+    the non-blocking stale-snapshot path. With the old blocking
+    snapshot this drill self-deadlocks (the handler waits on a lock
+    whose owner resumes only after the handler returns)."""
+    registry = obs_metrics.reset_registry()
+    counter = registry.counter("drill_signal_total")
+    counter.inc(7)
+    path = str(tmp_path / "status.json")
+    prev = obs_flight.install_status_handler(path)
+    try:
+        counter._lock.acquire()  # the interrupted bytecode's lock
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            time.sleep(0)  # a bytecode boundary: the handler runs here
+        finally:
+            counter._lock.release()
+    finally:
+        obs_flight.uninstall_status_handler(prev)
+        obs_metrics.reset_registry()
+    with open(path) as fh:
+        rec = json.load(fh)
+    assert rec["type"] == "status"
+    # the stale read still carried the value (GIL-atomic field read)
+    vals = [m["value"] for m in rec["metrics"]
+            if m["name"] == "drill_signal_total"]
+    assert vals == [7.0]
+
+
+@needs_sigusr1
+def test_sigusr1_under_registry_lock_completes(tmp_path):
+    """Same drill against the registry-level lock (a signal landing
+    mid-instrument-registration)."""
+    registry = obs_metrics.reset_registry()
+    registry.counter("drill_reg_total").inc()
+    path = str(tmp_path / "status.json")
+    prev = obs_flight.install_status_handler(path)
+    try:
+        registry._lock.acquire()
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            time.sleep(0)
+        finally:
+            registry._lock.release()
+    finally:
+        obs_flight.uninstall_status_handler(prev)
+        obs_metrics.reset_registry()
+    rec = json.load(open(path))
+    assert rec["type"] == "status"
+    assert any(m["name"] == "drill_reg_total" for m in rec["metrics"])
+
+
+@needs_sigusr1
+def test_sigusr1_under_armed_detector_completes(tmp_path, lock_debug):
+    """The armed-detector half of the signal-under-lock contract: with
+    SART_LOCK_DEBUG=1 every handler-side lock RELEASE would record a
+    hold time through a blocking registry acquire — if the interrupted
+    bytecode holds the registry lock, that blocks forever. The handler
+    suppresses detector bookkeeping, so the poke completes even with
+    the registry lock held by the interrupted frame."""
+    registry = lock_debug
+    registry.counter("drill_armed_total").inc(3)
+    path = str(tmp_path / "status.json")
+    prev = obs_flight.install_status_handler(path)
+    try:
+        registry._lock.acquire()  # instrumented: held by "the frame"
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            time.sleep(0)  # handler runs here, detector armed
+        finally:
+            registry._lock.release()
+    finally:
+        obs_flight.uninstall_status_handler(prev)
+    rec = json.load(open(path))
+    assert rec["type"] == "status"
+    assert any(m["name"] == "drill_armed_total" for m in rec["metrics"])
+
+
+def test_crash_bundle_under_ring_lock_completes(tmp_path):
+    """The crash hook fires while the process may be wedged holding the
+    flight-ring lock; the bundle write must settle for a stale ring,
+    not hang alongside the wedge."""
+    ring = obs_flight.install(obs_flight.FlightRecorder(max_events=32))
+    try:
+        ring.record("drill", message="before the wedge")
+        path = str(tmp_path / "crash.json")
+        ring._lock.acquire()
+        try:
+            assert obs_flight.write_crash_bundle(path, "drill wedge")
+        finally:
+            ring._lock.release()
+        rec = json.load(open(path))
+        assert rec["type"] == "flight"
+        assert rec["reason"] == "drill wedge"
+        assert any(e["kind"] == "drill" for e in rec["ring"])
+    finally:
+        obs_flight.uninstall()
+
+
+def test_nonblocking_snapshot_values_match_blocking():
+    """The stale fallback is a degraded *path*, not degraded data: with
+    no contention both forms must agree exactly."""
+    registry = obs_metrics.MetricsRegistry()
+    registry.counter("eq_total").inc(3)
+    registry.histogram("eq_seconds").observe(0.5)
+    registry.gauge("eq_depth").set(2)
+    assert registry.snapshot() == registry.snapshot(blocking=False)
+
+
+# ---------------------------------------------------------------------------
+# lint integration: SL1xx on the package, wall-time budget
+# ---------------------------------------------------------------------------
+
+
+def test_package_self_lint_clean_with_only_sl1xx():
+    """Acceptance: the package self-lint passes with SL101–SL105 enabled
+    — run the concurrency family alone so a regression in it cannot
+    hide behind the SL0xx catalogue."""
+    import sartsolver_tpu
+    from sartsolver_tpu.analysis.concurrency import CONCURRENCY_RULES
+    from sartsolver_tpu.analysis.rules import lint_paths
+
+    pkg = os.path.dirname(os.path.abspath(sartsolver_tpu.__file__))
+    findings = lint_paths([pkg], rules=CONCURRENCY_RULES)
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_lint_walltime_budget():
+    """The SL103 call-graph pass rides inside every `sartsolve lint`:
+    the package AST lint (all families) must stay under 10 s."""
+    import sartsolver_tpu
+    from sartsolver_tpu.analysis.rules import lint_paths
+
+    pkg = os.path.dirname(os.path.abspath(sartsolver_tpu.__file__))
+    t0 = time.perf_counter()
+    lint_paths([pkg])
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 10.0, f"package AST lint took {elapsed:.1f}s"
