@@ -502,8 +502,9 @@ def _worker_main() -> int:
 
     converge_state: dict = {}
 
-    def run_converge(log_variant: bool) -> dict:
-        """Time-to-converge on a realistic banded+background response."""
+    def ensure_converge_state() -> None:
+        """Build the realistic banded+background convergence problem
+        shared by the converge and tts items (one staging)."""
         if not converge_state:
             # 1-D second-difference Laplacian over the voxel axis (the
             # shape of the reference's regularizer; laplacian.cpp stores
@@ -537,6 +538,10 @@ def _worker_main() -> int:
             dens, length = compute_ray_stats(rtm, dtype=jnp.float32)
             converge_state["problem"] = SARTProblem(
                 rtm, dens, length, converge_state["lap"])
+
+    def run_converge(log_variant: bool) -> dict:
+        """Time-to-converge on a realistic banded+background response."""
+        ensure_converge_state()
         opts = SolverOptions(
             max_iterations=2000, conv_tolerance=1e-5,
             beta_laplace=2.0e-2, logarithmic=log_variant,
@@ -563,6 +568,90 @@ def _worker_main() -> int:
             "iterations": int(res.iterations[0]),
             "status": int(res.status[0]),
         }
+
+    def run_tts(log_variant: bool) -> dict:
+        """Time-to-solution of the convergence accelerators (ISSUE 10,
+        docs/PERFORMANCE.md §9): the converge item's realistic response
+        solved at the SAME stall tolerance with acceleration off vs the
+        recommended per-variant accel config — ordered subsets + Nesterov
+        momentum for the slow multiplicative log path, ordered subsets +
+        relaxation decay for the linear path (the §9 variant matrix:
+        momentum alone stalls the additive update early at a worse data
+        fit, while the OS cycle with a mild decay reaches the baseline's
+        fit ~3.6x sooner). Reports wall-ms AND iterations for both; the
+        iteration speedup is what `sartsolve metrics --diff --threshold`
+        gates (iter/s alone would miss a convergence regression
+        entirely). Parity-gated: both solves are eps-stationary points of
+        one problem, so the accelerated one must either coincide with the
+        unaccelerated stall point in solution space (rel L2 <= 0.05) or
+        fit the measurement as well (data-space residual within 20% — on
+        an underdetermined system two stall points can differ in
+        null-space components while fitting the data identically)."""
+        ensure_converge_state()
+        accel_kw = (dict(os_subsets=4, momentum="nesterov")
+                    if log_variant
+                    else dict(os_subsets=4, relaxation_decay=0.95))
+        problem = converge_state["problem"]
+        g_dev = jnp.asarray(converge_state["g_n"][None, :])
+        msq_dev = jnp.asarray([converge_state["msq"]], jnp.float32)
+        f0 = jnp.zeros((1, V), jnp.float32)
+
+        def solve(**kw):
+            opts = SolverOptions(
+                max_iterations=2000, conv_tolerance=1e-5,
+                beta_laplace=2.0e-2, logarithmic=log_variant, **kw,
+            )
+
+            def run():
+                return solve_normalized_batch(
+                    problem, g_dev, msq_dev, f0, opts=opts,
+                    axis_name=None, voxel_axis=None, use_guess=True,
+                )
+
+            res = run()  # compile
+            np.asarray(res.solution)
+            t_run = time.perf_counter()
+            res = run()
+            sol = np.asarray(res.solution)[0]
+            wall = time.perf_counter() - t_run
+            return sol, int(res.iterations[0]), int(res.status[0]), wall
+
+        sol_b, it_b, st_b, wall_b = solve()
+        sol_a, it_a, st_a, wall_a = solve(**accel_kw)
+        denom = float(np.linalg.norm(sol_b)) or 1.0
+        rel = float(np.linalg.norm(sol_a - sol_b)) / denom
+
+        def resid(sol):
+            # data-space residual on device (an fp64 host matmul would
+            # double-materialize the RTM at real shapes)
+            fit = jnp.matmul(problem.rtm, jnp.asarray(sol, jnp.float32))
+            return float(jnp.linalg.norm(jnp.asarray(
+                converge_state["g_n"]) - fit))
+
+        r_b, r_a = resid(sol_b), resid(sol_a)
+        resid_ratio = r_a / max(r_b, 1e-30)
+        parity = (st_b == 0 and st_a == 0
+                  and (rel <= 0.05 or resid_ratio <= 1.2))
+        out = {
+            "accel": accel_kw,
+            "iters_base": it_b, "iters_accel": it_a,
+            "iter_speedup": round(it_b / max(it_a, 1), 2),
+            "wall_ms_base": round(wall_b * 1e3, 1),
+            "wall_ms_accel": round(wall_a * 1e3, 1),
+            "wall_speedup": round(wall_b / max(wall_a, 1e-9), 2),
+            "status_base": st_b, "status_accel": st_a,
+            "sol_rel_diff": round(rel, 4),
+            "resid_ratio": round(resid_ratio, 3),
+            "parity": parity,
+        }
+        if not parity:
+            out["error"] = (
+                "parity FAILED: accelerated solve landed away from the "
+                f"unaccelerated stall point (sol rel diff {rel:.4f} > "
+                f"0.05 AND data-residual ratio {resid_ratio:.3f} > 1.2, "
+                f"statuses {st_b}/{st_a})"
+            )
+        return out
 
     def run_sharded(rtm_dtype: str, timed_reps: int) -> dict:
         """Pixel-sharded (row-block, the reference's MPI layout) fused
@@ -912,6 +1001,8 @@ def _worker_main() -> int:
                 data = run_straggler(item["B"], item["reps"])
             elif item["kind"] == "integrity":
                 data = run_integrity(item["reps"])
+            elif item["kind"] == "tts":
+                data = run_tts(item["log"])
             elif item["kind"] == "probe":
                 data = run_probe()
             else:
@@ -1219,6 +1310,17 @@ def main() -> int:
     items.append({"kind": "integrity", "id": "integrity:overhead",
                   "reps": 2, "deadline": budget_s + 240,
                   "timeout": cfg_timeout})
+    # convergence-acceleration time-to-solution section (ISSUE 10,
+    # docs/PERFORMANCE.md §9): iterations + wall-ms to the stall point,
+    # accel off vs the recommended per-variant config, parity-gated; the
+    # log iteration speedup is gated run-over-run by `make bench-smoke`
+    # (`sartsolve metrics --diff`) — BENCH_r06 starts the iterations-to-
+    # converge trajectory. Runs in quick mode too so the smoke artifact
+    # carries it.
+    items += [{"kind": "tts", "id": f"tts:{name}", "name": name,
+               "log": name == "log", "deadline": budget_s + 240,
+               "timeout": conv_timeout}
+              for name in ("linear", "log")]
     # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
@@ -1300,6 +1402,12 @@ def main() -> int:
         # integrity-on vs -off iter/s; `sartsolve metrics --diff` gates
         # on detail.integrity.iter_s_on run-over-run (ISSUE 7)
         detail["integrity"] = integ
+    tts = {name: results[f"tts:{name}"] for name in ("linear", "log")
+           if f"tts:{name}" in results}
+    if tts:
+        # accelerated time-to-solution (ISSUE 10, docs §9); `sartsolve
+        # metrics --diff` gates detail.tts.log.iter_speedup run-over-run
+        detail["tts"] = tts
     probes = {end: results[f"probe:{end}"] for end in ("start", "end")
               if f"probe:{end}" in results}
     if probes:

@@ -75,6 +75,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Geometric relaxation schedule: iteration k uses "
                         "relaxation * decay^k. Default 1.0 (fixed "
                         "relaxation, reference behavior).")
+    p.add_argument("--os_subsets", type=int, default=1,
+                   help="Ordered-subsets SART: cycle each iteration's "
+                        "update over N interleaved pixel-row subsets "
+                        "(docs/PERFORMANCE.md §9); must divide the padded "
+                        "per-shard pixel extent. Default 1 (classic sweep, "
+                        "byte-identical).")
+    p.add_argument("--momentum", default="off",
+                   choices=["off", "nesterov"],
+                   help="Nesterov/FISTA momentum over the SART update "
+                        "with gradient-based restart; resets on every "
+                        "divergence-recovery rollback "
+                        "(docs/PERFORMANCE.md §9). Default off "
+                        "(byte-identical).")
     p.add_argument("-n", "--raytransfer_name", default="with_reflections",
                    help="Ray transfer matrix dataset name.")
     p.add_argument("-L", "--logarithmic", action="store_true",
@@ -309,6 +322,12 @@ def _validate(args) -> None:
              f"with --fused_sweep {args.fused_sweep}: the per-frame "
              "relaxation scale cannot enter the fused kernel's literal "
              "exponent; use --fused_sweep auto/off.")
+    if args.os_subsets < 1:
+        fail(f"Argument os_subsets must be >= 1, {args.os_subsets} given.")
+    if args.os_subsets > 1 and args.fused_sweep in ("on", "interpret"):
+        fail(f"Argument os_subsets > 1 runs the subset-cycle sweep; "
+             f"--fused_sweep {args.fused_sweep} cannot be honored there — "
+             "use auto or off.")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -592,6 +611,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 divergence_recovery=args.divergence_recovery,
                 schedule_stride=schedule_stride,
                 integrity=integrity_on,
+                os_subsets=args.os_subsets,
+                momentum=args.momentum,
                 # forwarded so an explicit --fused_sweep on fails loudly
                 # (the fused sweep is fp32-only) instead of silently
                 # degrading to the unfused path
@@ -612,6 +633,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                 divergence_recovery=args.divergence_recovery,
                 schedule_stride=schedule_stride,
                 integrity=integrity_on,
+                os_subsets=args.os_subsets,
+                momentum=args.momentum,
                 rtm_dtype=args.rtm_dtype,
                 fused_sweep=args.fused_sweep,
             )
@@ -699,7 +722,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     f"accumulation bound {INT8_MAX_CONTRACTION}; use "
                     "fp32/bfloat16 storage."
                 )
-            if not sharded_fused_would_engage(
+            if opts.os_subsets == 1 and not sharded_fused_would_engage(
+                # the ordered-subsets cycle dequantizes int8 subset
+                # blocks itself (ops/fused_sweep.py os_subset_rows), so
+                # int8 + os_subsets > 1 does not need the fused sweep
                 opts, npixel, nvoxel, n_pix, max(n_vox, 1),
                 args.batch_frames or 1,
             ):
@@ -736,9 +762,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"rtm_dtype={opts.rtm_dtype or opts.dtype} "
                 f"compute={opts.dtype} "
                 f"fused_sweep={args.fused_sweep}->{opts.fused_sweep} "
+                f"os_subsets={opts.os_subsets} momentum={opts.momentum} "
                 f"processes={jax.process_count()}"
             )
-        # artifact provenance: the same decision line, as typed meta
+        # artifact provenance: the same decision line, as typed meta. The
+        # solver-variant fields (os_subsets/momentum/logarithmic) also ride
+        # every frame record (obs/run.py) so `sartsolve metrics --diff`
+        # can refuse to compare convergence behavior across variants.
         telem.set_run_info(
             backend=jax.default_backend(),
             mesh=f"{n_pix}x{n_vox}",
@@ -747,6 +777,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             compute_dtype=str(opts.dtype),
             fused_sweep=str(opts.fused_sweep),
             logarithmic=bool(args.logarithmic),
+            os_subsets=int(opts.os_subsets),
+            momentum=str(opts.momentum),
+        )
+        # convergence-accelerator gauges (docs/OBSERVABILITY.md): the
+        # variant in the metric snapshot, next to the iterations_to_
+        # converge trajectory it changes
+        telem.registry.gauge("solver_os_subsets").set(
+            float(opts.os_subsets)
+        )
+        telem.registry.gauge("solver_momentum_on").set(
+            1.0 if opts.momentum != "off" else 0.0
         )
 
         # ---- data model (main.cpp:70-86) ---------------------------------

@@ -185,9 +185,54 @@ class SolverOptions:
     # relaxation is standard SART practice for damping late-iteration
     # oscillation, and BASELINE.json config 3 names a relaxation schedule).
     # 1.0 (default) reproduces the reference's fixed-alpha behavior exactly.
+    #
+    # RELAXATION PRECEDENCE (docs/PERFORMANCE.md §9.4, pinned by
+    # tests/test_accel.py): three writers scale the per-iteration step and
+    # they compose MULTIPLICATIVELY, in one product —
+    #
+    #     step scale at iteration k = relaxation * decay^k * ascale
+    #
+    # where ``decay^k`` is this schedule (k = the frame's completed
+    # iterations; per-lane under continuous batching) and ``ascale`` is the
+    # divergence-recovery halving ladder's per-frame scale (1.0 until the
+    # guard trips; each rollback halves it FOR THE FRAME'S REMAINING
+    # ITERATIONS — the ladder never resets, and decay keeps advancing with
+    # k through a rollback, i.e. a rolled-back iteration still consumes a
+    # schedule step). Momentum (``momentum='nesterov'``) is NOT a
+    # relaxation writer: a momentum restart resets only the extrapolation
+    # state (t_k, f_prev) and never touches relaxation, decay or the
+    # ladder; conversely a ladder rollback also resets the momentum state
+    # (an extrapolated iterate must never survive as the rollback target).
+    # The linear update folds the whole product into the pixel weights;
+    # the logarithmic update applies it as the ratio exponent.
     relaxation_decay: float = 1.0
     max_iterations: int = 2000
     logarithmic: bool = False
+    # Ordered-subsets SART (OS-SART, docs/PERFORMANCE.md §9): each outer
+    # iteration cycles the update over this many INTERLEAVED pixel-row
+    # subsets (subset t = rows t::N per shard — interleaving makes every
+    # subset sample the full measurement geometry; contiguous stripes of
+    # a spatially-coherent RTM measured ~5x SLOWER than the classic
+    # sweep). Subset t's residual is computed FRESH against the iterate
+    # already updated by subsets 0..t-1, which is where the classic OS
+    # acceleration (arxiv 1705.07497) comes from. Each subset normalizes
+    # by its own ray density (the subset's column sums) and masks voxels
+    # the subset barely sees, so the Eq. 6 invariants hold per subset.
+    # Convergence is still tested once per outer iteration against the
+    # full forward projection, so iteration counts/tolerances compare
+    # 1:1 with the classic sweep. Must divide the (per-shard, padded)
+    # pixel extent. 1 (default) is the classic sweep, byte-identical.
+    os_subsets: int = 1
+    # Nesterov/FISTA-style momentum over the SART fixed-point update
+    # (docs/PERFORMANCE.md §9): 'nesterov' extrapolates the iterate
+    # (additively for the linear solver, multiplicatively — i.e. in log
+    # space — for the logarithmic solver, preserving positivity) before
+    # each sweep, with gradient-based adaptive restart (O'Donoghue &
+    # Candes) and a full momentum-state reset on every divergence-recovery
+    # rollback. 'off' (default) is byte-identical to the unaccelerated
+    # solver. Composes with os_subsets (extrapolate once per outer
+    # iteration, then run the subset cycle from the extrapolated point).
+    momentum: str = "off"
 
     # TPU extensions
     dtype: str = "float32"
@@ -299,6 +344,20 @@ class SolverOptions:
             )
         if self.max_iterations <= 0:
             raise ValueError("Attribute max_iterations must be positive.")
+        if self.os_subsets < 1:
+            raise ValueError(
+                "Attribute os_subsets must be >= 1 (1 disables ordered-"
+                "subsets cycling)."
+            )
+        if self.momentum not in ("off", "nesterov"):
+            raise ValueError("Attribute momentum must be 'off' or 'nesterov'.")
+        if self.os_subsets > 1 and self.fused_sweep in ("on", "interpret"):
+            raise ValueError(
+                "Attribute os_subsets > 1 runs the subset-cycle sweep "
+                "(one subset per update); an explicit fused_sweep="
+                f"'{self.fused_sweep}' cannot be honored there — use "
+                "'auto' or 'off'."
+            )
         if self.max_iterations > 2**24:
             # DeviceSolveResult packs the iteration count through an fp32
             # stack (parallel/sharded.py:_pack_fn), exact only up to 2^24;

@@ -42,6 +42,10 @@ from sartsolver_tpu.config import (
 from sartsolver_tpu.ops.fused_sweep import (
     fused_available,
     fused_sweep,
+    os_subset_back,
+    os_subset_forward,
+    os_subset_pixels,
+    os_subset_rows,
     sharded_panel_sweep,
 )
 from sartsolver_tpu.ops.laplacian import (
@@ -189,6 +193,18 @@ def _resolve_fused(
 # (VERDICT r3 next #4); a cached jit does not re-trace, so this reflects
 # the last *compilation*, which is what provenance needs.
 FUSED_ENGAGEMENT = {"last": None}
+
+def _momentum_carries_fitted(opts: SolverOptions) -> bool:
+    """Whether the momentum state includes the previous iterate's forward
+    projection. Only the linear solver on the classic (os_subsets == 1)
+    sweep carries it: ``H y = H f + beta (H f - H f_prev)`` is exact by
+    linearity, so the extrapolated point's projection costs no RTM read.
+    The log solver's extrapolation is multiplicative (no such identity —
+    it pays one forward projection per iteration instead), and the OS
+    cycle recomputes every subset's residual fresh anyway."""
+    return (opts.momentum != "off" and not opts.logarithmic
+            and opts.os_subsets == 1)
+
 
 # This JAX build emulates float64 as float32 pairs: full ~2x-fp32 precision
 # but *fp32 range* — magnitudes below ~1.2e-38 flush to zero. The reference's
@@ -712,18 +728,68 @@ class _SweepContext:
                 )
             self.scale = problem.rtm_scale.astype(dtype)
 
+        # Ordered-subsets cycle (docs/PERFORMANCE.md §9): per-subset ray
+        # densities and masks. Subset t is the INTERLEAVED row set
+        # ``t::os`` of this device's pixel rows (ops/fused_sweep.py
+        # os_subset_rows — interleaving is what makes every subset sample
+        # the full geometry; contiguous stripes of a spatially-coherent
+        # RTM measure NO acceleration). Each subset's column sums — its
+        # own rho — normalize that sub-step's update (normalizing by the
+        # FULL rho would scale every sub-update by ~1/s and erase the
+        # acceleration). A voxel a subset barely sees keeps the Eq. 6
+        # masking per subset: the subset mask is the same absolute
+        # threshold intersected with the global vmask, so no sub-step
+        # ever updates a globally-masked voxel. Loop-invariant: XLA
+        # hoists these out of the while body.
+        self.os = int(opts.os_subsets)
+        if self.os > 1:
+            P_local = rtm.shape[0]
+            if P_local % self.os:
+                raise ValueError(
+                    f"os_subsets={self.os} must divide the (per-shard, "
+                    f"padded) pixel extent {P_local}."
+                )
+            # [P/os, os, V]; axis 1 is the subset index (rows t::os)
+            stacked = rtm.reshape(P_local // self.os, self.os, nvoxel)
+            if self.is_int8:
+                dens_sub = _psum(
+                    self.scale[None, :]
+                    * jnp.sum(stacked, axis=0, dtype=jnp.int32).astype(dtype),
+                    axis_name,
+                )
+            else:
+                dens_sub = _psum(
+                    jnp.sum(stacked, axis=0, dtype=dtype), axis_name
+                )
+            self.vmask_sub = (  # [os, V]
+                (dens_sub > opts.ray_density_threshold) & self.vmask[None, :]
+            )
+            self.inv_density_sub = jnp.where(
+                self.vmask_sub,
+                opts.relaxation / jnp.where(self.vmask_sub, dens_sub, 1),
+                0,
+            ).astype(dtype)
+
         # Fused sweep: one HBM pass over the RTM per iteration instead of
         # two (ops/fused_sweep.py) — the Pallas kernel when the pixel
         # extent is whole on-device, the per-panel-psum scan ("panel")
         # when the pixel axis is sharded. The elementwise update closures
         # use Python float constants (Pallas kernels cannot capture traced
         # values; the panel scan shares the closures for exact path
-        # parity).
-        fused = self.fused = _resolve_fused(
-            opts, axis_name, rtm, B, vmem_raised=_vmem_raised
-        )
-        FUSED_ENGAGEMENT["last"] = fused or "off"
-        if self.is_int8 and fused is None:
+        # parity). The OS cycle (os_subsets > 1) replaces the whole-matrix
+        # sweep with the subset cycle (run_os_sweep) — plain-XLA subset
+        # dots with the panel scan's int8 dequant idiom — so the fused
+        # resolution is skipped there (SolverOptions rejects an explicit
+        # 'on'/'interpret' with os_subsets > 1 at construction).
+        if self.os > 1:
+            fused = self.fused = None
+            FUSED_ENGAGEMENT["last"] = "os-subset"
+        else:
+            fused = self.fused = _resolve_fused(
+                opts, axis_name, rtm, B, vmem_raised=_vmem_raised
+            )
+            FUSED_ENGAGEMENT["last"] = fused or "off"
+        if self.is_int8 and fused is None and self.os == 1:
             # The two-matmul loop would have to re-quantize w/f every
             # iteration (extra error) or dequantize the matrix (4x the
             # memory the user chose int8 to avoid) — int8 storage is a
@@ -836,6 +902,146 @@ class _SweepContext:
             self.axis_name,
         )
         return jnp.where(self.vmask[None, :], obs, 0)
+
+    def make_obs_sub(self, g, meas_mask):
+        """Log-variant per-subset observation back-projections for the OS
+        cycle: ``[B, os, V_local]``, subset t (rows ``t::os``) masked by
+        its own vmask. Computed once per measurement outside the iteration
+        loop (one full RTM read in subset dots), like :meth:`make_obs`."""
+        scale = self.scale if self.is_int8 else None
+        outs = []
+        for t in range(self.os):  # setup-time unroll, static subset index
+            panel = os_subset_rows(self.rtm, t, self.os)
+            g_t = os_subset_pixels(g, t, self.os)
+            m_t = os_subset_pixels(meas_mask, t, self.os)
+            il_t = os_subset_pixels(self.inv_length, t, self.os)[None, :]
+            obs_t = os_subset_back(
+                panel, jnp.where(m_t, g_t, 0) * il_t, scale,
+                axis_name=self.axis_name,
+            )
+            outs.append(jnp.where(self.vmask_sub[t][None, :], obs_t, 0))
+        return jnp.stack(outs, axis=1)
+
+    def run_os_sweep(self, f, dk, ascale, g, meas_mask, obs_sub):
+        """(f_upd, fitted_upd): one OUTER iteration of the ordered-subsets
+        cycle (docs/PERFORMANCE.md §9) — ``os_subsets`` sub-updates, each
+        against one interleaved pixel-row subset (rows ``t::os``) with a
+        FRESH subset residual (subset t sees the iterate subsets 0..t-1
+        already updated; that compounding is the OS acceleration), then
+        one full forward projection of the final iterate so the
+        convergence metric and the warm-start carry stay exact
+        (``fitted_upd == H @ f_upd``, this device's rows, pre-voxel-psum
+        like the fused paths).
+
+        ``dk``/``ascale`` compose exactly as in :meth:`run_sweep` (the
+        documented relaxation precedence: relaxation * decay^k * ascale;
+        the subset's own inverse density carries the base relaxation for
+        the linear update). The Laplacian penalty is re-evaluated per
+        sub-step at the current iterate and scaled by 1/os_subsets, so
+        one outer iteration applies the classic iteration's full
+        regularization strength, distributed over the cycle. The ABFT
+        back-projection checksum is not folded into sub-steps (that would
+        add os_subsets collectives per iteration past the audited
+        budget); the outer-level sum(Hf) == rho.f check still runs on the
+        exact full projection below.
+        """
+        opts = self.opts
+        dtype = self.dtype
+        scale = self.scale if self.is_int8 else None
+        pen_scale = 1.0 / self.os
+
+        def substep(t, f):
+            panel = os_subset_rows(self.rtm, t, self.os)
+            g_t = os_subset_pixels(g, t, self.os)
+            m_t = os_subset_pixels(meas_mask, t, self.os)
+            il_t = os_subset_pixels(self.inv_length, t, self.os)[None, :]
+            vm_t = lax.dynamic_index_in_dim(
+                self.vmask_sub, t, axis=0, keepdims=False
+            )[None, :]
+            fitted_t = _psum(
+                os_subset_forward(panel, f, scale), self.voxel_axis
+            )
+            if opts.logarithmic:
+                w = jnp.where(m_t, fitted_t, 0) * il_t
+                fit = os_subset_back(panel, w, scale,
+                                     axis_name=self.axis_name)
+                fit = jnp.where(vm_t, fit, 0)
+                obs_t = lax.dynamic_index_in_dim(
+                    obs_sub, t, axis=1, keepdims=False
+                )
+                exponent = jnp.asarray(opts.relaxation, dtype)
+                if self.scheduled:
+                    exponent = exponent * dk
+                if ascale is not None:
+                    exponent = exponent * ascale[:, None]
+                ratio = ((obs_t + self.eps) / (fit + self.eps)) ** exponent
+                f_new = f * ratio
+                if self.has_pen:
+                    pen = self.compute_penalty(jnp.log(f)) * pen_scale
+                    f_new = f_new * jnp.exp(-pen)
+                return f_new
+            w = jnp.where(m_t, g_t - fitted_t, 0) * il_t
+            if self.scheduled:
+                w = w * dk
+            if ascale is not None:
+                w = w * ascale[:, None]
+            bp = os_subset_back(panel, w, scale, axis_name=self.axis_name)
+            invd_t = lax.dynamic_index_in_dim(
+                self.inv_density_sub, t, axis=0, keepdims=False
+            )[None, :]
+            upd = f + invd_t * bp
+            if self.has_pen:
+                upd = upd - self.compute_penalty(f) * pen_scale
+            return jnp.maximum(upd, 0)
+
+        f_upd = lax.fori_loop(0, self.os, substep, f)
+        # Full forward projection of the final iterate — EXACT (int8:
+        # subset-wise dequantized dots, never int8_forward_project's
+        # quantized-vector approximation, which would perturb the ABFT
+        # sum(Hf) == rho.f identity and the warm-start carry in-loop).
+        # The subset results interleave back: row i = q * os + t lives at
+        # parts[t][:, q], i.e. stack on a trailing subset axis + reshape.
+        if self.is_int8:
+            parts = [
+                os_subset_forward(os_subset_rows(self.rtm, t, self.os),
+                                  f_upd, scale)
+                for t in range(self.os)
+            ]
+            fitted_upd = jnp.stack(parts, axis=2).reshape(
+                f_upd.shape[0], self.rtm.shape[0]
+            )
+        else:
+            fitted_upd = forward_project(self.rtm, f_upd,
+                                         accum_dtype=dtype)
+        return f_upd, fitted_upd
+
+    def extrapolate(self, f, f_prev, tk, mom_floor):
+        """(y, beta, t_next): the Nesterov/FISTA extrapolation shared by
+        the batched and stepped cores — one definition, like
+        :meth:`run_sweep` (docs/PERFORMANCE.md §9). Additive for the
+        linear solver; multiplicative (log-space, positivity-preserving,
+        floored against fp-underflowed iterates) for the log solver."""
+        t_next = 0.5 * (1.0 + jnp.sqrt(1.0 + 4.0 * tk * tk))
+        beta = ((tk - 1.0) / t_next).astype(self.dtype)[:, None]
+        if self.opts.logarithmic:
+            y = jnp.maximum(
+                f * (jnp.maximum(f, mom_floor)
+                     / jnp.maximum(f_prev, mom_floor)) ** beta,
+                mom_floor,
+            )
+        else:
+            y = f + beta * (f - f_prev)
+        return y, beta, t_next
+
+    def momentum_tk(self, y, f_new, f, t_next, reset):
+        """Next FISTA t_k: gradient-based adaptive restart (O'Donoghue &
+        Candes — the update moved against the extrapolation direction)
+        OR'd with the caller's reset mask (divergence-recovery rollback,
+        SDC freeze); restart resets only the momentum state, never the
+        relaxation product (the §9 precedence contract)."""
+        rs = _psum(jnp.sum((y - f_new) * (f_new - f), axis=1),
+                   self.voxel_axis) > 0
+        return jnp.where(rs | reset, 1.0, t_next).astype(self.dtype)
 
     def run_fused(self, w, f, aux):
         if self.is_int8:
@@ -1046,7 +1252,21 @@ def _solve_normalized_batch_impl(
     tol = jnp.asarray(opts.conv_tolerance, dtype)
     msq = jnp.asarray(msq, dtype)
 
-    obs = kit.make_obs(g, meas_mask) if opts.logarithmic else None
+    if opts.logarithmic:
+        obs = (kit.make_obs_sub(g, meas_mask) if kit.os > 1
+               else kit.make_obs(g, meas_mask))
+    else:
+        obs = None
+
+    # Convergence accelerators (docs/PERFORMANCE.md §9), both Python-gated:
+    # the default path (os_subsets=1, momentum off) traces byte-identically
+    # to the unaccelerated solver — no extra carries, no extra ops.
+    momentum = opts.momentum != "off"
+    carry_fit = _momentum_carries_fitted(opts)
+    mom_n = 3 if carry_fit else 2
+    os_cycle = kit.os > 1
+    mom_floor = (_tiny(max(opts.log_epsilon, 1e-30), dtype)
+                 if (momentum and opts.logarithmic) else None)
 
     # In-solve divergence recovery (docs/RESILIENCE.md): with R > 0 the
     # loop carries a per-frame relaxation scale, a recovery counter and a
@@ -1065,19 +1285,54 @@ def _solve_normalized_batch_impl(
     def body(carry):
         if integ:
             carry, sdc = carry[:-1], carry[-1]
+        if momentum:
+            mom = carry[-mom_n:]
+            carry = carry[:-mom_n]
+            if carry_fit:
+                f_prev, fitted_prev, tk = mom
+            else:
+                f_prev, tk = mom
         if recovery:
             f, fitted, conv_prev, it, done, iters, ascale, recov, div = carry
         else:
             f, fitted, conv_prev, it, done, iters = carry
             ascale = None
-        if opts.logarithmic:
-            penalty = kit.compute_penalty(jnp.log(f))
+        # Nesterov/FISTA extrapolation: the sweep runs AT the extrapolated
+        # point y (additive linear — y may dip below 0, standard FISTA,
+        # the update's clamp restores feasibility of x); the carry always
+        # holds the post-update iterate x_k, never y — so the divergence
+        # guard's rollback target is never an extrapolated iterate, by
+        # construction.
+        if momentum:
+            y, beta, t_next = kit.extrapolate(f, f_prev, tk, mom_floor)
+            base = y
         else:
-            penalty = kit.compute_penalty(f)
+            base = f
         dk = (jnp.asarray(kit.decay, dtype) ** it.astype(dtype)
               if kit.scheduled else None)
-        f_upd, fitted_upd, bp_chk = kit.run_sweep(f, fitted, penalty, dk,
-                                                  ascale, g, meas_mask, obs)
+        if os_cycle:
+            f_upd, fitted_upd = kit.run_os_sweep(base, dk, ascale, g,
+                                                 meas_mask, obs)
+            bp_chk = None
+        else:
+            if momentum:
+                if opts.logarithmic:
+                    # no linearity to exploit — one forward projection of
+                    # the extrapolated point per iteration
+                    fitted_base = _psum(kit.fp_any(y), voxel_axis)
+                else:
+                    # H y = H f + beta (H f - H f_prev), exact: the
+                    # extrapolated residual costs no RTM read
+                    fitted_base = fitted + beta * (fitted - fitted_prev)
+            else:
+                fitted_base = fitted
+            if opts.logarithmic:
+                penalty = kit.compute_penalty(jnp.log(base))
+            else:
+                penalty = kit.compute_penalty(base)
+            f_upd, fitted_upd, bp_chk = kit.run_sweep(
+                base, fitted_base, penalty, dk, ascale, g, meas_mask, obs
+            )
 
         f_new = jnp.where(done[:, None], f, f_upd)  # converged frames freeze
         if fitted_upd is not None:
@@ -1142,6 +1397,16 @@ def _solve_normalized_batch_impl(
             iters = jnp.where(ended, it + 1, iters)
             out = (f_new, fitted_new, conv, it + 1, done | ended, iters,
                    ascale, recov, div | exhausted)
+            if momentum:
+                # restart OR'd with rollback / SDC freeze — the documented
+                # precedence: restart never touches relaxation, the
+                # ladder never touches t_k except through this reset
+                tk_new = kit.momentum_tk(
+                    y, f_new, f, t_next,
+                    (bad | tripped) if integ else bad,
+                )
+                out = out + ((f,) + ((fitted,) if carry_fit else ())
+                             + (tk_new,))
             return out + (sdc,) if integ else out
         newly = (~done) & (it >= 1) & (jnp.abs(conv - conv_prev) < tol)
         if integ:
@@ -1151,6 +1416,11 @@ def _solve_normalized_batch_impl(
             ended = newly
         iters = jnp.where(ended, it + 1, iters)
         out = (f_new, fitted_new, conv, it + 1, done | ended, iters)
+        if momentum:
+            tk_new = kit.momentum_tk(y, f_new, f, t_next,
+                                     tripped if integ else False)
+            out = out + ((f,) + ((fitted,) if carry_fit else ())
+                         + (tk_new,))
         return out + (sdc,) if integ else out
 
     def cond(carry):
@@ -1187,11 +1457,17 @@ def _solve_normalized_batch_impl(
             jnp.zeros(B, jnp.int32),  # recoveries consumed
             input_bad,  # diverged (pre-failed, or ladder exhausted later)
         )
+        if momentum:
+            # t_1 = 1 -> beta = 0: iteration 1 extrapolates nothing
+            init = init + ((f0,) + ((fitted0,) if carry_fit else ())
+                           + (jnp.ones(B, dtype),))
         if integ:
             init = init + (jnp.zeros(B, bool),)  # SDC-tripped frames
         out = lax.while_loop(cond, body, init)
         if integ:
             out, sdc = out[:-1], out[-1]
+        if momentum:
+            out = out[:-mom_n]
         f, fitted_fin, conv, it, done, iters, _, _, div = out
         status = jnp.where(
             div, DIVERGED,
@@ -1204,11 +1480,16 @@ def _solve_normalized_batch_impl(
             f0, fitted0, jnp.zeros(B, dtype), jnp.asarray(0, jnp.int32),
             jnp.zeros(B, bool), jnp.full(B, opts.max_iterations, jnp.int32),
         )
+        if momentum:
+            init = init + ((f0,) + ((fitted0,) if carry_fit else ())
+                           + (jnp.ones(B, dtype),))
         if integ:
             init = init + (jnp.zeros(B, bool),)
         out = lax.while_loop(cond, body, init)
         if integ:
             out, sdc = out[:-1], out[-1]
+        if momentum:
+            out = out[:-mom_n]
         f, fitted_fin, conv, it, done, iters = out
         status = jnp.where(done, SUCCESS, MAX_ITERATIONS_EXCEEDED).astype(jnp.int32)
         if integ:
@@ -1263,8 +1544,20 @@ class SchedState(NamedTuple):
     ascale: Array  # [B] divergence-guard relaxation scale (1 when off)
     recov: Array  # [B] int32 recoveries consumed (0 when off)
     # [B, V_local] log-variant observation back-projection, recomputed per
-    # refill (one RTM read); None for the linear solver.
+    # refill (one RTM read); None for the linear solver. With os_subsets
+    # > 1 it holds the per-subset stack [B, os, V_local] instead
+    # (_SweepContext.make_obs_sub).
     obs: Optional[Array]
+    # Per-lane momentum state (SolverOptions.momentum='nesterov'): the
+    # previous post-update iterate, its forward projection (carried only
+    # when _momentum_carries_fitted — the linear classic sweep), and the
+    # FISTA t_k scalar; lanes age/restart independently, so the state
+    # lives here, keeping the stepped program's shape fixed at every
+    # occupancy (the one-compiled-program contract). All None when
+    # momentum is off — the default state tree is unchanged.
+    f_prev: Optional[Array] = None  # [B, V_local]
+    fitted_prev: Optional[Array] = None  # [B, P_local]
+    tk: Optional[Array] = None  # [B]
 
 
 def sched_step_normalized(
@@ -1302,6 +1595,14 @@ def sched_step_normalized(
     tol = jnp.asarray(opts.conv_tolerance, dtype)
     stride = int(opts.schedule_stride)
     maxit = jnp.asarray(opts.max_iterations, jnp.int32)
+    # convergence accelerators — Python-gated exactly like the batched
+    # core; the default path's carries and trace are unchanged
+    momentum = opts.momentum != "off"
+    carry_fit = _momentum_carries_fitted(opts)
+    mom_n = 3 if carry_fit else 2
+    os_cycle = kit.os > 1
+    mom_floor = (_tiny(max(opts.log_epsilon, 1e-30), dtype)
+                 if (momentum and opts.logarithmic) else None)
 
     def merge_refill(st: SchedState) -> SchedState:
         g = jnp.where(refill[:, None], g_new.astype(dtype), st.g)
@@ -1328,7 +1629,21 @@ def sched_step_normalized(
         fitted = jnp.where(refill[:, None], fitted0, st.fitted)
         obs = st.obs
         if opts.logarithmic:
-            obs = jnp.where(refill[:, None], kit.make_obs(g, g >= 0), st.obs)
+            if os_cycle:
+                obs = jnp.where(refill[:, None, None],
+                                kit.make_obs_sub(g, g >= 0), st.obs)
+            else:
+                obs = jnp.where(refill[:, None], kit.make_obs(g, g >= 0),
+                                st.obs)
+        f_prev, fitted_prev, tk = st.f_prev, st.fitted_prev, st.tk
+        if momentum:
+            # a refilled lane starts its FISTA sequence over: t_1 = 1,
+            # previous iterate = its own initial guess (beta = 0)
+            f_prev = jnp.where(refill[:, None], f0, f_prev)
+            if carry_fit:
+                fitted_prev = jnp.where(refill[:, None], fitted0,
+                                        fitted_prev)
+            tk = jnp.where(refill, jnp.ones((), dtype), tk)
         conv = jnp.where(refill, jnp.zeros((), dtype), st.conv)
         it = jnp.where(refill, 0, st.it)
         done = st.done & ~refill
@@ -1364,7 +1679,8 @@ def sched_step_normalized(
             )
             iters = jnp.where(input_bad, 0, iters)
         return SchedState(g, msq, f, fitted, conv, it, done, status,
-                          iters, ascale, recov, obs)
+                          iters, ascale, recov, obs, f_prev, fitted_prev,
+                          tk)
 
     state = lax.cond(jnp.any(refill), merge_refill, lambda st: st, state)
 
@@ -1374,19 +1690,46 @@ def sched_step_normalized(
     integ = kit.integrity
 
     def body(carry):
+        if momentum:
+            mom = carry[-mom_n:]
+            carry = carry[:-mom_n]
+            if carry_fit:
+                f_prev, fitted_prev, tk = mom
+            else:
+                f_prev, tk = mom
         (step, f, fitted, conv_prev, itl, done, status, iters,
          ascale, recov) = carry
-        if opts.logarithmic:
-            penalty = kit.compute_penalty(jnp.log(f))
+        # Nesterov/FISTA extrapolation per lane — the batched body's
+        # helper with per-lane t_k (lanes age and restart independently)
+        if momentum:
+            y, beta, t_next = kit.extrapolate(f, f_prev, tk, mom_floor)
+            base = y
         else:
-            penalty = kit.compute_penalty(f)
+            base = f
         # per-lane schedule factor decay^k — lanes age independently
         dk = ((jnp.asarray(kit.decay, dtype) ** itl.astype(dtype))[:, None]
               if kit.scheduled else None)
-        f_upd, fitted_upd, bp_chk = kit.run_sweep(
-            f, fitted, penalty, dk, ascale if recovery else None,
-            g, meas_mask, obs,
-        )
+        if os_cycle:
+            f_upd, fitted_upd = kit.run_os_sweep(
+                base, dk, ascale if recovery else None, g, meas_mask, obs
+            )
+            bp_chk = None
+        else:
+            if momentum:
+                if opts.logarithmic:
+                    fitted_base = _psum(kit.fp_any(y), voxel_axis)
+                else:
+                    fitted_base = fitted + beta * (fitted - fitted_prev)
+            else:
+                fitted_base = fitted
+            if opts.logarithmic:
+                penalty = kit.compute_penalty(jnp.log(base))
+            else:
+                penalty = kit.compute_penalty(base)
+            f_upd, fitted_upd, bp_chk = kit.run_sweep(
+                base, fitted_base, penalty, dk,
+                ascale if recovery else None, g, meas_mask, obs,
+            )
         f_new = jnp.where(done[:, None], f, f_upd)  # frozen lanes freeze
         if fitted_upd is not None:
             fitted_new = jnp.where(
@@ -1459,8 +1802,20 @@ def sched_step_normalized(
         iters = jnp.where(ended | capped, itl + 1, iters)
         done_new = done | ended | capped
         itl = jnp.where(done, itl, itl + 1)
-        return (step + 1, f_new, fitted_new, conv, itl, done_new, status,
-                iters, ascale, recov)
+        out = (step + 1, f_new, fitted_new, conv, itl, done_new, status,
+               iters, ascale, recov)
+        if momentum:
+            # gradient restart + the documented resets (rollback / SDC
+            # freeze kill the momentum state) — the batched body's rule
+            reset = False
+            if recovery:
+                reset = bad
+            if integ:
+                reset = reset | tripped
+            tk_new = kit.momentum_tk(y, f_new, f, t_next, reset)
+            out = out + ((f,) + ((fitted,) if carry_fit else ())
+                         + (tk_new,))
+        return out
 
     def cond(carry):
         return (carry[0] < stride) & ~jnp.all(carry[5])
@@ -1468,11 +1823,23 @@ def sched_step_normalized(
     init = (jnp.asarray(0, jnp.int32), state.f, state.fitted, state.conv,
             state.it, state.done, state.status, state.iters, state.ascale,
             state.recov)
-    (_, f, fitted, conv, itl, done, status, iters, ascale, recov) = (
-        lax.while_loop(cond, body, init)
-    )
+    if momentum:
+        init = init + ((state.f_prev,)
+                       + ((state.fitted_prev,) if carry_fit else ())
+                       + (state.tk,))
+    out = lax.while_loop(cond, body, init)
+    f_prev_fin = fitted_prev_fin = tk_fin = None
+    if momentum:
+        mom_fin = out[-mom_n:]
+        out = out[:-mom_n]
+        if carry_fit:
+            f_prev_fin, fitted_prev_fin, tk_fin = mom_fin
+        else:
+            f_prev_fin, tk_fin = mom_fin
+    (_, f, fitted, conv, itl, done, status, iters, ascale, recov) = out
     return SchedState(g, msq, f, fitted, conv, itl, done, status, iters,
-                      ascale, recov, obs)
+                      ascale, recov, obs, f_prev_fin, fitted_prev_fin,
+                      tk_fin)
 
 
 # --------------------------------------------------------------------------
@@ -1614,6 +1981,85 @@ def _audit_integrity_sweep():
     fn = jax.jit(functools.partial(
         _solve_normalized_batch_impl, opts=opts, axis_name=None,
         voxel_axis=None, use_guess=False,
+    ))
+    return fn.lower(_audit_problem(), *_audit_batch_args(2))
+
+
+@_register_audit_entry(
+    "os_sweep",
+    description="ordered-subsets (OS-SART) subset-cycle iteration sweep "
+                "(linear, 4 subsets, fp32): fori_loop over pixel-row "
+                "subsets + one full forward projection per outer "
+                "iteration — the cost golden pins the subset loop's FLOP "
+                "shape (~1.5x the classic sweep per iteration)",
+    # the subset cycle's slices are [P/os, V] — a FULL-matrix copy or
+    # convert in the loop would erase the subset structure
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_os_sweep():
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        os_subsets=4,
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=False,
+    ))
+    return fn.lower(_audit_problem(), *_audit_batch_args(2))
+
+
+@_register_audit_entry(
+    "momentum_sweep",
+    description="Nesterov/FISTA-accelerated linear iteration sweep "
+                "(momentum='nesterov', fp32): extrapolation + gradient "
+                "restart must stay O(B x (P+V)) elementwise bookkeeping — "
+                "the extrapolated point's projection is the exact linear "
+                "combination of carried products, never a third RTM sweep",
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_momentum_sweep():
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        momentum="nesterov",
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=False,
+    ))
+    return fn.lower(_audit_problem(), *_audit_batch_args(2))
+
+
+@_register_audit_entry(
+    "log_accel_sweep",
+    description="fully-accelerated logarithmic sweep (os_subsets=4 + "
+                "momentum='nesterov', fp32) — the headline convergence-"
+                "acceleration combination for the slow log path "
+                "(docs/PERFORMANCE.md §9)",
+    loop_copy_threshold=_AUDIT_P * _AUDIT_V,
+    loop_convert_threshold=_AUDIT_P * _AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_log_accel_sweep():
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        logarithmic=True, os_subsets=4, momentum="nesterov",
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=True,
     ))
     return fn.lower(_audit_problem(), *_audit_batch_args(2))
 

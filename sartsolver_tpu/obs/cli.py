@@ -97,6 +97,20 @@ def summarize(records: List[dict]) -> dict:
     events = [r for r in records if r.get("type") == "event"]
     metric_recs = [r for r in records if r.get("type") == "metric"]
     bench = [r for r in records if r.get("type") == "bench"]
+    # solver-variant provenance (run meta, cli.py set_run_info): two runs
+    # with different convergence accelerators must never have their
+    # iteration/solve-ms behavior compared silently (docs §9). Frame
+    # records carry the same fields (obs/run.py) precisely so a SLICED
+    # artifact — frames without their meta line — still declares its
+    # variant; fall back to the first frame that has them.
+    meta = records[0] if records and records[0].get("type") == "meta" else {}
+    variant_keys = ("os_subsets", "momentum", "logarithmic")
+    variant = {k: meta[k] for k in variant_keys if k in meta}
+    if not variant:
+        for fr in frames:
+            variant = {k: fr[k] for k in variant_keys if k in fr}
+            if variant:
+                break
     by_status: Dict[str, int] = {}
     for fr in frames:
         by_status[fr["status_name"]] = by_status.get(fr["status_name"], 0) + 1
@@ -128,6 +142,8 @@ def summarize(records: List[dict]) -> dict:
             if m["kind"] == "histogram" and m.get("count")
         },
     }
+    if variant:
+        out["variant"] = variant
     if bench:
         out["bench"] = {
             "metric": bench[0]["metric"], "value": bench[0]["value"],
@@ -151,6 +167,21 @@ def summarize(records: List[dict]) -> dict:
                 "iter_s_on": integ["iter_s_on"],
                 "iter_s_off": integ.get("iter_s_off"),
                 "overhead_pct": integ.get("overhead_pct"),
+            }
+        # time-to-solution section (bench.py tts items, docs §9): the
+        # log-path iterations-to-converge speedup of the accelerated
+        # variants is a gated rate — a run-over-run drop means the
+        # convergence accelerators regressed, which raw iter/s never sees
+        tts = (bench[0].get("detail") or {}).get("tts")
+        if isinstance(tts, dict):
+            out["tts"] = {
+                name: {
+                    "iter_speedup": sec.get("iter_speedup"),
+                    "iters_base": sec.get("iters_base"),
+                    "iters_accel": sec.get("iters_accel"),
+                    "parity": sec.get("parity"),
+                }
+                for name, sec in tts.items() if isinstance(sec, dict)
             }
         # roofline section (bench.py + obs/roofline.py): the headline
         # config's achieved-vs-peak MXU and HBM-bandwidth fractions —
@@ -209,6 +240,17 @@ def _print_summary(path: str, summary: dict) -> None:
         r = summary["roofline"]
         print(f"  roofline: mxu_util {r['mxu_util']:g}, "
               f"hbm_util {r['hbm_util']:g} ({r['bound']}-bound)")
+    if "variant" in summary:
+        v = summary["variant"]
+        print("  solver variant: " + ", ".join(
+            f"{k}={v[k]}" for k in sorted(v)))
+    if "tts" in summary:
+        for name, sec in sorted(summary["tts"].items()):
+            if sec.get("iter_speedup") is not None:
+                print(f"  tts {name}: {sec['iters_base']} -> "
+                      f"{sec['iters_accel']} iters "
+                      f"({sec['iter_speedup']:g}x, parity="
+                      f"{sec.get('parity')})")
 
 
 def diff(old: dict, new: dict) -> dict:
@@ -275,6 +317,34 @@ def diff(old: dict, new: dict) -> dict:
         out["integrity"] = {"old": old["integrity"]["iter_s_on"],
                             "new": new["integrity"]["iter_s_on"]}
     out["integrity_value_pct"] = integ_pct
+    # accelerated time-to-solution (bench detail.tts, docs §9): the
+    # log-path iteration-count speedup is a rate, gated like the bench
+    # value — the gate the raw iter/s headline cannot provide
+    tts_pct = None
+    a = ((old.get("tts") or {}).get("log") or {}).get("iter_speedup")
+    b = ((new.get("tts") or {}).get("log") or {}).get("iter_speedup")
+    if a and b and a > 0:
+        tts_pct = 100.0 * (b / a - 1.0)
+        out["tts"] = {"old": a, "new": b}
+    out["tts_log_speedup_pct"] = tts_pct
+    # the parity verdict is a hard gate, not a rate: a NEW artifact whose
+    # accelerated solve landed away from the unaccelerated stall point
+    # (bench run_tts parity=False) is a correctness regression even when
+    # the iteration speedup LOOKS better (fewer iterations to the wrong
+    # answer)
+    out["tts_parity_failed"] = sorted(
+        name for name, sec in (new.get("tts") or {}).items()
+        if isinstance(sec, dict) and sec.get("parity") is False
+    )
+    # solver-variant guard: run artifacts from different convergence
+    # accelerators (os_subsets/momentum/logarithmic) are different
+    # algorithms — their convergence-behavior and solve-ms gates are
+    # SKIPPED, with a loud note (never a silent cross-variant compare)
+    va, vb = old.get("variant"), new.get("variant")
+    if va is not None and vb is not None and va != vb:
+        out["variant_mismatch"] = {"old": va, "new": vb}
+        out["solve_ms_mean_pct"] = None
+        out["iterations_to_converge_mean_pct"] = None
     # roofline utilization (bench detail.roofline, obs/roofline.py):
     # achieved-vs-peak MXU / HBM fractions are rates — a drop past the
     # threshold is a regression, independently of the raw headline
@@ -297,7 +367,18 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
     is a loud note on stderr, never a silent pass — an artifact missing
     its bench section must not read as "no regression"."""
     notes: List[str] = []
-    for section in ("bench", "straggler", "integrity", "roofline"):
+    va, vb = old.get("variant"), new.get("variant")
+    if va is not None and vb is not None and va != vb:
+        notes.append(
+            f"solver variant differs (baseline {va} vs new {vb}) — "
+            "convergence-behavior and solve-ms gates skipped: different "
+            "algorithms are not comparable"
+        )
+    elif (va is None) != (vb is None):
+        side = "baseline" if vb is not None else "new"
+        notes.append(f"solver-variant meta missing from the {side} "
+                     "artifact — variant comparability unknown")
+    for section in ("bench", "straggler", "integrity", "roofline", "tts"):
         if (section in old) != (section in new):
             side = "baseline" if section in new else "new"
             notes.append(f"{section} section missing from the {side} "
@@ -307,6 +388,15 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
         ("straggler", "occ_frame_iter_s", "straggler occ frame-iter/s"),
         ("integrity", "iter_s_on", "integrity-on iter/s"),
     ]
+    if "tts" in old and "tts" in new:
+        # a zero/absent speedup on EITHER side skips the rate gate — and
+        # on the new side that is itself suspicious (an errored tts item
+        # or a speedup collapsed to 0 would otherwise sail through)
+        for side, summ in (("baseline", old), ("new", new)):
+            a = (summ["tts"].get("log") or {}).get("iter_speedup")
+            if not (a or 0) > 0:
+                notes.append(f"{side} tts log iteration speedup is zero/"
+                             "absent — its rate gate skipped")
     for section, key, label in zero_checks:
         if (section in old and section in new
                 and not (old[section].get(key) or 0) > 0):
@@ -405,6 +495,11 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['integrity']['old']:g} -> "
                       f"{delta['integrity']['new']:g} "
                       f"({delta['integrity_value_pct']:+.1f}%)")
+            if delta["tts_log_speedup_pct"] is not None:
+                print(f"  tts log iteration speedup: "
+                      f"{delta['tts']['old']:g}x -> "
+                      f"{delta['tts']['new']:g}x "
+                      f"({delta['tts_log_speedup_pct']:+.1f}%)")
             for key in ("mxu_util", "hbm_util"):
                 if delta[f"roofline_{key}_pct"] is not None:
                     d = delta["roofline"][key]
@@ -451,6 +546,23 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"regression {delta['integrity_value_pct']:+.1f}% "
                       f"exceeds the {args.threshold:g}% threshold.",
                       file=sys.stderr)
+                return 2
+            if delta.get("tts_parity_failed"):
+                # correctness outranks the rate thresholds: parity=False
+                # means the accelerated solve landed away from the
+                # unaccelerated stall point, whatever the speedup says
+                print(f"sartsolve metrics: accelerated time-to-solution "
+                      f"parity FAILED for "
+                      f"{', '.join(delta['tts_parity_failed'])} in the "
+                      "new artifact (bench tts item).", file=sys.stderr)
+                return 2
+            if (delta["tts_log_speedup_pct"] is not None
+                    and delta["tts_log_speedup_pct"] < -args.threshold):
+                print(f"sartsolve metrics: accelerated log time-to-"
+                      f"solution regression "
+                      f"{delta['tts_log_speedup_pct']:+.1f}% (iteration "
+                      f"speedup) exceeds the {args.threshold:g}% "
+                      "threshold.", file=sys.stderr)
                 return 2
             for key in ("mxu_util", "hbm_util"):
                 pct = delta[f"roofline_{key}_pct"]

@@ -176,6 +176,13 @@ class RunTelemetry:
             # cap exists to avoid (the registry aggregates below stay
             # always-on — --timing and the summary read them)
             extra = {"error": error} if error else {}
+            # solver-variant provenance per frame (set_run_info): a frame
+            # record never leaves its artifact, but downstream tooling
+            # slices/merges artifacts — `sartsolve metrics --diff` must be
+            # able to see a variant mismatch even on a frame subset
+            for key in ("os_subsets", "momentum", "logarithmic"):
+                if key in self._run_info:
+                    extra[key] = self._run_info[key]
             self._frames.append(schema.make_frame_record(
                 time_s, status, name, iterations, solve_ms, convergence,
                 group, **extra,

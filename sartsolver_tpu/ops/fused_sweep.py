@@ -354,6 +354,94 @@ def sharded_panel_sweep(
     return jnp.concatenate(f_new_parts, axis=1), fitted
 
 
+# --------------------------------------------------------------------------
+# Ordered-subsets (OS-SART) subset primitives (docs/PERFORMANCE.md §9).
+#
+# The OS cycle updates against one PIXEL-ROW subset at a time — the
+# transpose of the voxel-panel decomposition above, reusing its int8 idiom:
+# an int8 subset block is dequantized to bf16 codes (exact, |codes| <= 127)
+# and the per-voxel scales are applied around the dot, never to the matrix
+# (a subset-sized convert per sub-step, one full-matrix-equivalent per outer
+# iteration — budgeted by the ``os_sweep`` audit entries).
+#
+# Subset t is the INTERLEAVED row set {i : i mod n_subsets == t} — not a
+# contiguous stripe. The classic OS prescription (arxiv 1705.07497) needs
+# every subset to sample the full measurement geometry so each sub-update
+# approximates a full-data update at 1/s of the rows; contiguous stripes of
+# a spatially-coherent RTM (adjacent pixels view adjacent voxels) degrade
+# into block Gauss-Seidel with NO iteration-count win — measured on the
+# bench's banded+background response, stripes were 5x SLOWER than classic
+# while interleaving accelerates. Each row is still a contiguous V-length
+# HBM burst, so the strided read costs the same bytes as a stripe. Under
+# pixel sharding the interleave is over each device's LOCAL rows (the
+# global subset is the union over shards), so the subset back-projection
+# psums over the pixel axis exactly like the unfused path's bp. The subset
+# index is a traced loop counter (the cycle runs as a ``fori_loop``), hence
+# reshape + dynamic index with a static subset count.
+
+
+def os_subset_rows(rtm: Array, t, n_subsets: int) -> Array:
+    """Interleaved pixel-row subset ``t`` of this device's RTM block,
+    MXU-ready: ``[P_local/n_subsets, V_local]`` (rows ``t::n_subsets``),
+    int8 codes dequantized to bf16. ``t`` may be traced."""
+    P, V = rtm.shape
+    panel = jax.lax.dynamic_index_in_dim(
+        rtm.reshape(P // n_subsets, n_subsets, V), t, axis=1,
+        keepdims=False,
+    )
+    if panel.dtype == jnp.int8:
+        panel = panel.astype(jnp.bfloat16)
+    return panel
+
+
+def os_subset_pixels(x: Array, t, n_subsets: int) -> Array:
+    """Rows ``t::n_subsets`` of a per-pixel vector/batch: ``[P] ->
+    [P/n]`` or ``[B, P] -> [B, P/n]``; ``t`` may be traced."""
+    if x.ndim == 1:
+        return jax.lax.dynamic_index_in_dim(
+            x.reshape(x.shape[0] // n_subsets, n_subsets), t, axis=1,
+            keepdims=False,
+        )
+    B, P = x.shape
+    return jax.lax.dynamic_index_in_dim(
+        x.reshape(B, P // n_subsets, n_subsets), t, axis=2, keepdims=False,
+    )
+
+
+def os_subset_forward(
+    panel: Array, f: Array, scale: Optional[Array] = None
+) -> Array:
+    """``H_t @ f`` for one subset — ``[B, P/n]``, this device's rows
+    (a voxel-axis psum, if the mesh column-shards, is the caller's).
+    ``scale``: per-voxel int8 dequantization scales (``H = scale * codes``),
+    folded into the forward operand so the contraction is exact."""
+    fwd = f if scale is None else f * scale[None, :]
+    return jax.lax.dot_general(
+        fwd, panel,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def os_subset_back(
+    panel: Array, w: Array, scale: Optional[Array] = None, *, axis_name=None
+) -> Array:
+    """``H_t^T w`` for one subset — ``[B, V_local]``, psummed over the
+    pixel axis when sharded (subsets span every pixel shard). int8: the
+    reduction runs in code space; the per-voxel scales apply once, after
+    the psum — the panel scan's dequantization order."""
+    bp = jax.lax.dot_general(
+        w, panel,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if axis_name is not None:
+        bp = jax.lax.psum(bp, axis_name)
+    if scale is not None:
+        bp = bp * scale[None, :]
+    return bp
+
+
 _selftest_result: dict = {}
 
 

@@ -40,6 +40,7 @@ from sartsolver_tpu.models.sart import (
     SARTProblem,
     SchedState,
     SolveResult,
+    _momentum_carries_fitted,
     compute_ray_stats,
     prepare_measurement,
     sched_step_normalized,
@@ -1150,11 +1151,22 @@ class DistributedSARTSolver:
     # ---- continuous batching (sartsolver_tpu/sched/) ---------------------
 
     def _sched_state_spec(self) -> SchedState:
+        opts = self.opts
+        momentum = opts.momentum != "off"
         return SchedState(
             g=P(None, PIXEL_AXIS), msq=P(), f=P(None, VOXEL_AXIS),
             fitted=P(None, PIXEL_AXIS), conv=P(), it=P(), done=P(),
             status=P(), iters=P(), ascale=P(), recov=P(),
-            obs=P(None, VOXEL_AXIS) if self.opts.logarithmic else None,
+            # os_subsets > 1 stacks the per-subset observations on a
+            # middle axis ([B, os, V_local]); the voxel sharding moves
+            # with the last axis either way
+            obs=((P(None, None, VOXEL_AXIS) if opts.os_subsets > 1
+                  else P(None, VOXEL_AXIS))
+                 if opts.logarithmic else None),
+            f_prev=P(None, VOXEL_AXIS) if momentum else None,
+            fitted_prev=(P(None, PIXEL_AXIS)
+                         if _momentum_carries_fitted(opts) else None),
+            tk=P() if momentum else None,
         )
 
     def _sched_state_sharding(self) -> SchedState:
@@ -1273,9 +1285,25 @@ class DistributedSARTSolver:
             iters=_stage(np.zeros(B, np.int32), self.mesh, rep),
             ascale=_stage(np.ones(B, dtype), self.mesh, rep),
             recov=_stage(np.zeros(B, np.int32), self.mesh, rep),
-            obs=(_stage(np.zeros((B, self.padded_nvoxel), dtype),
-                        self.mesh, vox)
-                 if self.opts.logarithmic else None),
+            obs=(_stage(
+                np.zeros((B, self.opts.os_subsets, self.padded_nvoxel),
+                         dtype)
+                if self.opts.os_subsets > 1
+                else np.zeros((B, self.padded_nvoxel), dtype),
+                self.mesh,
+                P(None, None, VOXEL_AXIS) if self.opts.os_subsets > 1
+                else vox,
+            ) if self.opts.logarithmic else None),
+            # momentum state: f_prev = 1 matches the inert-lane iterate
+            # (log-safe); every refill overwrites it before use
+            f_prev=(_stage(np.ones((B, self.padded_nvoxel), dtype),
+                           self.mesh, vox)
+                    if self.opts.momentum != "off" else None),
+            fitted_prev=(_stage(np.zeros((B, self.padded_npixel), dtype),
+                                self.mesh, pix)
+                         if _momentum_carries_fitted(self.opts) else None),
+            tk=(_stage(np.ones(B, dtype), self.mesh, rep)
+                if self.opts.momentum != "off" else None),
         )
         return SchedLaneState(self, state, B)
 

@@ -1,0 +1,570 @@
+"""Convergence-acceleration drill matrix (docs/PERFORMANCE.md §9).
+
+Pins the ISSUE 10 contracts for ordered-subsets SART and Nesterov/FISTA
+momentum:
+
+- the DEFAULT path (os_subsets=1, momentum off) is byte-identical to the
+  classic sweep — solutions equal bit-for-bit and the lowered HLO text is
+  unchanged;
+- the Eq. 6 invariants (non-negativity clamp, ray-density masking) hold
+  for every accelerated variant across dtypes and mesh layouts
+  (hypothesis property sweep + explicit sharded legs);
+- os_subsets must divide the pixel extent, and explicit fused modes are
+  rejected with os_subsets > 1;
+- the accelerated log solve converges in FEWER iterations at the same
+  stall tolerance and lands on the unaccelerated stall point (parity);
+- relaxation precedence: relaxation * decay^k folds exactly as documented
+  (numpy mirror), momentum restarts never touch relaxation, and an armed
+  divergence guard that never trips is byte-identical to guard-off;
+- rollback composition: a diverging frame under momentum freezes DIVERGED
+  on a finite iterate while its batch peers converge unaffected;
+- continuous batching: retired-lane results are byte-identical to the
+  non-scheduled batch for accelerated variants, per-lane momentum state
+  rides SchedState, and ONE compiled stride program serves every
+  occupancy;
+- the new compile-audit entries (os_sweep / momentum_sweep /
+  log_accel_sweep) are registered with committed goldens.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from sartsolver_tpu.config import SolverOptions  # noqa: E402
+from sartsolver_tpu.models.sart import (  # noqa: E402
+    SARTProblem,
+    _solve_normalized_batch_impl,
+    compute_ray_stats,
+    make_problem,
+    solve_normalized_batch,
+)
+
+P, V = 32, 128
+
+
+def _problem(seed=0, banded=True, dead_voxels=(), **opts_kw):
+    """Small dense problem; ``dead_voxels`` get all-zero columns so the
+    ray-density mask (Eq. 6) has something to mask."""
+    rng = np.random.default_rng(seed)
+    H = rng.random((P, V)).astype(np.float32) * 0.9 + 0.1
+    if banded:
+        ii = np.arange(P, dtype=np.float32)[:, None] / P
+        jj = np.arange(V, dtype=np.float32)[None, :] / V
+        H = H * (np.exp(-((ii - jj) ** 2) * 100.0) + 0.02)
+    for v in dead_voxels:
+        H[:, v] = 0.0
+    f_true = (1.0 + 0.5 * np.sin(2 * np.pi * np.arange(V) / V)).astype(
+        np.float64
+    )
+    g = H.astype(np.float64) @ f_true
+    norm = g.max()
+    g_n = (g / norm).astype(np.float32)
+    msq = np.float32((np.where(g > 0, g, 0) ** 2).sum() / norm**2)
+    opts = SolverOptions(
+        max_iterations=200, conv_tolerance=1e-5, fused_sweep="off",
+        **opts_kw,
+    )
+    problem = make_problem(H, opts=opts)
+    return problem, g_n, msq, opts, f_true, norm
+
+
+def _solve(problem, g_n, msq, opts, B=1):
+    res = solve_normalized_batch(
+        problem, jnp.asarray(np.tile(g_n, (B, 1))),
+        jnp.full((B,), msq, jnp.float32),
+        jnp.zeros((B, V), jnp.float32), opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=True,
+    )
+    return (np.asarray(res.solution), np.asarray(res.iterations),
+            np.asarray(res.status))
+
+
+# ---------------------------------------------------------------------------
+# default-path identity
+# ---------------------------------------------------------------------------
+
+
+def test_default_path_bit_identical():
+    """os_subsets=1 + momentum off must be byte-identical to an opts
+    object that never heard of the accelerators — solutions AND the
+    lowered program text."""
+    problem, g_n, msq, opts, _, _ = _problem()
+    explicit = SolverOptions(
+        max_iterations=200, conv_tolerance=1e-5, fused_sweep="off",
+        os_subsets=1, momentum="off",
+    )
+    sol_a, it_a, _ = _solve(problem, g_n, msq, opts)
+    sol_b, it_b, _ = _solve(problem, g_n, msq, explicit)
+    assert np.array_equal(sol_a, sol_b)
+    assert np.array_equal(it_a, it_b)
+
+    def lower(o):
+        import functools
+
+        return jax.jit(functools.partial(
+            _solve_normalized_batch_impl, opts=o, axis_name=None,
+            voxel_axis=None, use_guess=True,
+        )).lower(
+            problem, jax.ShapeDtypeStruct((1, P), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.float32),
+            jax.ShapeDtypeStruct((1, V), jnp.float32),
+        ).as_text()
+
+    assert lower(opts) == lower(explicit)
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+
+def test_options_validation():
+    with pytest.raises(ValueError, match="os_subsets"):
+        SolverOptions(os_subsets=0)
+    with pytest.raises(ValueError, match="momentum"):
+        SolverOptions(momentum="heavy-ball")
+    for mode in ("on", "interpret"):
+        with pytest.raises(ValueError, match="os_subsets"):
+            SolverOptions(os_subsets=4, fused_sweep=mode)
+    # auto/off compose fine
+    SolverOptions(os_subsets=4, fused_sweep="auto")
+    SolverOptions(os_subsets=4, momentum="nesterov")
+
+
+def test_os_subsets_must_divide_pixels():
+    problem, g_n, msq, _, _, _ = _problem()
+    opts = SolverOptions(
+        max_iterations=5, conv_tolerance=1e-5, fused_sweep="off",
+        os_subsets=5,  # P = 32, 32 % 5 != 0
+    )
+    with pytest.raises(ValueError, match="divide"):
+        _solve(problem, g_n, msq, opts)
+
+
+# ---------------------------------------------------------------------------
+# invariants across the variant matrix
+# ---------------------------------------------------------------------------
+
+VARIANTS = [
+    dict(os_subsets=4),
+    dict(momentum="nesterov"),
+    dict(os_subsets=4, momentum="nesterov"),
+    dict(logarithmic=True, os_subsets=4),
+    dict(logarithmic=True, momentum="nesterov"),
+    dict(logarithmic=True, os_subsets=4, momentum="nesterov"),
+]
+
+
+@pytest.mark.parametrize("kw", VARIANTS,
+                         ids=lambda kw: "-".join(f"{k}={v}" for k, v in
+                                                 sorted(kw.items())))
+@pytest.mark.parametrize("rtm_dtype", [None, "bfloat16", "int8"])
+def test_invariants_variant_matrix(kw, rtm_dtype):
+    """Non-negativity and ray-density masking hold for every accelerated
+    variant and storage dtype; solutions stay finite and converge."""
+    if rtm_dtype == "int8" and kw.get("os_subsets", 1) == 1:
+        pytest.skip("int8 without OS requires the fused sweep (own tests)")
+    dead = (3, 70)
+    # guess_floor=0 so the linear masking assertion below sees an exact
+    # zero at dead voxels (the default floor would hold them at 1e-7 —
+    # also never updated, just less crisp to assert); the log path keeps
+    # its unconditional log_epsilon floor either way
+    problem, g_n, msq, opts, _, _ = _problem(
+        dead_voxels=dead, rtm_dtype=rtm_dtype, guess_floor=0.0, **kw
+    )
+    sol, iters, status = _solve(problem, g_n, msq, opts)
+    assert np.all(np.isfinite(sol))
+    assert status[0] == 0, f"did not converge: {iters[0]} iterations"
+    if kw.get("logarithmic"):
+        # the multiplicative update keeps a positive iterate positive
+        live = np.ones(V, bool)
+        live[list(dead)] = False
+        assert np.all(sol[0, live] > 0)
+    else:
+        assert np.all(sol[0] >= 0)
+    # Eq. 6: a voxel below the ray-density threshold is never updated —
+    # the zero initial guess stays exactly zero there (log: the guess
+    # floor value survives unchanged, see make_problem/guess floors)
+    if not kw.get("logarithmic"):
+        assert np.all(sol[0, list(dead)] == 0.0)
+
+
+try:
+    import hypothesis  # noqa: F401
+
+    HAVE_HYPOTHESIS = True
+except ImportError:
+    HAVE_HYPOTHESIS = False
+
+if HAVE_HYPOTHESIS:
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=10, deadline=None, derandomize=True)
+    @given(
+        seed=st.integers(0, 2**16),
+        os_subsets=st.sampled_from([1, 2, 4, 8]),
+        momentum=st.sampled_from(["off", "nesterov"]),
+        logarithmic=st.booleans(),
+    )
+    def test_invariants_property(seed, os_subsets, momentum, logarithmic):
+        """Hypothesis sweep: clamp/masking/finiteness invariants for any
+        problem seed under subset cycling and momentum extrapolation."""
+        problem, g_n, msq, opts, _, _ = _problem(
+            seed=seed, dead_voxels=(7,), os_subsets=os_subsets,
+            momentum=momentum, logarithmic=logarithmic, guess_floor=0.0,
+        )
+        sol, _, _ = _solve(problem, g_n, msq, opts)
+        assert np.all(np.isfinite(sol))
+        if logarithmic:
+            assert np.all(sol[0, np.arange(V) != 7] > 0)
+        else:
+            assert np.all(sol[0] >= 0)
+            assert sol[0, 7] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# acceleration + parity
+# ---------------------------------------------------------------------------
+
+
+def test_accelerated_log_fewer_iterations_and_parity():
+    """The headline contract: the accelerated log solve reaches the SAME
+    stall tolerance in fewer iterations and lands on the unaccelerated
+    stall point (both are eps-stationary points of one problem)."""
+    problem, g_n, msq, base, _, _ = _problem(seed=3, logarithmic=True)
+    accel = SolverOptions(
+        max_iterations=200, conv_tolerance=1e-5, fused_sweep="off",
+        logarithmic=True, os_subsets=4, momentum="nesterov",
+    )
+    sol_b, it_b, st_b = _solve(problem, g_n, msq, base)
+    sol_a, it_a, st_a = _solve(problem, g_n, msq, accel)
+    assert st_b[0] == 0 and st_a[0] == 0
+    assert it_a[0] < it_b[0], (it_a[0], it_b[0])
+    rel = np.linalg.norm(sol_a - sol_b) / np.linalg.norm(sol_b)
+    assert rel < 0.05, rel
+
+
+def test_momentum_accelerates_linear():
+    problem, g_n, msq, base, _, _ = _problem(seed=5)
+    accel = SolverOptions(
+        max_iterations=200, conv_tolerance=1e-5, fused_sweep="off",
+        momentum="nesterov",
+    )
+    _, it_b, st_b = _solve(problem, g_n, msq, base)
+    _, it_a, st_a = _solve(problem, g_n, msq, accel)
+    assert st_b[0] == 0 and st_a[0] == 0
+    assert it_a[0] < it_b[0], (it_a[0], it_b[0])
+
+
+# ---------------------------------------------------------------------------
+# relaxation precedence (config.py contract)
+# ---------------------------------------------------------------------------
+
+
+def test_relaxation_decay_fold_matches_numpy_mirror():
+    """Pinned precedence: iteration k's step scale is relaxation * decay^k
+    (one multiplicative product), with momentum extrapolating AROUND that
+    scale, never into it. A numpy mirror of the documented semantics must
+    match the device loop to fp32 tolerance at a fixed iteration count."""
+    rng = np.random.default_rng(11)
+    H = rng.random((P, V)).astype(np.float32) * 0.9 + 0.1
+    g = H.astype(np.float64) @ (np.ones(V) * 0.5)
+    norm = g.max()
+    g_n = (g / norm).astype(np.float32)
+    msq = np.float32((g / norm).dot(g / norm))
+    relax, decay, iters = 0.5, 0.8, 4
+
+    for mom in ("off", "nesterov"):
+        opts = SolverOptions(
+            max_iterations=iters, conv_tolerance=0.0, fused_sweep="off",
+            relaxation=relax, relaxation_decay=decay, momentum=mom,
+            guess_floor=0.0,
+        )
+        problem = make_problem(H, opts=opts)
+        sol, _, _ = _solve(problem, g_n, msq, opts)
+
+        H64 = H.astype(np.float64)
+        length = H64.sum(1)
+        dens = H64.sum(0)
+        f = (H64.T @ g_n.astype(np.float64)) / dens  # Eq. 4 guess
+        f_prev, tk = f.copy(), 1.0
+        for k in range(iters):
+            if mom == "nesterov":
+                t_next = 0.5 * (1 + np.sqrt(1 + 4 * tk * tk))
+                beta = (tk - 1) / t_next
+                y = f + beta * (f - f_prev)
+            else:
+                y = f
+            w = (g_n - H64 @ y) / length
+            # THE pinned fold: base relaxation rides the inverse density,
+            # decay^k scales the pixel weights — one product
+            f_new = np.maximum(
+                y + (H64.T @ (w * decay**k)) * (relax / dens), 0
+            )
+            if mom == "nesterov":
+                rs = np.dot(y - f_new, f_new - f) > 0
+                tk = 1.0 if rs else t_next
+                f_prev = f
+            f = f_new
+        np.testing.assert_allclose(sol[0], f, rtol=2e-4, atol=2e-5)
+
+
+def test_armed_guard_untripped_is_identical():
+    """An armed divergence guard that never fires composes with the
+    accelerators as a no-op: ascale = 1 folds exactly, so solutions are
+    byte-identical to guard-off — the precedence product's third factor
+    is inert until a rollback."""
+    for kw in (dict(os_subsets=4, momentum="nesterov"),
+               dict(logarithmic=True, os_subsets=4, momentum="nesterov")):
+        problem, g_n, msq, off, _, _ = _problem(seed=7, **kw)
+        armed = SolverOptions(
+            max_iterations=200, conv_tolerance=1e-5, fused_sweep="off",
+            divergence_recovery=3, **kw,
+        )
+        sol_off, it_off, _ = _solve(problem, g_n, msq, off)
+        sol_on, it_on, _ = _solve(problem, g_n, msq, armed)
+        assert np.array_equal(sol_off, sol_on)
+        assert np.array_equal(it_off, it_on)
+
+
+def test_momentum_rollback_composition():
+    """A frame whose iterate explodes under momentum freezes DIVERGED on
+    a finite iterate (the rollback target is never an extrapolated
+    point), while a healthy frame in the same batch converges to exactly
+    its solo solution."""
+    problem, g_n, msq, _, _, _ = _problem(seed=9)
+    opts = SolverOptions(
+        max_iterations=50, conv_tolerance=1e-5, fused_sweep="off",
+        momentum="nesterov", divergence_recovery=2,
+        divergence_threshold=1.001,
+    )
+    # frame 0 healthy; frame 1's measurement is inflated 10x while its
+    # declared ||g||^2 is not — as the solve fits the inflated data,
+    # ||Hf||^2 crosses threshold * max(msq, 1) and the guard trips until
+    # the ladder exhausts (the linear clamp makes a true NaN explosion
+    # hard to stage; the metric-vs-measurement mismatch is the drill)
+    g2 = np.stack([g_n, g_n * 10.0])
+    msq2 = np.asarray([msq, msq], np.float32)
+    f0 = np.zeros((2, V), np.float32)
+    res = solve_normalized_batch(
+        problem, jnp.asarray(g2), jnp.asarray(msq2), jnp.asarray(f0),
+        opts=opts, axis_name=None, voxel_axis=None, use_guess=False,
+    )
+    status = np.asarray(res.status)
+    sol = np.asarray(res.solution)
+    assert status[1] == -2  # DIVERGED after the ladder exhausted
+    assert np.all(np.isfinite(sol))
+    assert status[0] == 0
+    solo = solve_normalized_batch(
+        problem, jnp.asarray(g_n[None]), jnp.asarray([msq]),
+        jnp.zeros((1, V), jnp.float32), opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=False,
+    )
+    # B=2 vs B=1 changes the gemm reduction order (not the math): the
+    # healthy frame matches its solo solve to reduction tolerance
+    np.testing.assert_allclose(sol[0], np.asarray(solo.solution)[0],
+                               rtol=1e-5, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# sharded layouts + continuous batching composition
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kw", [
+    dict(os_subsets=4, momentum="nesterov"),
+    dict(logarithmic=True, os_subsets=4, momentum="nesterov"),
+])
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (1, 2), (2, 2)])
+def test_sharded_accel_matches_single_device(kw, mesh_shape):
+    """Accelerated solves agree across mesh layouts: the subset psums and
+    the momentum restart's voxel-axis reduction reproduce the one-device
+    result within fp32 reduction tolerance."""
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    problem, g_n, msq, opts, _, norm = _problem(seed=13, **kw)
+    sol_1, it_1, _ = _solve(problem, g_n, msq, opts)
+
+    rng = np.random.default_rng(13)
+    H = rng.random((P, V)).astype(np.float32) * 0.9 + 0.1
+    ii = np.arange(P, dtype=np.float32)[:, None] / P
+    jj = np.arange(V, dtype=np.float32)[None, :] / V
+    H = H * (np.exp(-((ii - jj) ** 2) * 100.0) + 0.02)
+    solver = DistributedSARTSolver(
+        H, opts=opts, mesh=make_mesh(*mesh_shape)
+    )
+    try:
+        res = solver.solve(np.asarray(g_n, np.float64) * norm)
+        np.testing.assert_allclose(
+            res.solution / norm, sol_1[0], rtol=5e-4, atol=5e-5
+        )
+        assert abs(int(res.iterations) - int(it_1[0])) <= 2
+    finally:
+        solver.close()
+
+
+def test_sched_accel_parity_and_one_program():
+    """Continuous batching with accelerators on: retired lanes are
+    byte-identical to the non-scheduled batch path (per-lane momentum
+    state in SchedState), and ONE compiled stride program serves every
+    occupancy across refills."""
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+    from sartsolver_tpu.sched import ContinuousBatcher
+
+    rng = np.random.default_rng(21)
+    H = rng.random((P, V)).astype(np.float32) * 0.9 + 0.1
+    ii = np.arange(P, dtype=np.float32)[:, None] / P
+    jj = np.arange(V, dtype=np.float32)[None, :] / V
+    H = H * (np.exp(-((ii - jj) ** 2) * 100.0) + 0.02)
+    N = 6
+    frames = []
+    for i in range(N):
+        f_i = np.maximum(
+            1.0 + 0.5 * np.sin(2 * np.pi * np.arange(V) / V + i), 1e-3
+        )
+        frames.append(np.maximum(H.astype(np.float64) @ f_i, 0.0))
+    opts = SolverOptions(
+        max_iterations=300, conv_tolerance=1e-5, fused_sweep="off",
+        schedule_stride=8, os_subsets=4, momentum="nesterov",
+    )
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(2, 1))
+    try:
+        base_sols, base_its = [], []
+        for s in range(0, N, 2):
+            res = solver.solve_batch(np.stack(frames[s:s + 2]),
+                                     device_result=True)
+            base_sols.append(res.fetch_solutions())
+            base_its.append(res.iterations)
+        base_sols = np.concatenate(base_sols)
+        base_its = np.concatenate(base_its)
+
+        got = {}
+        batcher = ContinuousBatcher(
+            solver, lanes=2,
+            on_result=lambda ft, _ct, st, it, _cv, fe, _ms:
+                got.__setitem__(int(ft), (st, it, fe)),
+            on_failed=lambda ft, _ct, e:
+                (_ for _ in ()).throw(RuntimeError(str(e))),
+        )
+        batcher.run((frames[i], float(i), ()) for i in range(N))
+        for i in range(N):
+            assert got[i][1] == base_its[i], (i, got[i][1], base_its[i])
+            assert np.array_equal(got[i][2](), base_sols[i]), i
+        assert solver._sched_fn()._cache_size() == 1
+    finally:
+        solver.close()
+
+
+# ---------------------------------------------------------------------------
+# metrics tooling: variant guard + tts gate
+# ---------------------------------------------------------------------------
+
+
+def _run_artifact(os_subsets, iters):
+    from sartsolver_tpu.obs import schema
+
+    return [
+        schema.make_meta_record(os_subsets=os_subsets, momentum="off",
+                                logarithmic=False),
+        schema.make_frame_record(0.0, 0, "SUCCESS", iters, 10.0, 1e-6,
+                                 "g0", os_subsets=os_subsets,
+                                 momentum="off", logarithmic=False),
+        {"type": "metric", "kind": "histogram",
+         "name": "iterations_to_converge", "labels": {},
+         "count": 1, "sum": float(iters), "min": float(iters),
+         "max": float(iters)},
+        schema.make_summary_record(1, {"SUCCESS": 1}),
+    ]
+
+
+def test_metrics_diff_variant_guard():
+    """`sartsolve metrics --diff` must never compare convergence behavior
+    across solver variants silently: mismatched os_subsets/momentum meta
+    skips the iterations/solve-ms gates with a loud note."""
+    from sartsolver_tpu.obs import cli as obs_cli
+
+    old = obs_cli.summarize(_run_artifact(1, 100))
+    new = obs_cli.summarize(_run_artifact(4, 30))
+    delta = obs_cli.diff(old, new)
+    assert delta["iterations_to_converge_mean_pct"] is None
+    assert delta["solve_ms_mean_pct"] is None
+    assert any("variant differs" in n for n in delta["notes"])
+    # same variant on both sides: the gates run
+    same = obs_cli.diff(obs_cli.summarize(_run_artifact(4, 100)),
+                        obs_cli.summarize(_run_artifact(4, 30)))
+    assert same["iterations_to_converge_mean_pct"] is not None
+    assert not any("variant differs" in n for n in same["notes"])
+
+
+def test_metrics_tts_gate_direction():
+    """The tts log iteration speedup is a rate: a drop is the regression
+    direction, and a one-sided section produces the loud skip-note."""
+    from sartsolver_tpu.obs import cli as obs_cli, schema
+
+    def bench_art(speedup):
+        return [schema.make_bench_record(
+            "iter_s", 100.0, "iter/s", 1.0,
+            {"tts": {"log": {"iter_speedup": speedup, "iters_base": 11,
+                             "iters_accel": 3, "parity": True}}},
+        )]
+
+    delta = obs_cli.diff(obs_cli.summarize(bench_art(3.6)),
+                         obs_cli.summarize(bench_art(2.0)))
+    assert delta["tts_log_speedup_pct"] == pytest.approx(-44.44, abs=0.1)
+    one_sided = obs_cli.diff(
+        obs_cli.summarize(bench_art(3.6)),
+        obs_cli.summarize([schema.make_bench_record(
+            "iter_s", 100.0, "iter/s", 1.0, {})]),
+    )
+    assert one_sided["tts_log_speedup_pct"] is None
+    assert any("tts" in n and "skipped" in n for n in one_sided["notes"])
+    # parity=False in the NEW artifact is a hard correctness gate, even
+    # with a better-looking speedup (fewer iterations to a wrong answer)
+    bad = obs_cli.summarize([schema.make_bench_record(
+        "iter_s", 100.0, "iter/s", 1.0,
+        {"tts": {"log": {"iter_speedup": 9.0, "iters_base": 11,
+                         "iters_accel": 1, "parity": False}}},
+    )])
+    gated = obs_cli.diff(obs_cli.summarize(bench_art(3.6)), bad)
+    assert gated["tts_parity_failed"] == ["log"]
+
+
+def test_metrics_variant_from_frame_records():
+    """A frame-sliced artifact (no meta line) still declares its variant
+    through the per-frame fields, so the mismatch guard fires."""
+    from sartsolver_tpu.obs import cli as obs_cli
+
+    sliced_old = _run_artifact(1, 100)[1:]  # drop the meta record
+    sliced_new = _run_artifact(4, 30)[1:]
+    delta = obs_cli.diff(obs_cli.summarize(sliced_old),
+                         obs_cli.summarize(sliced_new))
+    assert delta["iterations_to_converge_mean_pct"] is None
+    assert any("variant differs" in n for n in delta["notes"])
+
+
+# ---------------------------------------------------------------------------
+# audit entries
+# ---------------------------------------------------------------------------
+
+
+def test_accel_audit_entries_registered_with_goldens():
+    from sartsolver_tpu.analysis import registry
+
+    names = set(registry.load_registered_entries())
+    for entry in ("os_sweep", "momentum_sweep", "log_accel_sweep"):
+        assert entry in names, f"audit entry {entry} not registered"
+        base = os.path.join(
+            os.path.dirname(registry.__file__), "goldens", f"{entry}.cpu"
+        )
+        assert os.path.exists(base + ".json"), f"missing golden for {entry}"
+        assert os.path.exists(base + ".cost.json"), (
+            f"missing cost golden for {entry}"
+        )
