@@ -35,7 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
                "(see `sartsolve metrics --help` and "
                "docs/OBSERVABILITY.md); `sartsolve top FILE` — refreshing "
                "one-screen view of a live run from its heartbeat / "
-               "Prometheus textfile / status snapshot. A running solve "
+               "Prometheus textfile / status snapshot; `sartsolve serve` "
+               "/ `sartsolve submit` — resident serving engine with "
+               "admission control, deadlines and a crash-recoverable "
+               "request journal (docs/SERVING.md). A running solve "
                "answers SIGUSR1 with a status snapshot on stderr and "
                "<output>.status.json, and flushes a flight bundle "
                "(<output>.crash.json) on abnormal exits. "
@@ -355,6 +358,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.obs.cli import top_main
 
         return top_main(argv[1:])
+    if argv and argv[0] == "serve":
+        # resident serving engine (docs/SERVING.md): session held warm,
+        # requests from an ingest dir / local socket, crash-recoverable
+        # request journal; dispatched like `lint`, before the solver
+        # parser sees the argv
+        from sartsolver_tpu.engine.cli import serve_main
+
+        return serve_main(argv[1:])
+    if argv and argv[0] == "submit":
+        # serving-engine client (docs/SERVING.md): submit a request to
+        # a running `sartsolve serve` and optionally await its outcome
+        from sartsolver_tpu.engine.cli import submit_main
+
+        return submit_main(argv[1:])
     try:
         args = build_parser().parse_args(argv)
     except SystemExit as err:

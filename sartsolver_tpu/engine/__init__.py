@@ -1,0 +1,25 @@
+"""Resident serving engine (docs/SERVING.md).
+
+The one-shot CLI pays the full cold path — parse, ingest, compile,
+solve, exit — for every run; the engine keeps the expensive state
+resident (RTM + mesh + warm compiled programs) and serves *requests*
+against it with a fault-contained lifecycle:
+
+- :mod:`.request` — the request record, payload parsing, and the
+  machine-readable admission/outcome vocabulary.
+- :mod:`.journal` — the crash-recoverable append-only request journal
+  (accepted -> dispatched -> completed; idempotent replay).
+- :mod:`.admission` — admission control: bounded queue, per-tenant
+  quotas, failure quarantine, degraded-mode load shedding.
+- :mod:`.session` — the resident session (solver + geometry held in
+  memory across requests) and per-request frame-stream attachment.
+- :mod:`.server` — the serve loop: file-watch ingest dir + local
+  socket, deadline-aware dispatch through the continuous batcher,
+  SIGTERM drain, journal replay on restart.
+- :mod:`.cli` — ``sartsolve serve`` / ``sartsolve submit``.
+
+Nothing here is imported by the one-shot CLI path: ``sartsolve solve``
+runs byte-identically with the engine code present but unused.
+"""
+
+from sartsolver_tpu.engine.request import Request, RequestError  # noqa: F401

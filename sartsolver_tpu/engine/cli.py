@@ -1,0 +1,383 @@
+"""``sartsolve serve`` / ``sartsolve submit`` (docs/SERVING.md).
+
+``serve`` takes the one-shot CLI's full flag set (the session is built
+through the same validation gate and ingest) plus the engine options,
+then runs resident: requests arrive as JSON files in
+``<engine_dir>/ingest/`` or over the local socket, verdicts and
+outcomes land in ``<engine_dir>/responses/``, solutions in
+``<engine_dir>/outputs/<id>.h5``, and the request journal in
+``<engine_dir>/journal.jsonl``.
+
+``submit`` is the matching client: build or load a request payload,
+validate it locally, hand it to a serve process (ingest dir or
+socket), optionally wait for the outcome — with exit codes at parity
+with the solver taxonomy (0 clean, 1 malformed input, 2 completed
+with failed/deadline-shed frames, 3 rejected/unavailable, 4
+interrupted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+from sartsolver_tpu.engine.request import (
+    REASON_MALFORMED,
+    REQ_COMPLETED,
+    RequestError,
+    parse_request,
+)
+
+EXIT_OK = 0
+EXIT_INPUT_ERROR = 1
+EXIT_PARTIAL = 2
+EXIT_INFRASTRUCTURE = 3
+EXIT_INTERRUPTED = 4
+
+
+# ---------------------------------------------------------------------------
+# serve
+# ---------------------------------------------------------------------------
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    from sartsolver_tpu.cli import build_parser
+
+    p = build_parser()
+    p.prog = "sartsolve serve"
+    p.description = (
+        "Resident serving engine: hold the RTM + compiled programs in "
+        "memory and solve queued requests against them "
+        "(docs/SERVING.md)."
+    )
+    eng = p.add_argument_group("engine options")
+    eng.add_argument("--engine_dir", required=True,
+                     help="Engine state directory: ingest/ (file-watch "
+                          "request intake), outputs/, responses/, "
+                          "journal.jsonl.")
+    eng.add_argument("--lanes", type=int, default=2,
+                     help="Continuous-batcher lanes serving requests "
+                          "(one fixed-shape compiled program; a device "
+                          "OOM halves this, sticky). Default 2.")
+    eng.add_argument("--max_queue", type=int, default=16,
+                     help="Bounded accepted-request queue; a full queue "
+                          "rejects with reason 'queue-full' instead of "
+                          "queueing to death. Default 16.")
+    eng.add_argument("--max_per_tenant", type=int, default=0,
+                     help="Per-tenant in-queue cap (reason "
+                          "'tenant-quota'); 0 = no cap (default).")
+    eng.add_argument("--quarantine_after", type=int, default=3,
+                     help="Consecutive failing requests before a tenant "
+                          "is quarantined (reason 'tenant-quarantined'). "
+                          "Default 3.")
+    eng.add_argument("--quarantine_cooldown", type=float, default=60.0,
+                     help="Tenant quarantine duration in seconds. "
+                          "Default 60.")
+    eng.add_argument("--default_deadline", type=float, default=None,
+                     help="Default per-request deadline_s for requests "
+                          "that carry none (default: no deadline).")
+    eng.add_argument("--poll_interval", type=float, default=0.2,
+                     help="Ingest-dir poll interval in seconds. "
+                          "Default 0.2.")
+    eng.add_argument("--socket", default=None, metavar="PATH",
+                     help="Also serve admission on a local AF_UNIX "
+                          "socket at PATH (synchronous verdict reply).")
+    eng.add_argument("--idle_exit", type=float, default=0.0,
+                     help="Exit 0 after this many seconds with an empty "
+                          "queue (drills/CI); 0 = serve forever "
+                          "(default).")
+    eng.add_argument("--max_cycle_requests", type=int, default=8,
+                     help="Requests co-batched into one solve cycle. "
+                          "Default 8.")
+    return p
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    parser = build_serve_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as err:
+        raise SystemExit(1 if err.code else 0) from None
+    from sartsolver_tpu.cli import _validate
+
+    _validate(args)
+    if args.lanes < 1:
+        print("Argument lanes must be >= 1.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if args.max_queue < 1:
+        print("Argument max_queue must be >= 1.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+
+    from sartsolver_tpu.utils.cache import configure_compilation_cache
+
+    configure_compilation_cache()
+
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.engine.admission import AdmissionController
+    from sartsolver_tpu.engine.server import EngineServer
+    from sartsolver_tpu.engine.session import ResidentSession
+    from sartsolver_tpu.obs import flight as obs_flight
+    from sartsolver_tpu.obs.run import RunTelemetry
+    from sartsolver_tpu.resilience import shutdown, watchdog
+    from sartsolver_tpu.resilience.failures import RunSummary
+    from sartsolver_tpu.resilience.retry import (
+        RetriesExhausted, reset_retry_stats,
+    )
+
+    reset_retry_stats()
+    # telemetry FIRST (it resets the metric registry; the engine's
+    # instruments register against the fresh one)
+    telem = RunTelemetry.from_cli(args.metrics_out)
+    shutdown.install()
+    obs_flight.install()
+    status_path = obs_flight.default_status_path(
+        os.path.join(args.engine_dir, "engine")
+    )
+    bundle_path = obs_flight.default_bundle_path(
+        os.path.join(args.engine_dir, "engine")
+    )
+    prev_usr1 = obs_flight.install_status_handler(status_path)
+    summary = RunSummary()
+    watchdog.set_crash_hook(
+        lambda reason: obs_flight.write_crash_bundle(
+            bundle_path, reason, summary
+        )
+    )
+    wd = watchdog.Watchdog.from_env(on_event=summary.record_event)
+    if wd is not None:
+        wd.start()
+    abort_reason = None
+    try:
+        try:
+            session = ResidentSession.build(args)
+        except KeyError as err:
+            print(f"Missing dataset or attribute in input files: {err}",
+                  file=sys.stderr)
+            return EXIT_INPUT_ERROR
+        except (SartInputError, OSError) as err:
+            print(err, file=sys.stderr)
+            return EXIT_INPUT_ERROR
+        telem.set_run_info(
+            engine=True,
+            lanes=int(args.lanes),
+            max_queue=int(args.max_queue),
+        )
+        admission = AdmissionController(
+            max_queue=args.max_queue,
+            max_per_tenant=args.max_per_tenant,
+            quarantine_after=args.quarantine_after,
+            quarantine_cooldown=args.quarantine_cooldown,
+        )
+        server = EngineServer(
+            session,
+            engine_dir=args.engine_dir,
+            lanes=args.lanes,
+            admission=admission,
+            poll_interval=args.poll_interval,
+            socket_path=args.socket,
+            default_deadline_s=args.default_deadline,
+            idle_exit=args.idle_exit,
+            max_cycle_requests=args.max_cycle_requests,
+            telemetry=telem,
+        )
+        code = server.run()
+        if code == EXIT_INTERRUPTED:
+            abort_reason = (
+                f"interrupted by {shutdown.stop_signal()} (exit 4)"
+            )
+        # clean/drain exits write a complete artifact; the finally
+        # block's finalize_local stays the abort-path fallback
+        telem.finalize(None)
+        return code
+    except RetriesExhausted as err:
+        # the journal (or another retried site) failed permanently: the
+        # engine must not serve unjournaled work — infrastructure abort
+        abort_reason = f"retries exhausted: {err}"
+        print(f"Unrecoverable after retries: {err}", file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
+    except BaseException as err:
+        abort_reason = f"unhandled {type(err).__name__}: {err}"
+        raise
+    finally:
+        if abort_reason is not None:
+            obs_flight.write_crash_bundle(bundle_path, abort_reason,
+                                          summary)
+        watchdog.set_crash_hook(None)
+        obs_flight.uninstall_status_handler(prev_usr1)
+        obs_flight.uninstall()
+        if wd is not None:
+            wd.stop()
+        shutdown.uninstall()
+        telem.finalize_local(None)
+
+
+# ---------------------------------------------------------------------------
+# submit
+# ---------------------------------------------------------------------------
+
+def build_submit_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sartsolve submit",
+        description="Submit a request to a running `sartsolve serve` "
+                    "engine and optionally wait for its outcome "
+                    "(docs/SERVING.md). Exit codes mirror the solver "
+                    "taxonomy: 0 accepted/completed clean; 1 malformed "
+                    "request or flags; 2 completed with failed or "
+                    "deadline-shed frames; 3 rejected by admission "
+                    "(machine-readable reason on stdout) or engine "
+                    "unreachable.",
+    )
+    p.add_argument("request_file", nargs="?", default=None,
+                   help="JSON request payload file; omit to build one "
+                        "from --id/--tenant/--time_range/--deadline.")
+    p.add_argument("--engine_dir", default=None,
+                   help="Submit via the engine's ingest directory "
+                        "(atomic rename into <engine_dir>/ingest/).")
+    p.add_argument("--socket", default=None, metavar="PATH",
+                   help="Submit over the engine's local socket "
+                        "(synchronous admission verdict).")
+    p.add_argument("--id", dest="req_id", default=None,
+                   help="Request id (required without request_file).")
+    p.add_argument("--tenant", default="default")
+    p.add_argument("--time_range", default="",
+                   help="Frame selection (solver -t grammar; empty = "
+                        "all frames).")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="deadline_s: wall-clock budget from acceptance.")
+    p.add_argument("--wait", type=float, default=0.0, metavar="S",
+                   help="Wait up to S seconds for the outcome response "
+                        "(needs --engine_dir; 0 = do not wait).")
+    return p
+
+
+def _outcome_exit(rec: dict) -> int:
+    if rec.get("verdict") == "rejected":
+        reason = rec.get("reason")
+        print(json.dumps(rec))
+        return (EXIT_INPUT_ERROR if reason == REASON_MALFORMED
+                else EXIT_INFRASTRUCTURE)
+    outcome = rec.get("outcome") or {}
+    print(json.dumps(rec))
+    state = rec.get("state")
+    if state == "interrupted":
+        return EXIT_INTERRUPTED
+    if not outcome:
+        return EXIT_OK  # accepted, not waited for
+    status = outcome.get("status")
+    if status == REQ_COMPLETED:
+        return EXIT_OK
+    if status in ("partial", "shed-deadline"):
+        return EXIT_PARTIAL
+    return EXIT_INFRASTRUCTURE  # failed / unknown
+
+
+def submit_main(argv: Optional[List[str]] = None) -> int:
+    parser = build_submit_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as err:
+        raise SystemExit(1 if err.code else 0) from None
+    if (args.engine_dir is None) == (args.socket is None):
+        print("sartsolve submit: exactly one of --engine_dir or "
+              "--socket is required.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if args.request_file is not None:
+        try:
+            with open(args.request_file) as f:
+                payload_text = f.read()
+        except OSError as err:
+            print(err, file=sys.stderr)
+            return EXIT_INPUT_ERROR
+    else:
+        if not args.req_id:
+            print("sartsolve submit: --id is required without a "
+                  "request file.", file=sys.stderr)
+            return EXIT_INPUT_ERROR
+        payload = {"id": args.req_id, "tenant": args.tenant,
+                   "time_range": args.time_range}
+        if args.deadline is not None:
+            payload["deadline_s"] = args.deadline
+        payload_text = json.dumps(payload)
+    # local validation: a malformed request fails HERE with the polite
+    # input-error exit, before it ever reaches the engine
+    try:
+        req = parse_request(payload_text)
+    except RequestError as err:
+        print(err, file=sys.stderr)
+        return EXIT_INPUT_ERROR
+
+    if args.socket:
+        import socket as socketmod
+
+        if not hasattr(socketmod, "AF_UNIX"):
+            print("sartsolve submit: AF_UNIX sockets unavailable on "
+                  "this platform; use --engine_dir.", file=sys.stderr)
+            return EXIT_INFRASTRUCTURE
+        try:
+            sock = socketmod.socket(socketmod.AF_UNIX,
+                                    socketmod.SOCK_STREAM)
+            sock.settimeout(10.0)
+            sock.connect(args.socket)
+            sock.sendall(payload_text.encode())
+            sock.shutdown(socketmod.SHUT_WR)
+            chunks = []
+            while True:
+                chunk = sock.recv(65536)
+                if not chunk:
+                    break
+                chunks.append(chunk)
+            sock.close()
+        except OSError as err:
+            print(f"sartsolve submit: socket submit failed: {err}",
+                  file=sys.stderr)
+            return EXIT_INFRASTRUCTURE
+        try:
+            rec = json.loads(b"".join(chunks).decode())
+        except ValueError:
+            print("sartsolve submit: unreadable engine reply.",
+                  file=sys.stderr)
+            return EXIT_INFRASTRUCTURE
+        return _outcome_exit(rec)
+
+    ingest = os.path.join(args.engine_dir, "ingest")
+    responses = os.path.join(args.engine_dir, "responses")
+    if not os.path.isdir(ingest):
+        print(f"sartsolve submit: no engine ingest dir at {ingest} "
+              "(is `sartsolve serve` running with this --engine_dir?).",
+              file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
+    t_submit = time.time()
+    tmp = os.path.join(ingest, f".{req.id}.{os.getpid()}.tmp")
+    final = os.path.join(ingest, f"{req.id}.json")
+    try:
+        with open(tmp, "w") as f:
+            f.write(payload_text)
+        os.replace(tmp, final)
+    except OSError as err:
+        print(f"sartsolve submit: submit failed: {err}", file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
+    if args.wait <= 0:
+        print(json.dumps({"id": req.id, "state": "submitted"}))
+        return EXIT_OK
+    resp_path = os.path.join(responses, f"{req.id}.json")
+    deadline = time.monotonic() + args.wait
+    while time.monotonic() < deadline:
+        try:
+            with open(resp_path) as f:
+                rec = json.loads(f.read())
+        except (OSError, ValueError):
+            rec = None
+        # only responses written AFTER this submit count — a stale
+        # record from an earlier submission of the same id (e.g. the
+        # duplicate-rejection flow) must not read as this one's outcome
+        if rec and rec.get("unix", 0) >= t_submit - 0.05:
+            if (rec.get("verdict") == "rejected"
+                    or rec.get("state") in ("done", "interrupted")):
+                return _outcome_exit(rec)
+        time.sleep(0.1)
+    print(f"sartsolve submit: no outcome for {req.id!r} within "
+          f"{args.wait:g}s.", file=sys.stderr)
+    return EXIT_INFRASTRUCTURE

@@ -1,0 +1,171 @@
+"""Crash-recoverable append-only request journal (docs/SERVING.md §4).
+
+One JSONL file under the engine directory records every request's
+lifecycle as three markers::
+
+    {"marker": "accepted",   "id": ..., "unix": ..., "request": {...}}
+    {"marker": "dispatched", "id": ..., "unix": ...}
+    {"marker": "completed",  "id": ..., "unix": ..., "outcome": {...}}
+
+Durability and replay contract (pinned by the crash drill in
+tests/test_engine.py):
+
+- Every append is flush+fsync'd before the engine acts on it, so after
+  a ``kill -9`` at ANY instant the journal is a consistent prefix of
+  the run (a torn final line — the kill landing mid-append — is
+  ignored by :func:`replay`, which is exactly the state "the marker
+  never landed").
+- Replay is idempotent by id: a request with a ``completed`` marker is
+  never re-run; a request with ``accepted`` (with or without
+  ``dispatched``) but no ``completed`` is re-run from its journaled
+  payload, in acceptance order, ahead of new ingest. Requests solve
+  frames independently (no cross-request warm state), so a replayed
+  solve writes byte-identical output.
+
+Named fault site ``journal.append`` (wrapped in the shared retry
+policy): the journal is the engine's correctness backbone, so a
+*permanent* append failure is an engine abort (EXIT_INFRASTRUCTURE),
+never a silently unjournaled request.
+
+Deterministic crash windows for the kill drill: with
+``SART_TEST_JOURNAL_DELAY`` set, the named commit points announce
+``SART_JOURNAL_POINT <name>`` on stderr and sleep inside the window —
+"accepted" / "dispatched" (marker durable, nothing acted on yet) and
+"pre-flush" (outputs written, completed marker NOT yet durable).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from sartsolver_tpu.engine.request import Request
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.retry import retry_call
+
+MARKER_ACCEPTED = "accepted"
+MARKER_DISPATCHED = "dispatched"
+MARKER_COMPLETED = "completed"
+
+_MARKERS = (MARKER_ACCEPTED, MARKER_DISPATCHED, MARKER_COMPLETED)
+
+
+def _crash_window(point: str) -> None:
+    """Test-only hook mirroring io/solution.py's flush windows: announce
+    the commit point and hold it open so the kill drill can SIGKILL the
+    real serve process deterministically inside it. Zero work unset."""
+    delay = os.environ.get("SART_TEST_JOURNAL_DELAY")
+    if delay:
+        sys.stderr.write(f"SART_JOURNAL_POINT {point}\n")
+        sys.stderr.flush()
+        time.sleep(float(delay))
+
+
+class RequestJournal:
+    """Append-only journal over one JSONL file."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    # ---- append ----------------------------------------------------------
+
+    def append(self, marker: str, request_id: str, **data) -> None:
+        """Durably append one marker record (flush + fsync before
+        returning). The ``completed`` marker exposes the "pre-flush"
+        crash window BEFORE the record lands (outputs are on disk, the
+        completion is not — a kill there must replay the request);
+        ``accepted``/``dispatched`` expose theirs AFTER (the marker is
+        durable, the work it promises has not started)."""
+        if marker not in _MARKERS:
+            raise ValueError(f"Unknown journal marker {marker!r}.")
+        rec = {"marker": marker, "id": str(request_id),
+               "unix": round(time.time(), 3)}
+        rec.update(data)
+        line = json.dumps(rec) + "\n"
+        if marker == MARKER_COMPLETED:
+            _crash_window("pre-flush")
+
+        def write() -> None:
+            faults.fire(faults.SITE_JOURNAL_APPEND)
+            with open(self.path, "a") as f:
+                f.write(line)
+                f.flush()
+                os.fsync(f.fileno())
+
+        # transient fs blips (an NFS hiccup under the engine dir) retry
+        # with the shared policy; exhaustion raises RetriesExhausted,
+        # which the server maps to the infrastructure abort — an engine
+        # that cannot journal must stop, not serve unjournaled work
+        retry_call(write, site=faults.SITE_JOURNAL_APPEND,
+                   retry_on=(OSError,))
+        if marker != MARKER_COMPLETED:
+            _crash_window(marker)
+
+    def accepted(self, request: Request) -> None:
+        self.append(MARKER_ACCEPTED, request.id, request=request.to_dict())
+
+    def dispatched(self, request: Request) -> None:
+        self.append(MARKER_DISPATCHED, request.id)
+
+    def completed(self, request: Request, outcome: dict) -> None:
+        self.append(MARKER_COMPLETED, request.id, outcome=outcome)
+
+    # ---- replay ----------------------------------------------------------
+
+    def replay(self) -> Tuple[Dict[str, dict], List[Request]]:
+        """Read the journal back: ``(completed, pending)``.
+
+        ``completed`` maps request id -> its outcome dict (these are
+        never re-run, and re-submissions of the same id are rejected as
+        duplicates). ``pending`` holds the accepted-but-not-completed
+        requests, reconstructed from their journaled payloads, in
+        acceptance order — the restart re-runs exactly these. A torn
+        final line (kill mid-append) is skipped; a torn line anywhere
+        else would mean the fsync contract broke, but replay still
+        degrades per-line rather than refusing the whole journal."""
+        completed: Dict[str, dict] = {}
+        accepted: Dict[str, Request] = {}
+        order: List[str] = []
+        if not os.path.exists(self.path):
+            return completed, []
+        with open(self.path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # torn append (the kill window); marker absent
+                marker = rec.get("marker")
+                rid = rec.get("id")
+                if not isinstance(rid, str):
+                    continue
+                if marker == MARKER_ACCEPTED:
+                    # direct reconstruction, NOT parse_request: the
+                    # payload was validated at acceptance, and replay
+                    # must not consult the request.parse fault site (an
+                    # armed ingest-parse drill would otherwise silently
+                    # drop journaled work on restart)
+                    raw = rec.get("request") or {}
+                    if not isinstance(raw, dict):
+                        continue
+                    req = Request(
+                        id=rid,
+                        tenant=str(raw.get("tenant", "default")),
+                        time_range=str(raw.get("time_range", "")),
+                        deadline_s=raw.get("deadline_s"),
+                        submitted_unix=float(
+                            raw.get("submitted_unix") or 0.0
+                        ),
+                    )
+                    if rid not in accepted:
+                        accepted[rid] = req
+                        order.append(rid)
+                elif marker == MARKER_COMPLETED:
+                    completed[rid] = rec.get("outcome") or {}
+        pending = [accepted[rid] for rid in order if rid not in completed]
+        return completed, pending

@@ -1,0 +1,640 @@
+"""The serve loop: ingest, admission, dispatch, journal, drain.
+
+:class:`EngineServer` ties the engine together (docs/SERVING.md):
+
+- **Ingest** — a file-watch directory (``<engine_dir>/ingest/``; one
+  JSON request per file, atomic-rename submitted) polled between
+  cycles, plus an optional local AF_UNIX socket served from a side
+  thread (same admission path, synchronous verdict reply).
+- **Admission** — :class:`~sartsolver_tpu.engine.admission.
+  AdmissionController`; every verdict lands in a response file
+  (``<engine_dir>/responses/<id>.json``) a submitter can poll.
+- **Journal** — accepted -> dispatched -> completed markers, fsync'd
+  before the engine acts on them; replayed on restart (completed
+  requests are never re-run, accepted-but-unfinished ones are, with
+  byte-identical outputs).
+- **Dispatch** — each cycle drains the queue through ONE continuous-
+  batcher run over the resident solver's lanes: requests are co-batched
+  frame-wise, deadlines ride the stream items and shed at stride
+  boundaries (sched/scheduler.py), results route back to per-request
+  writers in frame order.
+- **Degradation** — a device OOM halves the lane count (sticky, like
+  the CLI's group ladder) and flips admission into degraded load-shed
+  mode; per-frame failures become FAILED rows; a request whose frames
+  keep failing moves its tenant toward quarantine.
+- **Drain** — SIGTERM (resilience/shutdown.py) stops intake
+  (rejections say ``draining``), finishes what the batcher already
+  holds, journals the rest as accepted, and exits 4; ``kill -9``
+  recovery is the journal's job.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import socket as socketmod
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from sartsolver_tpu.config import SDC_DETECTED, SartInputError
+from sartsolver_tpu.engine import request as reqmod
+from sartsolver_tpu.engine.admission import AdmissionController
+from sartsolver_tpu.engine.journal import RequestJournal
+from sartsolver_tpu.engine.request import Request, RequestError, parse_request
+from sartsolver_tpu.engine.session import ResidentSession, absolute_deadline
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.resilience import shutdown, watchdog
+from sartsolver_tpu.resilience.failures import (
+    DEADLINE_EXCEEDED,
+    DIVERGED,
+    EXIT_INTERRUPTED,
+    EXIT_OK,
+    FRAME_FAILED,
+    RECOVERABLE_FRAME_ERRORS,
+    FrameFailure,
+    failed_row,
+    status_name,
+)
+
+_TERMINAL_FRAME_STATUSES = (DIVERGED, FRAME_FAILED, SDC_DETECTED)
+
+
+class _ActiveRequest:
+    """One dispatched request's in-cycle bookkeeping."""
+
+    __slots__ = ("req", "deadline", "expected", "got", "by_status",
+                 "writer", "t_dispatch", "deadline_missed", "output")
+
+    def __init__(self, req: Request, expected: int,
+                 deadline: Optional[float], output: str):
+        self.req = req
+        self.deadline = deadline
+        self.expected = int(expected)
+        self.got = 0
+        self.by_status: Dict[str, int] = {}
+        self.writer = None  # lazy SolutionWriter
+        self.t_dispatch = time.perf_counter()
+        self.deadline_missed = False
+        self.output = output
+
+    @property
+    def done(self) -> bool:
+        return self.got >= self.expected
+
+
+class EngineServer:
+    """One resident serve process's request lifecycle owner."""
+
+    def __init__(
+        self,
+        session: ResidentSession,
+        *,
+        engine_dir: str,
+        lanes: int = 2,
+        admission: Optional[AdmissionController] = None,
+        poll_interval: float = 0.2,
+        socket_path: Optional[str] = None,
+        default_deadline_s: Optional[float] = None,
+        idle_exit: float = 0.0,
+        max_cycle_requests: int = 8,
+        telemetry=None,
+    ):
+        if lanes < 1:
+            raise ValueError("lanes must be >= 1.")
+        self.session = session
+        self.engine_dir = engine_dir
+        self.ingest_dir = os.path.join(engine_dir, "ingest")
+        self.outputs_dir = os.path.join(engine_dir, "outputs")
+        self.responses_dir = os.path.join(engine_dir, "responses")
+        for d in (engine_dir, self.ingest_dir, self.outputs_dir,
+                  self.responses_dir):
+            os.makedirs(d, exist_ok=True)
+        self.journal = RequestJournal(os.path.join(engine_dir,
+                                                   "journal.jsonl"))
+        self.admission = admission if admission is not None \
+            else AdmissionController(on_event=self._event)
+        if self.admission._on_event is None:
+            self.admission._on_event = self._event
+        self.lanes = int(lanes)
+        self.initial_lanes = int(lanes)
+        self.poll_interval = float(poll_interval)
+        self.socket_path = socket_path
+        self.default_deadline_s = default_deadline_s
+        self.idle_exit = float(idle_exit)
+        self.max_cycle_requests = max(1, int(max_cycle_requests))
+        self.telemetry = telemetry
+        # accepted-not-yet-dispatched: (Request, accepted_monotonic)
+        self._queue: List[Tuple[Request, float]] = []
+        # one lock guards admission-state mutation + queue + journal +
+        # response writes: the socket thread admits concurrently with
+        # the serve loop, and EVERY AdmissionController mutation
+        # (admit / note_dispatched / note_outcome / set_degraded) must
+        # hold it — a lost queue_depth update would either wedge the
+        # bounded queue at "full" or silently disable backpressure
+        self._lock = threading.Lock()
+        self._active_ids: List[str] = []
+        self._draining = False
+        self._cycles = 0
+        # bounded: a serve-forever daemon must not grow a list one
+        # entry per request for the process lifetime (the telemetry
+        # sink and stdout get every event; this is just the recent tail)
+        self.events: deque = deque(maxlen=256)
+        self._sock = None
+        self._sock_thread = None
+        self._sock_stop = threading.Event()
+        registry = obs_metrics.get_registry()
+        self._queue_wait_hist = registry.histogram("engine_queue_wait_s")
+        self._solve_hist = registry.histogram("engine_request_solve_s")
+        self._deadline_miss_ctr = registry.counter(
+            "engine_deadline_miss_total"
+        )
+        self._requests_ctrs: Dict[str, object] = {}
+        self._lanes_gauge = registry.gauge("engine_lanes")
+        self._lanes_gauge.set(float(lanes))
+
+    # ---- events / status -------------------------------------------------
+
+    def _event(self, message: str) -> None:
+        self.events.append(str(message))
+        if self.telemetry is not None:
+            self.telemetry.record_event(message)
+        print(f"sartsolve engine: {message}", flush=True)
+
+    def _requests_ctr(self, outcome: str):
+        ctr = self._requests_ctrs.get(outcome)
+        if ctr is None:
+            ctr = obs_metrics.get_registry().counter(
+                "engine_requests_total", outcome=outcome
+            )
+            self._requests_ctrs[outcome] = ctr
+        return ctr
+
+    def _status(self) -> dict:
+        """Engine view for the heartbeat line / SIGUSR1 status snapshot
+        (watchdog.set_engine_status_provider): attributes a wedged
+        daemon's stall to a request, not just a pipeline phase. Lock-
+        free reads of GIL-atomic fields — this runs from the heartbeat
+        write and from signal context."""
+        adm = self.admission
+        shed_total = 0
+        for ctr in adm._shed_ctrs.values():
+            shed_total += int(ctr.value)
+        return {
+            "queue_depth": int(adm.queue_depth),
+            "admitted": int(adm._admitted_ctr.value),
+            "shed": shed_total,
+            "quarantined_tenants": adm.quarantined_tenants(),
+            "active_requests": list(self._active_ids),
+            "lanes": int(self.lanes),
+            "degraded": adm.degraded_reason,
+            "draining": bool(self._draining),
+            "cycles": int(self._cycles),
+            "tenants": adm.tenant_view(),
+        }
+
+    # ---- responses -------------------------------------------------------
+
+    def _read_response(self, key: str) -> Optional[dict]:
+        try:
+            with open(os.path.join(self.responses_dir,
+                                   f"{key}.json")) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _respond(self, key: str, payload: dict) -> None:
+        """Atomically publish a response record a submitter can poll."""
+        path = os.path.join(self.responses_dir, f"{key}.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        payload = {"unix": round(time.time(), 3), **payload}
+        try:
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+                f.write("\n")
+            os.replace(tmp, path)
+        except OSError as err:
+            self._event(f"response write for {key!r} failed: {err}")
+
+    # ---- admission (shared by ingest dir and socket) ---------------------
+
+    def _admit_payload(self, payload, *, source: str) -> dict:
+        """Parse + admit one raw payload under the engine lock; returns
+        the response record (also published to the responses dir)."""
+        try:
+            req = parse_request(
+                payload, default_deadline_s=self.default_deadline_s
+            )
+        except (RequestError, OSError, RuntimeError) as err:
+            # RequestError: client bug. OSError/RuntimeError: a torn
+            # read or the armed request.parse fault — the payload is
+            # unusable either way; reject loudly, keep serving.
+            rec = {"verdict": "rejected",
+                   "reason": reqmod.REASON_MALFORMED,
+                   "error": f"{type(err).__name__}: {err}",
+                   "source": source}
+            with self._lock:
+                self.admission.shed(reqmod.REASON_MALFORMED)
+            return rec
+        with self._lock:
+            reason = self.admission.admit(req, draining=self._draining)
+            if reason is None:
+                self.journal.accepted(req)
+                self._queue.append((req, time.monotonic()))
+                rec = {"id": req.id, "verdict": "accepted",
+                       "state": "pending", "tenant": req.tenant,
+                       "source": source}
+            else:
+                rec = {"id": req.id, "verdict": "rejected",
+                       "reason": reason, "tenant": req.tenant,
+                       "source": source}
+        if reason == reqmod.REASON_DUPLICATE:
+            # idempotency, not amnesia: a resubmitted id must never
+            # clobber the original's response record. A completed
+            # original's outcome is re-published (the duplicate
+            # submitter gets the recorded result, timestamp refreshed
+            # for its poll); a still-pending original's record is left
+            # untouched — the rejection reaches only this reply, and
+            # both submitters resolve from the original's outcome.
+            prev = self._read_response(req.id)
+            if prev and prev.get("state") == "done":
+                rec = dict(prev)
+                rec["duplicate"] = True
+                rec.pop("unix", None)
+                self._respond(req.id, rec)
+                rec = {"unix": round(time.time(), 3), **rec}
+            return rec
+        self._respond(req.id, rec)
+        return rec
+
+    def _scan_ingest(self) -> int:
+        """Admit every request file currently in the ingest dir (sorted
+        by name — submitters that need ordering encode it there)."""
+        try:
+            names = sorted(os.listdir(self.ingest_dir))
+        except OSError:
+            return 0
+        n = 0
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.ingest_dir, name)
+            try:
+                with open(path) as f:
+                    payload = f.read()
+            except OSError as err:
+                self._event(f"unreadable request file {name!r}: {err}")
+                payload = None
+            if payload is not None:
+                rec = self._admit_payload(payload, source=f"file:{name}")
+            else:
+                with self._lock:
+                    self.admission.shed(reqmod.REASON_MALFORMED)
+                rec = {"verdict": "rejected",
+                       "reason": reqmod.REASON_MALFORMED,
+                       "error": "unreadable request file"}
+            if "id" not in rec:
+                # unparseable payloads still get a response, keyed by
+                # the file stem, so the submitter is never left polling
+                self._respond(os.path.splitext(name)[0], rec)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            n += 1
+        return n
+
+    # ---- socket ----------------------------------------------------------
+
+    def _start_socket(self) -> None:
+        if not self.socket_path or not hasattr(socketmod, "AF_UNIX"):
+            return
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+        sock = socketmod.socket(socketmod.AF_UNIX, socketmod.SOCK_STREAM)
+        sock.bind(self.socket_path)
+        sock.listen(8)
+        sock.settimeout(0.2)
+        self._sock = sock
+        self._sock_thread = threading.Thread(
+            target=self._serve_socket, name="sart-engine-socket",
+            daemon=True,
+        )
+        self._sock_thread.start()
+
+    def _serve_socket(self) -> None:
+        while not self._sock_stop.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socketmod.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                conn.settimeout(5.0)
+                chunks = []
+                while True:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    chunks.append(chunk)
+                payload = b"".join(chunks).decode("utf-8", "replace")
+                rec = self._admit_payload(payload, source="socket")
+                conn.sendall((json.dumps(rec) + "\n").encode())
+            except Exception as err:  # noqa: BLE001 - keep the listener up
+                self._event(f"socket request failed: {err}")
+            finally:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def _stop_socket(self) -> None:
+        self._sock_stop.set()
+        if self._sock_thread is not None:
+            self._sock_thread.join(timeout=2)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        if self.socket_path:
+            try:
+                os.unlink(self.socket_path)
+            except OSError:
+                pass
+
+    # ---- replay ----------------------------------------------------------
+
+    def _replay(self) -> None:
+        completed, pending = self.journal.replay()
+        for rid in completed:
+            self.admission.note_seen(rid)
+        if not completed and not pending:
+            return
+        for req in pending:
+            # re-accepted ahead of new ingest, in acceptance order; a
+            # partial output from the interrupted attempt is removed so
+            # the re-run writes the file fresh (byte-identical replay)
+            self.admission.note_seen(req.id)
+            self.admission.queue_depth += 1
+            self.admission._tenant(req.tenant).queued += 1
+            self.admission._depth_gauge.set(
+                float(self.admission.queue_depth)
+            )
+            self._queue.append((req, time.monotonic()))
+            out = os.path.join(self.outputs_dir, f"{req.id}.h5")
+            try:
+                os.unlink(out)
+            except OSError:
+                pass
+        self._event(
+            f"journal replay: {len(completed)} completed request(s) "
+            f"skipped, {len(pending)} accepted-but-unfinished "
+            "re-queued"
+        )
+
+    # ---- request finalization --------------------------------------------
+
+    def _finish(self, ar: _ActiveRequest, outcome: str,
+                error: Optional[str] = None) -> None:
+        if ar.writer is not None:
+            ar.writer.flush()
+            self.session.grid.write_hdf5(ar.output, "voxel_map")
+        wall = time.perf_counter() - ar.t_dispatch
+        self._solve_hist.observe(wall)
+        if ar.deadline_missed:
+            self._deadline_miss_ctr.inc()
+        rec = {
+            "status": outcome,
+            "frames": ar.got,
+            "by_status": dict(ar.by_status),
+            "output": (os.path.relpath(ar.output, self.engine_dir)
+                       if ar.writer is not None else None),
+            "solve_s": round(wall, 3),
+        }
+        if error:
+            rec["error"] = error
+        with self._lock:
+            self.journal.completed(ar.req, rec)
+            self.admission.note_outcome(ar.req, outcome)
+        self._requests_ctr(outcome).inc()
+        self._respond(ar.req.id, {
+            "id": ar.req.id, "verdict": "accepted", "state": "done",
+            "outcome": rec,
+        })
+        if self.telemetry is not None:
+            self.telemetry.record_event(
+                f"request {ar.req.id} ({ar.req.tenant}): {outcome} "
+                f"({ar.got} frame(s) in {wall:.3f}s)"
+            )
+        if ar.req.id in self._active_ids:
+            self._active_ids.remove(ar.req.id)
+
+    # ---- the solve cycle -------------------------------------------------
+
+    def _solve_cycle(self, batch: List[Tuple[Request, float]]) -> None:
+        from sartsolver_tpu.sched import ContinuousBatcher
+
+        now = time.monotonic()
+        gens = []
+        route: deque = deque()
+        active: List[_ActiveRequest] = []
+        for req, t_acc in batch:
+            with self._lock:
+                self.admission.note_dispatched(req)
+            self._queue_wait_hist.observe(now - t_acc)
+            deadline = absolute_deadline(req, t_acc)
+            output = os.path.join(self.outputs_dir, f"{req.id}.h5")
+            if deadline is not None and now > deadline:
+                # queue wait alone blew the budget: shed WITHOUT
+                # touching the solver (the load-shedding half of the
+                # deadline contract)
+                ar = _ActiveRequest(req, 0, deadline, output)
+                ar.deadline_missed = True
+                with self._lock:
+                    self.journal.dispatched(req)
+                self._finish(ar, reqmod.REQ_SHED_DEADLINE,
+                             error="deadline passed while queued")
+                continue
+            with self._lock:
+                self.journal.dispatched(req)
+            try:
+                image = self.session.attach(req)
+            except (SartInputError,) + RECOVERABLE_FRAME_ERRORS as err:
+                ar = _ActiveRequest(req, 0, deadline, output)
+                self._finish(ar, reqmod.REQ_FAILED,
+                             error=f"{type(err).__name__}: {err}")
+                continue
+            ar = _ActiveRequest(req, self.session.n_frames(image),
+                                deadline, output)
+            self._active_ids.append(req.id)
+            if ar.expected == 0:
+                self._finish(ar, reqmod.REQ_COMPLETED)
+                continue
+            active.append(ar)
+            route.extend([ar] * ar.expected)
+            gens.append(self.session.frame_items(image, deadline))
+        if not active:
+            return
+
+        nvoxel = self.session.nvoxel
+
+        def add_row(ar: _ActiveRequest, row, status: int, ftime,
+                    cam_times, iterations: int) -> None:
+            if ar.writer is None:
+                from sartsolver_tpu.io.solution import SolutionWriter
+
+                ar.writer = SolutionWriter(
+                    ar.output, self.session.camera_names, nvoxel,
+                )
+            ar.writer.add(row, status, ftime, cam_times,
+                          iterations=iterations)
+            name = status_name(status)
+            ar.by_status[name] = ar.by_status.get(name, 0) + 1
+            ar.got += 1
+            watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+
+        def on_result(ftime, cam_times, status, iterations, convergence,
+                      fetcher, per_frame_ms) -> None:
+            ar = route.popleft()
+            row = fetcher() if callable(fetcher) else np.asarray(fetcher)
+            add_row(ar, row, status, ftime, cam_times, iterations)
+            if status == DEADLINE_EXCEEDED:
+                ar.deadline_missed = True
+            if self.telemetry is not None:
+                self.telemetry.record_frame(
+                    ftime, status, iterations, convergence,
+                    per_frame_ms, "engine",
+                )
+            if ar.done:
+                self._finish_solved(ar)
+
+        def on_failed(ftime, cam_times, err) -> None:
+            ar = route.popleft()
+            add_row(ar, failed_row(nvoxel), FRAME_FAILED, ftime,
+                    cam_times, -1)
+            if self.telemetry is not None:
+                self.telemetry.record_frame(
+                    ftime, FRAME_FAILED, -1, None, None, "engine",
+                    error=type(err).__name__,
+                )
+            if ar.done:
+                self._finish_solved(ar)
+
+        items = iter(itertools.chain.from_iterable(gens))
+        interrupted = False
+        while True:
+            batcher = ContinuousBatcher(
+                self.session.solver, lanes=self.lanes,
+                on_result=on_result, on_failed=on_failed,
+                stop_check=shutdown.stop_requested,
+                on_event=self._event, isolate=True,
+            )
+            stats = batcher.run(items)
+            interrupted = interrupted or stats.interrupted
+            if stats.leftover is None:
+                break
+            # device OOM: halve the lane count (sticky, the CLI ladder's
+            # semantics) and flip admission into degraded load-shed mode
+            if self.lanes <= 1:
+                # the ladder is exhausted: every un-emitted frame —
+                # handed back by the scheduler AND still unread from the
+                # stream — fails in order (per-frame isolation)
+                for item in itertools.chain(stats.leftover, items):
+                    if isinstance(item, FrameFailure):
+                        on_failed(item.time, item.camera_times,
+                                  item.error)
+                    else:
+                        on_failed(item[1], item[2], stats.oom_error)
+                break
+            self.lanes = max(self.lanes // 2, 1)
+            self._lanes_gauge.set(float(self.lanes))
+            with self._lock:
+                self.admission.set_degraded(
+                    f"device OOM; lanes halved to {self.lanes}"
+                )
+            items = iter(itertools.chain(stats.leftover, items))
+        # requests truncated by a mid-cycle stop request: leave them
+        # journaled dispatched-but-not-completed — the restart replays
+        # them from scratch (their partial outputs are removed then)
+        if interrupted and route:
+            truncated = []
+            for ar in route:
+                if ar.req.id not in truncated:
+                    truncated.append(ar.req.id)
+            for ar in active:
+                if ar.req.id in truncated:
+                    if ar.req.id in self._active_ids:
+                        self._active_ids.remove(ar.req.id)
+                    self._respond(ar.req.id, {
+                        "id": ar.req.id, "verdict": "accepted",
+                        "state": "interrupted",
+                    })
+            self._event(
+                f"stop request truncated the cycle; "
+                f"{len(truncated)} request(s) left for journal replay"
+            )
+            route.clear()
+
+    def _finish_solved(self, ar: _ActiveRequest) -> None:
+        if ar.deadline_missed:
+            outcome = reqmod.REQ_SHED_DEADLINE
+        elif any(ar.by_status.get(status_name(s)) for s in
+                 _TERMINAL_FRAME_STATUSES):
+            outcome = reqmod.REQ_PARTIAL
+        else:
+            outcome = reqmod.REQ_COMPLETED
+        self._finish(ar, outcome)
+
+    # ---- main loop -------------------------------------------------------
+
+    def run(self) -> int:
+        """Serve until SIGTERM/SIGINT (exit 4) or, with ``idle_exit``
+        set, until the queue has been empty that long (exit 0)."""
+        self._replay()
+        watchdog.set_engine_status_provider(self._status)
+        self._start_socket()
+        idle_since = time.monotonic()
+        exit_code = EXIT_OK
+        try:
+            while True:
+                if shutdown.stop_requested() and not self._draining:
+                    self._draining = True
+                    left = len(self._queue)
+                    self._event(
+                        f"stop requested ({shutdown.stop_signal()}); "
+                        f"draining — {left} queued request(s) stay "
+                        "journaled for the next serve"
+                    )
+                if self._draining:
+                    exit_code = EXIT_INTERRUPTED
+                    break
+                self._scan_ingest()
+                with self._lock:
+                    batch = self._queue[: self.max_cycle_requests]
+                    del self._queue[: len(batch)]
+                if batch:
+                    self._cycles += 1
+                    self._solve_cycle(batch)
+                    idle_since = time.monotonic()
+                    continue
+                if (self.idle_exit > 0
+                        and time.monotonic() - idle_since
+                        >= self.idle_exit):
+                    self._event(
+                        f"idle for {self.idle_exit:g}s with an empty "
+                        "queue; exiting"
+                    )
+                    break
+                time.sleep(self.poll_interval)
+        finally:
+            self._stop_socket()
+            watchdog.set_engine_status_provider(None)
+        return exit_code

@@ -1,0 +1,235 @@
+"""Resident session: solver + geometry held in memory across requests.
+
+The one-shot CLI's cold path (validate -> ingest -> compile) runs ONCE,
+at ``sartsolve serve`` startup; every request afterwards only selects
+frames out of the already-indexed image files and solves them through
+the already-compiled lane programs (docs/SERVING.md §2). Single-host
+only — the multihost collective loop's lockstep constraints are exactly
+what a per-request service cannot promise (the same reasoning that
+forces multihost fail-fast in the CLI).
+
+Requests are solved with independent frames (the continuous batcher's
+lanes carry no cross-frame warm state), which is what makes crash
+replay byte-identical: re-running an interrupted request from its
+journaled payload reproduces the exact output bytes of an uninterrupted
+run, whatever order or lane assignment the scheduler picks.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from sartsolver_tpu.config import SolverOptions, parse_time_intervals
+from sartsolver_tpu.engine.request import Request
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.failures import FrameFailure
+
+
+class ResidentSession:
+    """The warm state one serve process holds for its lifetime."""
+
+    def __init__(self, *, solver, grid, opts: SolverOptions,
+                 camera_names: List[str], sorted_image_files,
+                 rtm_frame_masks, npixel: int, nvoxel: int,
+                 max_cached_frames: int = 100):
+        self.solver = solver
+        self.grid = grid
+        self.opts = opts
+        self.camera_names = camera_names
+        self.sorted_image_files = sorted_image_files
+        self.rtm_frame_masks = rtm_frame_masks
+        self.npixel = int(npixel)
+        self.nvoxel = int(nvoxel)
+        self.max_cached_frames = int(max_cached_frames)
+
+    # ---- construction ----------------------------------------------------
+
+    @classmethod
+    def build(cls, args) -> "ResidentSession":
+        """Build the session from a parsed solve-flag namespace — the
+        same pre-flight validation gate and striped ingest the one-shot
+        CLI runs (cli.py), minus the per-run frame loop."""
+        import jax
+
+        from sartsolver_tpu.io import hdf5files as hf
+        from sartsolver_tpu.io.laplacian_io import read_laplacian
+        from sartsolver_tpu.io.voxelgrid import make_voxel_grid
+        from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
+        from sartsolver_tpu.ops.laplacian import make_laplacian
+        from sartsolver_tpu.parallel.mesh import choose_mesh_shape, make_mesh
+        from sartsolver_tpu.parallel.multihost import read_and_shard_rtm
+        from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+        # ---- pre-flight validation gate (identical to the CLI's) --------
+        matrix_files, image_files = hf.categorize_input_files(
+            args.input_files
+        )
+        rtm_name = args.raytransfer_name
+        hf.check_group_attribute_consistency(
+            matrix_files, f"rtm/{rtm_name}", ["wavelength"]
+        )
+        hf.check_group_attribute_consistency(
+            matrix_files, "rtm/voxel_map", ["nx", "ny", "nz"]
+        )
+        sorted_matrix_files = hf.sort_rtm_files(matrix_files)
+        hf.check_rtm_frame_consistency(sorted_matrix_files)
+        hf.check_rtm_voxel_consistency(sorted_matrix_files)
+        hf.check_group_attribute_consistency(
+            image_files, "image", ["wavelength"]
+        )
+        sorted_image_files = hf.sort_image_files(image_files)
+        hf.check_rtm_image_consistency(
+            sorted_matrix_files, sorted_image_files, rtm_name,
+            args.wavelength_threshold,
+        )
+        npixel, nvoxel = hf.get_total_rtm_size(sorted_matrix_files)
+        rtm_frame_masks = hf.read_rtm_frame_masks(sorted_matrix_files)
+
+        kw = dict(
+            logarithmic=args.logarithmic,
+            ray_density_threshold=args.ray_density_threshold,
+            ray_length_threshold=args.ray_length_threshold,
+            conv_tolerance=args.conv_tolerance,
+            beta_laplace=args.beta_laplace,
+            relaxation=args.relaxation,
+            relaxation_decay=args.relaxation_decay,
+            max_iterations=args.max_iterations,
+            divergence_recovery=args.divergence_recovery,
+            integrity=bool(args.integrity),
+            os_subsets=args.os_subsets,
+            momentum=args.momentum,
+            fused_sweep=args.fused_sweep,
+        )
+        if args.use_cpu:
+            opts = SolverOptions.cpu_parity(**kw)
+            jax.config.update("jax_enable_x64", True)
+            devices = jax.devices("cpu")
+        else:
+            opts = SolverOptions(rtm_dtype=args.rtm_dtype, **kw)
+            devices = jax.devices()
+            resolved = resolve_fused_auto(opts, pixel_sharded=False)
+            if resolved is not opts:
+                print("Warning: fused Pallas sweep failed its self-test "
+                      "on this backend; using the two-matmul path.",
+                      file=sys.stderr)
+            opts = resolved
+
+        lap = None
+        if args.laplacian_file:
+            rows, cols, vals = read_laplacian(args.laplacian_file, nvoxel)
+            lap = make_laplacian(rows, cols, vals, dtype=opts.dtype)
+
+        if args.pixel_shards is None and args.voxel_shards is None:
+            n_pix, n_vox = choose_mesh_shape(
+                len(devices), npixel, nvoxel, opts, args.batch_frames
+            )
+        else:
+            n_vox = args.voxel_shards or 1
+            n_pix = args.pixel_shards or max(len(devices) // n_vox, 1)
+        mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
+
+        rtm_scale = None
+        if opts.rtm_dtype == "int8":
+            from sartsolver_tpu.parallel.multihost import (
+                read_and_quantize_rtm,
+            )
+
+            rtm, rtm_scale = read_and_quantize_rtm(
+                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+            )
+        else:
+            rtm = read_and_shard_rtm(
+                sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                dtype=opts.rtm_dtype or opts.dtype,
+            )
+        solver = DistributedSARTSolver(
+            rtm, lap, opts=opts, mesh=mesh, npixel=npixel,
+            nvoxel=nvoxel, rtm_scale=rtm_scale,
+        )
+        grid = make_voxel_grid(
+            next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
+        )
+        print(
+            f"engine: session resident — mesh={n_pix}x{n_vox} "
+            f"backend={jax.default_backend()} "
+            f"rtm_dtype={opts.rtm_dtype or opts.dtype} "
+            f"compute={opts.dtype} npixel={npixel} nvoxel={nvoxel}"
+        )
+        return cls(
+            solver=solver, grid=grid, opts=opts,
+            camera_names=list(sorted_image_files),
+            sorted_image_files=sorted_image_files,
+            rtm_frame_masks=rtm_frame_masks,
+            npixel=npixel, nvoxel=nvoxel,
+            max_cached_frames=args.max_cached_frames,
+        )
+
+    # ---- per-request attachment ------------------------------------------
+
+    def attach(self, request: Request):
+        """Bind a request to the resident geometry: index its composite
+        frames out of the already-opened image files.
+
+        Named fault site ``session.attach``: an armed fault models a
+        torn frame-index read / a request whose selection cannot be
+        served — the request FAILS (and counts toward its tenant's
+        quarantine streak) while the session and every other request
+        keep running. Returns a :class:`CompositeImage` over the
+        request's time range."""
+        faults.fire(faults.SITE_SESSION_ATTACH)
+        from sartsolver_tpu.io.image import CompositeImage
+
+        intervals = parse_time_intervals(request.time_range)
+        return CompositeImage(
+            self.sorted_image_files, self.rtm_frame_masks, intervals,
+            self.npixel, max_cache_size=self.max_cached_frames,
+            pixel_runs=[(0, self.npixel)],
+        )
+
+    def frame_items(
+        self, image, deadline: Optional[float],
+    ) -> Iterator[Tuple]:
+        """The request's scheduler-stream items: ``(frame, time,
+        camera_times, deadline)`` tuples (``deadline`` is the absolute
+        ``time.monotonic()`` budget the lane sweep sheds against, or
+        None). A failed frame read degrades to an ordered
+        :class:`FrameFailure` item — per-frame isolation, like the
+        CLI's prefetcher."""
+        for i in range(len(image)):
+            try:
+                frame = image.frame(i)
+                ftime = image.frame_time(i)
+                cam_times = image.camera_frame_time(i)
+            except Exception as err:  # noqa: BLE001 - isolate frame reads
+                try:
+                    ftime = image.frame_time(i)
+                    cam_times = image.camera_frame_time(i)
+                except Exception:
+                    ftime, cam_times = float("nan"), []
+                yield FrameFailure(None, ftime, cam_times, err)
+                continue
+            yield (np.asarray(frame), ftime, cam_times, deadline)
+
+    def n_frames(self, image) -> int:
+        return len(image)
+
+    def close(self) -> None:
+        close = getattr(self.solver, "close", None)
+        if close is not None:
+            close()
+
+
+def absolute_deadline(request: Request,
+                      accepted_monotonic: float) -> Optional[float]:
+    """A request's absolute ``time.monotonic()`` deadline, anchored at
+    acceptance (queue wait counts against the budget — that is what
+    makes queue saturation shed instead of serving stale work)."""
+    if request.deadline_s is None:
+        return None
+    return accepted_monotonic + float(request.deadline_s)
+
+
+__all__ = ["ResidentSession", "absolute_deadline"]

@@ -144,6 +144,25 @@ def summarize(records: List[dict]) -> dict:
     }
     if variant:
         out["variant"] = variant
+    # serving-engine section (docs/SERVING.md): queue-wait / request
+    # solve-time moments and the deadline-miss rate, derived from the
+    # engine's registry instruments whenever a serve run wrote them
+    qw = out["histograms"].get("engine_queue_wait_s")
+    admitted = out["counters"].get("engine_admitted_total")
+    if qw or admitted is not None:
+        miss = out["counters"].get("engine_deadline_miss_total", 0.0)
+        shed = sum(v for k, v in out["counters"].items()
+                   if k.startswith("engine_shed_total"))
+        solve = out["histograms"].get("engine_request_solve_s")
+        out["engine"] = {
+            "queue_wait_mean_s": qw["mean"] if qw else None,
+            "request_solve_mean_s": solve["mean"] if solve else None,
+            "admitted": admitted or 0.0,
+            "shed": shed,
+            "deadline_miss_rate": (
+                miss / admitted if admitted else None
+            ),
+        }
     if bench:
         out["bench"] = {
             "metric": bench[0]["metric"], "value": bench[0]["value"],
@@ -345,6 +364,24 @@ def diff(old: dict, new: dict) -> dict:
         out["variant_mismatch"] = {"old": va, "new": vb}
         out["solve_ms_mean_pct"] = None
         out["iterations_to_converge_mean_pct"] = None
+    # serving-engine gates (docs/SERVING.md): queue wait is a cost (up
+    # = worse, like solve_ms); the deadline-miss rate is compared in
+    # percentage POINTS (a rate-of-rates would blow up on the healthy
+    # zero-miss baseline)
+    eng_wait_pct = None
+    a = (old.get("engine") or {}).get("queue_wait_mean_s")
+    b = (new.get("engine") or {}).get("queue_wait_mean_s")
+    if a and b and a > 0:
+        eng_wait_pct = 100.0 * (b / a - 1.0)
+        out["engine_queue_wait"] = {"old": a, "new": b}
+    out["engine_queue_wait_pct"] = eng_wait_pct
+    miss_pts = None
+    a = (old.get("engine") or {}).get("deadline_miss_rate")
+    b = (new.get("engine") or {}).get("deadline_miss_rate")
+    if a is not None and b is not None:
+        miss_pts = 100.0 * (b - a)
+        out["engine_deadline_miss"] = {"old": a, "new": b}
+    out["engine_deadline_miss_pts"] = miss_pts
     # roofline utilization (bench detail.roofline, obs/roofline.py):
     # achieved-vs-peak MXU / HBM fractions are rates — a drop past the
     # threshold is a regression, independently of the raw headline
@@ -378,11 +415,21 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
         side = "baseline" if vb is not None else "new"
         notes.append(f"solver-variant meta missing from the {side} "
                      "artifact — variant comparability unknown")
-    for section in ("bench", "straggler", "integrity", "roofline", "tts"):
+    for section in ("bench", "straggler", "integrity", "roofline", "tts",
+                    "engine"):
         if (section in old) != (section in new):
             side = "baseline" if section in new else "new"
             notes.append(f"{section} section missing from the {side} "
                          "artifact — its rate gate skipped")
+    if "engine" in old and "engine" in new:
+        if not ((old["engine"].get("queue_wait_mean_s") or 0) > 0):
+            notes.append("baseline engine queue-wait mean is zero/absent "
+                         "— its gate skipped")
+        for side, summ in (("baseline", old), ("new", new)):
+            if summ["engine"].get("deadline_miss_rate") is None:
+                notes.append(f"{side} engine admitted zero requests — "
+                             "the deadline-miss gate skipped")
+                break
     zero_checks = [
         ("bench", "value", "bench headline value"),
         ("straggler", "occ_frame_iter_s", "straggler occ frame-iter/s"),
@@ -506,6 +553,16 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                     print(f"  roofline {key}: {d['old']:g} -> "
                           f"{d['new']:g} "
                           f"({delta[f'roofline_{key}_pct']:+.1f}%)")
+            if delta["engine_queue_wait_pct"] is not None:
+                d = delta["engine_queue_wait"]
+                print(f"  engine queue-wait mean s: {d['old']:g} -> "
+                      f"{d['new']:g} "
+                      f"({delta['engine_queue_wait_pct']:+.1f}%)")
+            if delta["engine_deadline_miss_pts"] is not None:
+                d = delta["engine_deadline_miss"]
+                print(f"  engine deadline-miss rate: {d['old']:g} -> "
+                      f"{d['new']:g} "
+                      f"({delta['engine_deadline_miss_pts']:+.1f} pts)")
         # a gate that did not run must say so — an artifact missing its
         # bench section, a zero baseline — never silently pass
         for note in delta.get("notes", ()):
@@ -572,6 +629,22 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                           f"the {args.threshold:g}% threshold.",
                           file=sys.stderr)
                     return 2
+            if (delta["engine_queue_wait_pct"] is not None
+                    and delta["engine_queue_wait_pct"] > args.threshold):
+                print(f"sartsolve metrics: engine queue-wait regression "
+                      f"{delta['engine_queue_wait_pct']:+.1f}% exceeds "
+                      f"the {args.threshold:g}% threshold.",
+                      file=sys.stderr)
+                return 2
+            if (delta["engine_deadline_miss_pts"] is not None
+                    and delta["engine_deadline_miss_pts"]
+                    > args.threshold):
+                print(f"sartsolve metrics: engine deadline-miss rate "
+                      f"rose {delta['engine_deadline_miss_pts']:+.1f} "
+                      f"percentage points, exceeding the "
+                      f"{args.threshold:g}-point threshold.",
+                      file=sys.stderr)
+                return 2
         return 0
 
     summary = summarize(loaded[0])
@@ -656,6 +729,31 @@ def _render_status(path: str, rec: dict) -> List[str]:
             f"{sched.get('strides')}  in-flight lanes "
             + (",".join(str(s) for s in lanes) if lanes else "-")
         )
+    engine = rec.get("engine")
+    if engine:
+        active = engine.get("active_requests") or []
+        lines.append(
+            f"  engine: queue {engine.get('queue_depth')}  admitted "
+            f"{engine.get('admitted')}  shed {engine.get('shed')}  "
+            f"lanes {engine.get('lanes')}"
+            + (f"  degraded ({engine['degraded']})"
+               if engine.get("degraded") else "")
+            + ("  draining" if engine.get("draining") else "")
+        )
+        lines.append(
+            "  engine requests in flight: "
+            + (",".join(str(r) for r in active) if active else "-")
+        )
+        quarantined = engine.get("quarantined_tenants") or []
+        tenants = engine.get("tenants") or {}
+        if tenants:
+            lines.append("  engine tenants: " + "  ".join(
+                f"{name}(queued {st.get('queued', 0)}"
+                + (f", quarantined {st.get('quarantined_s')}s"
+                   if name in quarantined else "")
+                + ")"
+                for name, st in tenants.items()
+            ))
     for m in rec.get("metrics") or []:
         key = _metric_key(m)
         if m.get("kind") == "histogram":

@@ -169,6 +169,7 @@ def status_snapshot(blocking: bool = True, **extra) -> dict:
         },
         "beacon_ages": watchdog.beacon_ages(),
         "sched": watchdog.sched_status(),
+        "engine": watchdog.engine_status(),
         "metrics": metrics.get_registry().snapshot(blocking=blocking),
     }
     rec.update(extra)

@@ -23,6 +23,14 @@ Per-frame statuses (``solution/status``; extends config.py's codes):
   CLI's escalation normally recomputes the frame once and converts a
   repeat into FRAME_FAILED, so -4 reaches the file only from library
   callers that skip the escalation.
+- ``-5`` DEADLINE_EXCEEDED — the serving engine (docs/SERVING.md) shed
+  the frame at a scheduler stride boundary because its request's
+  deadline passed mid-solve; the row holds the last iterate reached.
+  Deliberately distinct from DIVERGED/FRAME_FAILED: a deadline miss is
+  a *policy* outcome (the pool was busy), not a numerical or
+  infrastructure fault, and must not count toward tenant quarantine or
+  the partial exit code. Never produced by the one-shot CLI (its frames
+  carry no deadline).
 
 Process exit codes (the CLI contract):
 
@@ -56,6 +64,9 @@ from sartsolver_tpu.resilience.faults import InjectedFault, InjectedIOError
 from sartsolver_tpu.resilience.retry import RetriesExhausted, retry_stats
 
 FRAME_FAILED = -3
+# Serving-engine deadline shed (docs/SERVING.md): the scheduler retired
+# the lane at a stride boundary because the request's deadline passed.
+DEADLINE_EXCEEDED = -5
 
 EXIT_OK = 0
 EXIT_INPUT_ERROR = 1
@@ -127,6 +138,7 @@ def status_name(status: int) -> str:
         DIVERGED: "diverged",
         FRAME_FAILED: "failed",
         SDC_DETECTED: "sdc",
+        DEADLINE_EXCEEDED: "deadline",
     }.get(int(status), f"unknown({int(status)})")
 
 

@@ -81,10 +81,17 @@ SITE_SOLVE = "solve.dispatch"        # parallel/sharded.py: solve entry
 SITE_FLUSH = "io.flush"              # io/solution.py: output flush
 SITE_MULTIHOST_INIT = "multihost.init"  # parallel/multihost.py: runtime init
 SITE_DEVICE_BUFFER = "device.buffer"    # parallel/sharded.py: resident RTM rot
+# Serving-engine seams (docs/SERVING.md): request-file/socket payload
+# parsing, the request-journal append (the engine's durability backbone),
+# and attaching a request's frame stream to the resident session.
+SITE_REQUEST_PARSE = "request.parse"    # engine/request.py: payload parse
+SITE_JOURNAL_APPEND = "journal.append"  # engine/journal.py: record append
+SITE_SESSION_ATTACH = "session.attach"  # engine/session.py: frame-stream attach
 
 FAULT_SITES = frozenset({
     SITE_FRAME_READ, SITE_RTM_INGEST, SITE_PREFETCH, SITE_DEVICE_PUT,
     SITE_SOLVE, SITE_FLUSH, SITE_MULTIHOST_INIT, SITE_DEVICE_BUFFER,
+    SITE_REQUEST_PARSE, SITE_JOURNAL_APPEND, SITE_SESSION_ATTACH,
 })
 
 FAULT_KINDS = ("io", "error", "nan", "hang", "oom", "corrupt")

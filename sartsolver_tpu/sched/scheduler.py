@@ -40,6 +40,12 @@ Contracts kept from the dense grouped loop:
   stop request ends backfilling and the in-flight lanes drain to
   completion, exactly like the dense loop draining its dispatched
   group.
+- **Deadline shed** (serving engine, docs/SERVING.md) — a stream item
+  may carry a 4th element: an absolute ``time.monotonic()`` deadline.
+  At each stride boundary, occupied lanes past their deadline are
+  force-retired with status ``DEADLINE_EXCEEDED`` (-5) and the last
+  iterate reached, freeing the lane for backfill while co-batched
+  lanes run on. CLI frames carry no deadline; the sweep is inert there.
 
 Observability (docs/OBSERVABILITY.md): ``sched_lane_occupancy`` gauge
 (useful lane-iterations / lane capacity over the run — THE number
@@ -67,6 +73,7 @@ from sartsolver_tpu.resilience.degrade import (
     is_resource_exhausted,
 )
 from sartsolver_tpu.resilience.failures import (
+    DEADLINE_EXCEEDED,
     RECOVERABLE_FRAME_ERRORS,
     FrameFailure,
 )
@@ -83,6 +90,7 @@ class SchedRunStats:
     strides: int = 0  # device dispatches
     loop_steps: int = 0  # solver iterations the device executed
     useful_iters: int = 0  # per-frame iterations summed over retirees
+    deadline_shed: int = 0  # lanes force-retired past their deadline
     interrupted: bool = False  # a stop request truncated the queue
     # un-emitted frames (in frame order, FrameFailure items included)
     # after a device OOM: the caller re-solves them on the classic
@@ -104,14 +112,18 @@ class _Slot:
     """One occupied lane's host-side bookkeeping."""
 
     __slots__ = ("seq", "frame", "ftime", "cam_times", "it_prev",
-                 "sdc_retries")
+                 "sdc_retries", "deadline")
 
-    def __init__(self, seq, frame, ftime, cam_times):
+    def __init__(self, seq, frame, ftime, cam_times, deadline=None):
         self.seq = seq
         self.frame = frame  # kept for OOM requeue (one [npixel] fp64 row)
         self.ftime = ftime
         self.cam_times = cam_times
         self.it_prev = 0
+        # absolute time.monotonic() deadline (serving engine,
+        # docs/SERVING.md), or None — the one-shot CLI's frames carry
+        # none and the deadline sweep never touches them
+        self.deadline = deadline
         # SDC escalation (docs/RESILIENCE.md §8): how many times this
         # frame was re-queued after an ABFT trip — recompute-once, then
         # the lane fails through the ordered FAILED-row path
@@ -184,6 +196,7 @@ class ContinuousBatcher:
         self._retired_ctr = registry.counter("sched_lanes_retired_total")
         self._backfill_ctr = registry.counter("sched_lanes_backfilled_total")
         self._stride_ctr = registry.counter("sched_strides_total")
+        self._deadline_ctr = registry.counter("sched_deadline_shed_total")
 
     # ---- ordered emission ------------------------------------------------
 
@@ -307,10 +320,14 @@ class ContinuousBatcher:
                     )
                     seq += 1
                     continue
-                frame, ftime, cam_times = item
+                # items are (frame, time, camera_times) from the CLI's
+                # prefetcher, or the serving engine's 4th-element form
+                # carrying an absolute monotonic deadline
+                frame, ftime, cam_times = item[0], item[1], item[2]
+                deadline = item[3] if len(item) > 3 else None
                 lane = free.popleft()
                 occupied[lane] = _Slot(seq, np.asarray(frame), ftime,
-                                       cam_times)
+                                       cam_times, deadline=deadline)
                 refills.append((lane, occupied[lane].frame))
                 seq += 1
             return refills
@@ -448,6 +465,32 @@ class ContinuousBatcher:
                 free.append(lane)
             if retired_now:
                 t_last = now
+            # Deadline sweep (serving engine, docs/SERVING.md): lanes
+            # whose slot carries an absolute deadline that has passed are
+            # force-retired HERE, at the stride boundary — the one place
+            # the host holds control between device dispatches — with the
+            # distinct DEADLINE_EXCEEDED status and the last iterate
+            # reached. Co-batched lanes are untouched: the fixed-shape
+            # program keeps running them; the shed lane is simply freed
+            # for backfill. CLI frames carry no deadline, so this loop
+            # never fires there (byte-identical behavior).
+            now_mono = time.monotonic()
+            overdue = [
+                lane for lane, slot in occupied.items()
+                if slot.deadline is not None and now_mono > slot.deadline
+            ]
+            for lane in sorted(overdue, key=lambda b: occupied[b].seq):
+                slot = occupied.pop(lane)
+                fetcher = lane_state.lane_solution_fetcher(lane)
+                stats.deadline_shed += 1
+                self._deadline_ctr.inc()
+                self._emit_buf[slot.seq] = (
+                    "result",
+                    (slot.ftime, slot.cam_times, DEADLINE_EXCEEDED,
+                     int(itv[lane]), float(conv[lane]), fetcher, 0.0),
+                    slot.frame,
+                )
+                free.append(lane)
             self._emit_ready()
         self._finalize()
         return stats
@@ -470,13 +513,20 @@ class ContinuousBatcher:
                 ftime, cam_times = payload[0], payload[1]
                 entries.append((seq_i, (frame, ftime, cam_times)))
         for lane, slot in occupied.items():
-            entries.append((slot.seq, (slot.frame, slot.ftime,
-                                       slot.cam_times)))
+            entries.append((slot.seq, self._requeue_item(slot)))
         for slot in getattr(self, "_sdc_retry", ()):  # awaiting recompute
-            entries.append((slot.seq, (slot.frame, slot.ftime,
-                                       slot.cam_times)))
+            entries.append((slot.seq, self._requeue_item(slot)))
         self._emit_buf.clear()
         return [item for _, item in sorted(entries, key=lambda e: e[0])]
+
+    @staticmethod
+    def _requeue_item(slot):
+        """An in-flight slot back in stream-item form; the engine's
+        deadline (4th element) survives the requeue so the fallback run
+        can still shed it."""
+        if slot.deadline is not None:
+            return (slot.frame, slot.ftime, slot.cam_times, slot.deadline)
+        return (slot.frame, slot.ftime, slot.cam_times)
 
     def _finalize(self) -> None:
         self._occ_gauge.set(round(self._stats.occupancy, 6))

@@ -1,0 +1,60 @@
+"""Generate a real serving-engine --metrics_out artifact.
+
+Used by ``make bench-smoke``'s engine gate: runs a real ``sartsolve
+serve`` pass (in-process, the same serve_main the CLI dispatches) over
+the synthetic world with a few requests pre-staged in the ingest dir
+and the JSONL sink enabled, then exits on idle. The artifact carries
+the engine's queue-wait histogram and admitted/deadline-miss counters,
+so ``sartsolve metrics --diff --threshold`` can gate queue-wait and
+deadline-miss rates run-over-run (docs/SERVING.md §6). Exits with the
+serve exit code (0 expected).
+
+Usage: gen_engine_artifact.py WORLD_DIR ARTIFACT.jsonl
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)  # fixtures.py
+sys.path.insert(0, os.path.dirname(_here))  # the repo checkout itself
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import fixtures as fx  # noqa: E402
+from sartsolver_tpu.engine.cli import serve_main  # noqa: E402
+
+
+def run(world_dir: str, artifact: str) -> int:
+    paths, *_ = fx.write_world(world_dir, n_frames=6)
+    eng = os.path.join(world_dir, "engine")
+    ingest = os.path.join(eng, "ingest")
+    os.makedirs(ingest, exist_ok=True)
+    # three tenants' worth of queued work; generous deadlines that a
+    # healthy smoke run never misses (a zero miss rate is the stable
+    # baseline the gate watches for movement)
+    requests = [
+        {"id": "smoke-a", "tenant": "a", "deadline_s": 300},
+        {"id": "smoke-b", "tenant": "b", "time_range": "0.05:0.35"},
+        {"id": "smoke-c", "tenant": "c", "deadline_s": 300},
+    ]
+    for i, payload in enumerate(requests):
+        with open(os.path.join(ingest, f"{i}-{payload['id']}.json"),
+                  "w") as f:
+            json.dump(payload, f)
+    return serve_main([
+        "--engine_dir", eng, "--use_cpu", "-m", "60", "-c", "1e-8",
+        "--lanes", "2", "--idle_exit", "0.5", "--poll_interval", "0.05",
+        "--metrics_out", artifact,
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+    ])
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1], sys.argv[2]))

@@ -1,0 +1,787 @@
+"""Serving-engine matrix (docs/SERVING.md; `make serve`).
+
+In-process legs share one resident session (module fixture): request
+parsing + fault site, journal append/replay (torn tails, retry),
+admission policy (bounded queue, quotas, quarantine, degraded,
+draining), the deadline shed drill (over-deadline request retires at a
+stride boundary with the distinct status while the co-batched request
+completes), OOM lane degradation, replay determinism, the engine
+status/heartbeat/top surfaces, and the `sartsolve metrics` engine
+gates.
+
+Real-process legs drive the actual ``sartsolve serve`` binary:
+submit/duplicate/SIGTERM-drain lifecycle, the crash-replay matrix
+(SIGKILL inside each journal marker window, restart, byte-identical
+outputs, no request lost or double-solved), and the fault-injection
+sites drilled end-to-end through admission/retry/quarantine.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.engine import admission as adm_mod
+from sartsolver_tpu.engine import journal as journal_mod
+from sartsolver_tpu.engine import request as req_mod
+from sartsolver_tpu.engine.request import Request, RequestError, parse_request
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.failures import (
+    DEADLINE_EXCEEDED,
+    status_name,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+
+# ---------------------------------------------------------------------------
+# request parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_request_roundtrip():
+    req = parse_request(json.dumps({
+        "id": "r1", "tenant": "diag-a", "time_range": "0.1:0.3",
+        "deadline_s": 2.5,
+    }))
+    assert req.id == "r1" and req.tenant == "diag-a"
+    assert req.deadline_s == 2.5 and req.time_range == "0.1:0.3"
+    assert req.submitted_unix > 0
+    # to_dict round-trips through the journal's accepted record
+    again = parse_request(req.to_dict())
+    assert again.id == req.id and again.deadline_s == req.deadline_s
+
+
+@pytest.mark.parametrize("payload", [
+    "not json",
+    json.dumps(["list"]),
+    json.dumps({"tenant": "t"}),                      # missing id
+    json.dumps({"id": "bad id!"}),                    # bad id charset
+    json.dumps({"id": "r", "unknown_field": 1}),      # unknown field
+    json.dumps({"id": "r", "deadline_s": -1}),        # bad deadline
+    json.dumps({"id": "r", "time_range": "5:1"}),     # bad range
+    json.dumps({"id": "r", "tenant": 7}),             # bad tenant type
+])
+def test_parse_request_rejects(payload):
+    with pytest.raises(RequestError):
+        parse_request(payload)
+
+
+def test_parse_request_default_deadline():
+    req = parse_request(json.dumps({"id": "r"}), default_deadline_s=9.0)
+    assert req.deadline_s == 9.0
+    req = parse_request(json.dumps({"id": "r", "deadline_s": 1.5}),
+                        default_deadline_s=9.0)
+    assert req.deadline_s == 1.5
+
+
+def test_parse_request_fault_site():
+    """The request.parse site models a torn payload read: armed io
+    faults surface as OSError (the server's malformed-rejection leg)."""
+    with faults.injected(faults.SITE_REQUEST_PARSE, "io", 1.0, count=1):
+        with pytest.raises(OSError):
+            parse_request(json.dumps({"id": "ok"}))
+        parse_request(json.dumps({"id": "ok"}))  # count exhausted
+
+
+# ---------------------------------------------------------------------------
+# journal
+# ---------------------------------------------------------------------------
+
+def test_journal_roundtrip_and_replay(tmp_path):
+    j = journal_mod.RequestJournal(str(tmp_path / "j.jsonl"))
+    r1 = parse_request({"id": "r1", "tenant": "a", "deadline_s": 5})
+    r2 = parse_request({"id": "r2", "tenant": "b"})
+    r3 = parse_request({"id": "r3", "tenant": "b"})
+    j.accepted(r1)
+    j.dispatched(r1)
+    j.completed(r1, {"status": "completed"})
+    j.accepted(r2)
+    j.dispatched(r2)  # dispatched but never completed -> replays
+    j.accepted(r3)    # accepted only -> replays
+    completed, pending = j.replay()
+    assert set(completed) == {"r1"}
+    assert [r.id for r in pending] == ["r2", "r3"]
+    assert pending[0].tenant == "b"
+    # r1's payload details survived the journal round trip
+    with open(j.path) as f:
+        first = json.loads(f.readline())
+    assert first["request"]["deadline_s"] == 5
+
+
+def test_journal_ignores_torn_tail(tmp_path):
+    j = journal_mod.RequestJournal(str(tmp_path / "j.jsonl"))
+    j.accepted(parse_request({"id": "r1"}))
+    with open(j.path, "a") as f:
+        f.write('{"marker": "completed", "id": "r1", "out')  # torn append
+    completed, pending = j.replay()
+    assert not completed and [r.id for r in pending] == ["r1"]
+
+
+def test_journal_append_fault_retries(tmp_path, monkeypatch):
+    """Transient journal I/O faults retry in place; the marker still
+    lands (the engine never proceeds unjournaled)."""
+    monkeypatch.setenv("SART_RETRY_BASE_DELAY", "0.01")
+    j = journal_mod.RequestJournal(str(tmp_path / "j.jsonl"))
+    with faults.injected(faults.SITE_JOURNAL_APPEND, "io", 1.0, count=2):
+        j.accepted(parse_request({"id": "r1"}))
+    completed, pending = j.replay()
+    assert [r.id for r in pending] == ["r1"]
+
+
+def test_journal_crash_window_announces(tmp_path, monkeypatch, capfd):
+    monkeypatch.setenv("SART_TEST_JOURNAL_DELAY", "0.01")
+    j = journal_mod.RequestJournal(str(tmp_path / "j.jsonl"))
+    r = parse_request({"id": "r1"})
+    j.accepted(r)
+    j.dispatched(r)
+    j.completed(r, {})
+    err = capfd.readouterr().err
+    assert "SART_JOURNAL_POINT accepted" in err
+    assert "SART_JOURNAL_POINT dispatched" in err
+    assert "SART_JOURNAL_POINT pre-flush" in err
+
+
+# ---------------------------------------------------------------------------
+# admission policy
+# ---------------------------------------------------------------------------
+
+def _req(rid, tenant="t"):
+    return parse_request({"id": rid, "tenant": tenant})
+
+
+def test_admission_queue_and_quota():
+    obs_metrics.reset_registry()
+    adm = adm_mod.AdmissionController(max_queue=2, max_per_tenant=1)
+    assert adm.admit(_req("a1", "a")) is None
+    # tenant quota before global capacity
+    assert adm.admit(_req("a2", "a")) == req_mod.REASON_TENANT_QUOTA
+    assert adm.admit(_req("b1", "b")) is None
+    assert adm.admit(_req("c1", "c")) == req_mod.REASON_QUEUE_FULL
+    # duplicates rejected even after completion
+    adm.note_dispatched(_req("a1", "a"))
+    adm.note_outcome(_req("a1", "a"), req_mod.REQ_COMPLETED)
+    assert adm.admit(_req("a1", "a")) == req_mod.REASON_DUPLICATE
+    # draining outranks everything
+    assert adm.admit(_req("z", "z"), draining=True) \
+        == req_mod.REASON_DRAINING
+
+
+def test_admission_quarantine_and_cooldown():
+    obs_metrics.reset_registry()
+    clock = {"t": 0.0}
+    adm = adm_mod.AdmissionController(
+        max_queue=8, quarantine_after=2, quarantine_cooldown=10.0,
+        clock=lambda: clock["t"],
+    )
+    for i, outcome in enumerate(
+            (req_mod.REQ_FAILED, req_mod.REQ_PARTIAL)):
+        r = _req(f"bad{i}", "noisy")
+        assert adm.admit(r) is None
+        adm.note_dispatched(r)
+        adm.note_outcome(r, outcome)
+    # two consecutive failures -> quarantined; other tenants unaffected
+    assert adm.admit(_req("bad2", "noisy")) \
+        == req_mod.REASON_TENANT_QUARANTINED
+    assert adm.admit(_req("ok1", "calm")) is None
+    assert adm.quarantined_tenants() == ["noisy"]
+    # cooldown expiry readmits
+    clock["t"] = 11.0
+    assert adm.admit(_req("bad3", "noisy")) is None
+    # a completed request resets the failure streak
+    adm.note_dispatched(_req("bad3", "noisy"))
+    adm.note_outcome(_req("bad3", "noisy"), req_mod.REQ_COMPLETED)
+    r = _req("bad4", "noisy")
+    assert adm.admit(r) is None
+    adm.note_dispatched(r)
+    adm.note_outcome(r, req_mod.REQ_FAILED)
+    assert adm.admit(_req("bad5", "noisy")) is None  # streak is 1, not 3
+
+
+def test_admission_deadline_shed_not_quarantined():
+    obs_metrics.reset_registry()
+    adm = adm_mod.AdmissionController(max_queue=8, quarantine_after=1)
+    r = _req("d1", "t")
+    assert adm.admit(r) is None
+    adm.note_dispatched(r)
+    adm.note_outcome(r, req_mod.REQ_SHED_DEADLINE)
+    # a deadline miss is pool congestion, not the tenant's fault
+    assert adm.admit(_req("d2", "t")) is None
+
+
+def test_admission_degraded_mode():
+    obs_metrics.reset_registry()
+    adm = adm_mod.AdmissionController(max_queue=4)
+    adm.set_degraded("device OOM; lanes halved to 1")
+    assert adm.admit(_req("a")) is None  # below the degraded watermark
+    assert adm.admit(_req("b")) is None
+    assert adm.admit(_req("c")) == req_mod.REASON_DEGRADED
+    adm.set_degraded(None)
+    assert adm.admit(_req("c2")) is None
+
+
+def test_status_taxonomy():
+    assert DEADLINE_EXCEEDED == -5
+    assert status_name(DEADLINE_EXCEEDED) == "deadline"
+
+
+# ---------------------------------------------------------------------------
+# in-process engine drills (shared resident session)
+# ---------------------------------------------------------------------------
+
+SOLVE_FLAGS = ["--use_cpu", "-m", "40", "-c", "1e-12"]
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    td = tmp_path_factory.mktemp("engine_world")
+    paths, H, f_true, times, scales = fx.write_world(str(td), n_frames=4)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def session(world):
+    from sartsolver_tpu.cli import _validate
+    from sartsolver_tpu.engine.cli import build_serve_parser
+    from sartsolver_tpu.engine.session import ResidentSession
+
+    args = build_serve_parser().parse_args([
+        "--engine_dir", "/nonexistent-unused", *SOLVE_FLAGS,
+        world["rtm_a1"], world["rtm_a2"], world["rtm_b"],
+        world["img_a"], world["img_b"],
+    ])
+    _validate(args)
+    return ResidentSession.build(args)
+
+
+def _run_server(session, eng_dir, requests, *, lanes=2, idle_exit=0.4,
+                **kw):
+    from sartsolver_tpu.engine.server import EngineServer
+
+    os.makedirs(os.path.join(eng_dir, "ingest"), exist_ok=True)
+    for i, payload in enumerate(requests):
+        with open(os.path.join(eng_dir, "ingest",
+                               f"{i:03d}-{payload['id']}.json"),
+                  "w") as f:
+            json.dump(payload, f)
+    admission = kw.pop("admission", None)
+    if admission is None:
+        admission = adm_mod.AdmissionController(
+            max_queue=kw.pop("max_queue", 16),
+            max_per_tenant=kw.pop("max_per_tenant", 0),
+            quarantine_after=kw.pop("quarantine_after", 3),
+            quarantine_cooldown=kw.pop("quarantine_cooldown", 60.0),
+        )
+    server = EngineServer(
+        session, engine_dir=eng_dir, lanes=lanes, admission=admission,
+        poll_interval=0.05, idle_exit=idle_exit, **kw,
+    )
+    rc = server.run()
+    return server, rc
+
+
+def _response(eng_dir, rid):
+    with open(os.path.join(eng_dir, "responses", f"{rid}.json")) as f:
+        return json.load(f)
+
+
+def _solution(path):
+    with h5py.File(path, "r") as f:
+        return {k: f[f"solution/{k}"][:] for k in f["solution"]}
+
+
+def test_engine_serves_requests_and_matches_cli(session, world, tmp_path):
+    """Two requests solved against the resident session; the full-range
+    request's output is byte-identical to the one-shot CLI's scheduler
+    path over the same frames (lane parity), and a re-run in a fresh
+    engine dir reproduces the bytes (replay determinism)."""
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    server, rc = _run_server(session, eng, [
+        {"id": "all", "tenant": "a"},
+        {"id": "head", "tenant": "b", "time_range": "0.05:0.25"},
+    ])
+    assert rc == 0
+    out = _response(eng, "all")["outcome"]
+    assert out["status"] == "completed" and out["frames"] == 4
+    assert _response(eng, "head")["outcome"]["frames"] == 2
+    # journal is a complete accepted->dispatched->completed story
+    completed, pending = journal_mod.RequestJournal(
+        os.path.join(eng, "journal.jsonl")).replay()
+    assert set(completed) == {"all", "head"} and not pending
+
+    # parity with the one-shot CLI's continuous-batching path
+    from sartsolver_tpu.cli import main as cli_main
+
+    cli_out = str(tmp_path / "cli.h5")
+    assert cli_main([
+        "-o", cli_out, *SOLVE_FLAGS, "--no_guess", "--batch_frames", "2",
+        world["rtm_a1"], world["rtm_a2"], world["rtm_b"],
+        world["img_a"], world["img_b"],
+    ]) == 0
+    a = _solution(os.path.join(eng, "outputs", "all.h5"))
+    b = _solution(cli_out)
+    for key in sorted(b):
+        np.testing.assert_array_equal(a[key], b[key], err_msg=key)
+
+    # replay determinism: a second engine run writes identical bytes
+    eng2 = str(tmp_path / "eng2")
+    _run_server(session, eng2, [{"id": "all", "tenant": "a"}])
+    c = _solution(os.path.join(eng2, "outputs", "all.h5"))
+    for key in sorted(a):
+        np.testing.assert_array_equal(a[key], c[key], err_msg=key)
+
+
+def test_engine_deadline_shed_while_cobatched_completes(world, tmp_path):
+    """The deadline drill (ISSUE acceptance): an over-deadline request
+    retires at a stride boundary with the distinct status while the
+    co-batched request completes normally."""
+    from sartsolver_tpu.cli import _validate
+    from sartsolver_tpu.engine.cli import build_serve_parser
+    from sartsolver_tpu.engine.session import ResidentSession
+
+    obs_metrics.reset_registry()
+    # a convergence-proof problem (tolerance below reach, huge cap) so
+    # the deadline reliably expires mid-solve
+    args = build_serve_parser().parse_args([
+        "--engine_dir", "/unused", "--use_cpu", "-m", "20000",
+        "-c", "1e-300", "--schedule_stride", "8",
+        world["rtm_a1"], world["rtm_a2"], world["rtm_b"],
+        world["img_a"], world["img_b"],
+    ])
+    _validate(args)
+    slow_session = ResidentSession.build(args)
+    eng = str(tmp_path / "eng")
+    server, rc = _run_server(slow_session, eng, [
+        {"id": "hurried", "tenant": "a", "deadline_s": 0.8},
+        {"id": "patient", "tenant": "b"},
+    ], lanes=2)
+    assert rc == 0
+    hurried = _response(eng, "hurried")["outcome"]
+    patient = _response(eng, "patient")["outcome"]
+    assert hurried["status"] == "shed-deadline"
+    assert set(hurried["by_status"]) == {"deadline"}
+    assert patient["status"] == "completed"
+    sol = _solution(os.path.join(eng, "outputs", "hurried.h5"))
+    assert (sol["status"] == DEADLINE_EXCEEDED).all()
+    reg = obs_metrics.get_registry()
+    assert reg.counter("engine_deadline_miss_total").value >= 1
+    assert reg.counter("sched_deadline_shed_total").value >= 1
+
+
+def test_engine_queue_full_rejects_machine_readable(session, tmp_path):
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    server, rc = _run_server(session, eng, [
+        {"id": "q1", "tenant": "a"},
+        {"id": "q2", "tenant": "a"},
+        {"id": "q3", "tenant": "a"},
+    ], max_queue=1, max_cycle_requests=1)
+    assert rc == 0
+    verdicts = {rid: _response(eng, rid) for rid in ("q1", "q2", "q3")}
+    assert verdicts["q1"]["verdict"] == "accepted"
+    shed = [r for r in verdicts.values()
+            if r.get("reason") == req_mod.REASON_QUEUE_FULL]
+    assert len(shed) == 2  # the scan found them beyond the bounded queue
+    reg = obs_metrics.get_registry()
+    assert reg.counter("engine_shed_total",
+                       reason=req_mod.REASON_QUEUE_FULL).value == 2
+
+
+def test_engine_attach_fault_quarantines_tenant(session, tmp_path):
+    """session.attach faults fail the request (FAILED outcome, no
+    engine abort) and consecutive failures quarantine only that
+    tenant. Requests arrive sequentially (quarantine is judged on
+    outcomes, so the failing ones must complete before the next
+    admission) — the admission controller persists across the serve
+    passes, like one resident engine fed over time."""
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    adm = adm_mod.AdmissionController(max_queue=16, quarantine_after=2)
+    with faults.injected(faults.SITE_SESSION_ATTACH, "error", 1.0,
+                         count=2):
+        _run_server(session, eng, [{"id": "n1", "tenant": "noisy"}],
+                    admission=adm)
+        _run_server(session, eng, [{"id": "n2", "tenant": "noisy"}],
+                    admission=adm)
+    _run_server(session, eng, [{"id": "n3", "tenant": "noisy"},
+                               {"id": "c1", "tenant": "calm"}],
+                admission=adm)
+    assert _response(eng, "n1")["outcome"]["status"] == "failed"
+    assert _response(eng, "n2")["outcome"]["status"] == "failed"
+    assert _response(eng, "n3")["reason"] \
+        == req_mod.REASON_TENANT_QUARANTINED
+    assert _response(eng, "c1")["outcome"]["status"] == "completed"
+
+
+def test_engine_oom_halves_lanes_and_degrades(session, tmp_path):
+    """A device OOM mid-cycle: the lane count halves (sticky), the
+    leftover frames still solve, and admission flips degraded."""
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    with faults.injected(faults.SITE_SOLVE, "oom", 1.0, count=1):
+        server, rc = _run_server(session, eng, [
+            {"id": "o1", "tenant": "a"},
+        ], lanes=2)
+    assert rc == 0
+    assert server.lanes == 1
+    assert server.admission.degraded_reason is not None
+    out = _response(eng, "o1")["outcome"]
+    assert out["status"] == "completed" and out["frames"] == 4
+
+
+def test_engine_status_heartbeat_and_top(session, tmp_path, monkeypatch):
+    """The engine view reaches all three surfaces: the status snapshot
+    (SIGUSR1 / crash bundle), the heartbeat line, `sartsolve top`."""
+    from sartsolver_tpu.engine.server import EngineServer
+    from sartsolver_tpu.obs import flight as obs_flight
+    from sartsolver_tpu.obs.cli import render_top
+    from sartsolver_tpu.resilience import watchdog
+
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    server = EngineServer(
+        session, engine_dir=eng, lanes=2,
+        admission=adm_mod.AdmissionController(max_queue=4),
+    )
+    server.admission.admit(_req("s1", "a"))
+    server._active_ids.append("s0")
+    watchdog.set_engine_status_provider(server._status)
+    try:
+        rec = obs_flight.status_snapshot()
+        assert rec["engine"]["queue_depth"] == 1
+        assert rec["engine"]["admitted"] == 1
+        assert rec["engine"]["active_requests"] == ["s0"]
+        status_path = str(tmp_path / "status.json")
+        obs_flight.write_status(status_path)
+        screen = render_top(status_path)
+        assert "engine: queue 1" in screen
+        assert "s0" in screen
+        hb = str(tmp_path / "hb")
+        monkeypatch.setenv("SART_HEARTBEAT_FILE", hb)
+        watchdog.beacon(watchdog.PHASE_FRAME_DONE)
+        line = open(hb).read()
+        assert "queue=1" in line and "admitted=1" in line \
+            and "requests=s0" in line
+    finally:
+        watchdog.set_engine_status_provider(None)
+    assert watchdog.engine_status() is None
+
+
+# ---------------------------------------------------------------------------
+# `sartsolve metrics` engine gates
+# ---------------------------------------------------------------------------
+
+def _engine_artifact(path, queue_wait_mean, miss, admitted):
+    from sartsolver_tpu.obs import schema
+
+    records = [
+        schema.make_meta_record(created_unix=1.0),
+        {"type": "metric", "kind": "histogram",
+         "name": "engine_queue_wait_s", "labels": {},
+         "count": 4, "sum": 4 * queue_wait_mean,
+         "min": queue_wait_mean, "max": queue_wait_mean},
+        {"type": "metric", "kind": "counter",
+         "name": "engine_admitted_total", "labels": {},
+         "value": admitted},
+        {"type": "metric", "kind": "counter",
+         "name": "engine_deadline_miss_total", "labels": {},
+         "value": miss},
+        schema.make_summary_record(0, {}, wall_s=1.0),
+    ]
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_metrics_engine_summary_and_gates(tmp_path, capsys):
+    from sartsolver_tpu.obs.cli import metrics_main, summarize, _load
+
+    old = str(tmp_path / "old.jsonl")
+    new = str(tmp_path / "new.jsonl")
+    _engine_artifact(old, queue_wait_mean=0.1, miss=0, admitted=10)
+    summary = summarize(_load(old)[0])
+    assert summary["engine"]["queue_wait_mean_s"] == pytest.approx(0.1)
+    assert summary["engine"]["deadline_miss_rate"] == 0.0
+    # within threshold: queue wait +50%, no misses
+    _engine_artifact(new, queue_wait_mean=0.15, miss=0, admitted=10)
+    assert metrics_main(["--diff", old, new, "--threshold", "60"]) == 0
+    # queue-wait regression past the threshold fails the gate
+    _engine_artifact(new, queue_wait_mean=0.5, miss=0, admitted=10)
+    assert metrics_main(["--diff", old, new, "--threshold", "60"]) == 2
+    assert "queue-wait regression" in capsys.readouterr().err
+    # deadline-miss rate rising past the point threshold fails the gate
+    _engine_artifact(new, queue_wait_mean=0.1, miss=9, admitted=10)
+    assert metrics_main(["--diff", old, new, "--threshold", "60"]) == 2
+    assert "deadline-miss rate" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# real-process drills
+# ---------------------------------------------------------------------------
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"  # the drills watch live stdout lines
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SART_TEST_JOURNAL_DELAY", None)
+    env.pop("SART_FAULT", None)
+    for k, v in (extra or {}).items():
+        env[k] = v
+    return env
+
+
+def _serve_cmd(paths, eng_dir, *extra):
+    return [
+        sys.executable, "-m", "sartsolver_tpu.cli", "serve",
+        "--engine_dir", eng_dir, *SOLVE_FLAGS,
+        "--lanes", "2", "--poll_interval", "0.05", *extra,
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+    ]
+
+
+def _submit_cmd(eng_dir, *extra):
+    return [sys.executable, "-m", "sartsolver_tpu.cli", "submit",
+            "--engine_dir", eng_dir, *extra]
+
+
+def _start_serve(cmd, env, timeout=120):
+    proc = subprocess.Popen(
+        cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    deadline = time.monotonic() + timeout
+    lines = []
+
+    for line in proc.stdout:
+        lines.append(line)
+        if "session resident" in line:
+            return proc, lines
+        if time.monotonic() > deadline:
+            break
+    proc.kill()
+    raise AssertionError(
+        "serve process never became resident:\n" + "".join(lines)
+    )
+
+
+def _drain_stdout(proc, sink):
+    t = threading.Thread(
+        target=lambda: sink.extend(proc.stdout), daemon=True
+    )
+    t.start()
+    return t
+
+
+@pytest.fixture(scope="module")
+def drill_world(tmp_path_factory):
+    td = tmp_path_factory.mktemp("engine_drill")
+    paths, *_ = fx.write_world(str(td), n_frames=4)
+    return paths
+
+
+def test_serve_submit_lifecycle_and_sigterm(drill_world, tmp_path):
+    """One real serve process: dir submit with --wait completes; a
+    duplicate id is rejected with the machine-readable reason at exit-
+    code parity; a malformed submit fails locally with exit 1; SIGTERM
+    drains and exits 4."""
+    eng = str(tmp_path / "eng")
+    env = _env()
+    proc, lines = _start_serve(_serve_cmd(drill_world, eng), env)
+    _drain_stdout(proc, lines)
+    try:
+        done = subprocess.run(
+            _submit_cmd(eng, "--id", "life1", "--tenant", "demo",
+                        "--wait", "90"),
+            env=env, capture_output=True, text=True, timeout=120,
+        )
+        assert done.returncode == 0, done.stderr
+        rec = json.loads(done.stdout)
+        assert rec["outcome"]["status"] == "completed"
+        assert rec["outcome"]["frames"] == 4
+
+        # idempotent resubmission: the completed id's recorded outcome
+        # is returned (never re-run, never clobbered) with the
+        # duplicate flag set
+        dup = subprocess.run(
+            _submit_cmd(eng, "--id", "life1", "--wait", "60"),
+            env=env, capture_output=True, text=True, timeout=90,
+        )
+        assert dup.returncode == 0, dup.stdout + dup.stderr
+        dup_rec = json.loads(dup.stdout)
+        assert dup_rec.get("duplicate") is True
+        assert dup_rec["outcome"]["status"] == "completed"
+        # and the original's response record survived intact
+        assert _response(eng, "life1")["outcome"]["frames"] == 4
+
+        bad = subprocess.run(
+            _submit_cmd(eng, "--id", "bad name!"),
+            env=env, capture_output=True, text=True, timeout=90,
+        )
+        assert bad.returncode == 1
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    assert rc == 4
+    text = "".join(lines)
+    assert "draining" in text
+
+
+CRASH_REQUESTS = [
+    {"id": "cr1", "tenant": "a", "time_range": "0.05:0.25"},
+    {"id": "cr2", "tenant": "b"},
+]
+
+
+@pytest.fixture(scope="module")
+def crash_reference(drill_world, tmp_path_factory):
+    """Uninterrupted reference outputs for the crash matrix (one real
+    serve run shared by every marker leg)."""
+    ref = str(tmp_path_factory.mktemp("crash_ref"))
+    os.makedirs(os.path.join(ref, "ingest"), exist_ok=True)
+    for i, payload in enumerate(CRASH_REQUESTS):
+        with open(os.path.join(ref, "ingest", f"{i}-r.json"), "w") as f:
+            json.dump(payload, f)
+    res = subprocess.run(
+        _serve_cmd(drill_world, ref, "--idle_exit", "1"),
+        env=_env(), capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    return {
+        r["id"]: _solution(os.path.join(ref, "outputs",
+                                        f"{r['id']}.h5"))
+        for r in CRASH_REQUESTS
+    }
+
+
+@pytest.mark.parametrize("marker", ["accepted", "dispatched", "pre-flush"])
+def test_crash_replay_matrix(drill_world, crash_reference, tmp_path,
+                             marker):
+    """SIGKILL the real serve process inside a journal marker window,
+    restart, and assert: no request lost, none double-solved, outputs
+    byte-identical to an uninterrupted run (ISSUE acceptance)."""
+    requests = CRASH_REQUESTS
+    ref_out = crash_reference
+    env = _env()
+
+    # kill run: the journal windows are held open; SIGKILL inside the
+    # first occurrence of the target marker
+    eng = str(tmp_path / "eng")
+    os.makedirs(os.path.join(eng, "ingest"))
+    for i, payload in enumerate(requests):
+        with open(os.path.join(eng, "ingest", f"{i}-r.json"), "w") as f:
+            json.dump(payload, f)
+    kill_env = _env({"SART_TEST_JOURNAL_DELAY": "1.5"})
+    proc = subprocess.Popen(
+        _serve_cmd(drill_world, eng),
+        env=kill_env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+    watchdog_timer = threading.Timer(240, proc.kill)
+    watchdog_timer.start()
+    try:
+        for line in proc.stdout:
+            if f"SART_JOURNAL_POINT {marker}" in line:
+                proc.kill()
+                break
+    finally:
+        watchdog_timer.cancel()
+    assert proc.wait(timeout=60) == -signal.SIGKILL
+
+    # restart without the windows: replay must finish exactly the
+    # unfinished requests
+    rc = subprocess.run(
+        _serve_cmd(drill_world, eng, "--idle_exit", "1"),
+        env=env, capture_output=True, text=True, timeout=300,
+    ).returncode
+    assert rc == 0
+    completed, pending = journal_mod.RequestJournal(
+        os.path.join(eng, "journal.jsonl")).replay()
+    assert set(completed) == {"cr1", "cr2"} and not pending
+    # solved exactly once: one completed marker per id
+    with open(os.path.join(eng, "journal.jsonl")) as f:
+        markers = [json.loads(ln) for ln in f if ln.strip()
+                   and ln.strip().endswith("}")]
+    n_completed = {}
+    for rec in markers:
+        if rec.get("marker") == "completed":
+            n_completed[rec["id"]] = n_completed.get(rec["id"], 0) + 1
+    assert n_completed == {"cr1": 1, "cr2": 1}
+    for rid, ref_sol in ref_out.items():
+        got = _solution(os.path.join(eng, "outputs", f"{rid}.h5"))
+        for key in sorted(ref_sol):
+            np.testing.assert_array_equal(
+                got[key], ref_sol[key],
+                err_msg=f"{marker}/{rid}/{key} not byte-identical",
+            )
+
+
+def test_serve_fault_sites_end_to_end(drill_world, tmp_path):
+    """The three engine fault sites drilled through the real serve
+    process in one resident run, exercised via sequential submits so
+    the retry/shed/quarantine legs are judged on real outcomes:
+    request.parse -> malformed rejection; journal.append -> in-place
+    retry recovery; session.attach -> FAILED outcomes that quarantine
+    the tenant (and only that tenant)."""
+    eng = str(tmp_path / "eng")
+    env = _env({
+        "SART_FAULT": "request.parse:io:1:1,journal.append:io:1:2,"
+                      "session.attach:error:1:2",
+        "SART_RETRY_BASE_DELAY": "0.01",
+    })
+    submit_env = _env()
+    proc, lines = _start_serve(
+        _serve_cmd(drill_world, eng, "--quarantine_after", "2"), env,
+    )
+    _drain_stdout(proc, lines)
+    try:
+        def submit(rid, tenant):
+            return subprocess.run(
+                _submit_cmd(eng, "--id", rid, "--tenant", tenant,
+                            "--wait", "90"),
+                env=submit_env, capture_output=True, text=True,
+                timeout=120,
+            )
+
+        # parse fault trips on the first payload: rejected malformed
+        # (response keyed by the ingest file stem, i.e. the id)
+        p1 = submit("p1", "noisy")
+        assert p1.returncode == 1, p1.stdout + p1.stderr
+        assert json.loads(p1.stdout)["reason"] \
+            == req_mod.REASON_MALFORMED
+        # attach faults fail two requests -> tenant quarantined; the
+        # journal's own injected append faults retry in place underneath
+        f1 = submit("f1", "noisy")
+        assert f1.returncode == 3, f1.stdout + f1.stderr
+        assert json.loads(f1.stdout)["outcome"]["status"] == "failed"
+        f2 = submit("f2", "noisy")
+        assert json.loads(f2.stdout)["outcome"]["status"] == "failed"
+        f3 = submit("f3", "noisy")
+        assert f3.returncode == 3
+        assert json.loads(f3.stdout)["reason"] \
+            == req_mod.REASON_TENANT_QUARANTINED
+        ok = submit("ok", "calm")
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        assert json.loads(ok.stdout)["outcome"]["status"] == "completed"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    assert rc == 4
+    # the journal survived its injected append faults via retry: every
+    # accepted request has a consistent record
+    completed, pending = journal_mod.RequestJournal(
+        os.path.join(eng, "journal.jsonl")).replay()
+    assert set(completed) == {"f1", "f2", "ok"} and not pending
