@@ -840,6 +840,110 @@ def _worker_main() -> int:
             "overhead_pct": round(100.0 * (off - on) / off, 2) if off else 0.0,
         }
 
+    def run_sparse(occ_pct: int, timed_reps: int) -> dict:
+        """Dense vs block-sparse iter/s at a fixed tile occupancy
+        (ISSUE 13, docs/PERFORMANCE.md §10): a synthetic banded,
+        REFLECTION-FREE RTM at the sweep shape — each pixel couples to a
+        localized voxel window and there is no dense reflection floor,
+        so (100-occ)% of the voxel panels are exactly zero. Both paths
+        solve the SAME matrix at fixed iterations; parity is asserted
+        (PARITY_RTOL — the panel scan only regroups reductions) and
+        detail.sparse.occN.iter_speedup is what `sartsolve metrics
+        --diff --threshold` gates run-over-run in `make bench-smoke`."""
+        from sartsolver_tpu.models.sart import (
+            FUSED_ENGAGEMENT, make_problem, make_sparse_problem,
+        )
+        from sartsolver_tpu.utils.fused_parity import PARITY_RTOL
+
+        # FIXED shape, independent of the sweep env: the item measures
+        # the tile-skip's relative win, so it must be comparable across
+        # smoke/TPU rounds — and gemm-shaped (B frames), since a B=1
+        # gemv at smoke shapes is all panel-loop overhead on CPU
+        Ps, Vs, Bs, bs = 1024, 8192, 8, 1024
+        sr = np.random.default_rng(13)
+        n_panels = Vs // bs
+        occupied = max(1, round(n_panels * occ_pct / 100))
+        Hs = np.zeros((Ps, Vs), np.float32)
+        for j in range(occupied):
+            lo = j * bs
+            # banded response confined to the occupied panels: pixel i
+            # sees a localized voxel window (ray locality), and there is
+            # NO dense reflection floor — the reflection-free class
+            ii = np.arange(Ps)[:, None]
+            jj = np.arange(lo, lo + bs)[None, :]
+            center = lo + (ii * bs) // Ps
+            band = np.exp(-((jj - center) ** 2) / (0.02 * bs * bs + 1.0))
+            Hs[:, lo:lo + bs] = (
+                band * (sr.random((Ps, bs), dtype=np.float32) * 0.9 + 0.1)
+            ).astype(np.float32)
+        f_sp = sr.random((Bs, Vs), dtype=np.float32) + 0.5
+        Gs = f_sp.astype(np.float64) @ Hs.astype(np.float64).T
+        norms_s = np.maximum(Gs.max(axis=1), 1e-30)
+        msq_s = (np.where(Gs > 0, Gs, 0.0) ** 2).sum(axis=1) / norms_s ** 2
+        g_dev = jnp.asarray((Gs / norms_s[:, None]).astype(np.float32))
+        msq_dev = jnp.asarray(msq_s, jnp.float32)
+        f0 = jnp.zeros((Bs, Vs), jnp.float32)
+
+        def rate(sparse: bool):
+            opts = SolverOptions(
+                max_iterations=min(iters, 50), conv_tolerance=0.0,
+                fused_sweep="auto",
+                sparse_rtm="0" if sparse else "off",
+                fused_panel_voxels=bs if sparse else None,
+            )
+            if sparse:
+                problem, occ = make_sparse_problem(Hs, opts=opts)
+            else:
+                problem, occ = make_problem(Hs, opts=opts), None
+
+            def run():
+                return solve_normalized_batch(
+                    problem, g_dev, msq_dev, f0, opts=opts,
+                    axis_name=None, voxel_axis=None, use_guess=True,
+                    tile_occupancy=occ,
+                )
+
+            res = run()
+            sol = np.asarray(res.solution)  # compile + warm
+            engaged = FUSED_ENGAGEMENT["last"]
+            n_done = max(int(res.iterations[0]), 1)
+            best = float("inf")
+            for _ in range(timed_reps):
+                t_rep = time.perf_counter()
+                res = run()
+                sol = np.asarray(res.solution)
+                best = min(best, time.perf_counter() - t_rep)
+            frac = occ.occupancy_fraction() if occ is not None else 1.0
+            return n_done / best, sol[0], engaged, frac
+
+        dense_rate, dense_sol, _, _ = rate(False)
+        sparse_rate, sparse_sol, engaged, frac = rate(True)
+        d = float(np.max(np.abs(sparse_sol - dense_sol)))
+        scale = float(np.max(np.abs(dense_sol)))
+        parity = bool(d <= PARITY_RTOL * max(scale, 1.0))
+        out = {
+            "occ_pct": occ_pct,
+            "tile_occupancy": round(frac, 4),
+            "panel_voxels": bs,
+            "iter_s_dense": round(dense_rate, 2),
+            "iter_s_sparse": round(sparse_rate, 2),
+            "iter_speedup": round(sparse_rate / max(dense_rate, 1e-9), 3),
+            "sparse_engaged": engaged,
+            "parity_max_abs_diff": round(d, 9),
+            "parity": parity,
+        }
+        if not parity:
+            out["error"] = (
+                f"sparse-vs-dense parity FAILED at occ{occ_pct}: "
+                f"max|d|={d:.3e} vs scale {scale:.3e}"
+            )
+        if not str(engaged).startswith("sparse"):
+            out["error"] = (
+                f"block-sparse path did not engage at occ{occ_pct}: "
+                f"{engaged}"
+            )
+        return out
+
     def run_probe() -> dict:
         """~0.35 s fixed-shape bandwidth probe (VERDICT r4 next #5): a
         50-step power iteration over the staged fp32 matrix using the
@@ -1003,6 +1107,8 @@ def _worker_main() -> int:
                 data = run_integrity(item["reps"])
             elif item["kind"] == "tts":
                 data = run_tts(item["log"])
+            elif item["kind"] == "sparse":
+                data = run_sparse(item["occ"], item["reps"])
             elif item["kind"] == "probe":
                 data = run_probe()
             else:
@@ -1321,6 +1427,16 @@ def main() -> int:
                "log": name == "log", "deadline": budget_s + 240,
                "timeout": conv_timeout}
               for name in ("linear", "log")]
+    # block-sparse RTM section (ISSUE 13, docs/PERFORMANCE.md §10):
+    # dense vs sparse iter/s on synthetic banded reflection-free RTMs at
+    # 25/50/100% tile occupancy, parity-asserted; occ50's iter_speedup
+    # is gated run-over-run by `sartsolve metrics --diff --threshold`
+    # in `make bench-smoke`. Runs in quick mode too so the smoke
+    # artifact carries it (plain XLA — no TPU needed).
+    items += [{"kind": "sparse", "id": f"sparse:occ{p}", "occ": p,
+               "reps": 2, "deadline": budget_s + 240,
+               "timeout": cfg_timeout}
+              for p in (25, 50, 100)]
     # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
@@ -1408,6 +1524,13 @@ def main() -> int:
         # accelerated time-to-solution (ISSUE 10, docs §9); `sartsolve
         # metrics --diff` gates detail.tts.log.iter_speedup run-over-run
         detail["tts"] = tts
+    sparse = {f"occ{p}": results[f"sparse:occ{p}"] for p in (25, 50, 100)
+              if f"sparse:occ{p}" in results}
+    if sparse:
+        # dense-vs-block-sparse iter/s at fixed tile occupancy (ISSUE
+        # 13); `sartsolve metrics --diff` gates
+        # detail.sparse.occ50.iter_speedup run-over-run
+        detail["sparse"] = sparse
     probes = {end: results[f"probe:{end}"] for end in ("start", "end")
               if f"probe:{end}" in results}
     if probes:

@@ -806,9 +806,102 @@ class BroadExceptDeviceCode(Rule):
                     )
 
 
+class DenseRtmContraction(Rule):
+    """SL007 — a dense matrix product against the RTM (``rtm @ x``,
+    ``jnp.matmul(problem.rtm, ...)``, ``lax.dot_general`` on an
+    rtm-named operand) outside the operator layer
+    (``ops/fused_sweep.py`` / ``ops/projection.py``): new code must
+    route contractions through the projection operators or the fused/
+    panel-sweep primitives — a raw dot bypasses the block-sparse
+    tile-skip (and the fused-sweep dispatch entirely), so the sparse
+    path silently degrades to dense the moment such a call lands on a
+    hot path (docs/PERFORMANCE.md §10)."""
+
+    id = "SL007"
+    severity = "error"
+    title = "dense RTM contraction outside the operator layer"
+    hint = ("route the product through ops/projection.py "
+            "(forward_project/back_project) or the fused/panel sweep "
+            "primitives (ops/fused_sweep.py) so sparse/fused dispatch "
+            "applies; annotate deliberate exceptions with "
+            "sart-lint: disable=SL007 and a why")
+
+    # the operator layer itself: the one home for raw RTM contractions
+    _ALLOWED_SUFFIXES = ("ops/fused_sweep.py", "ops/projection.py")
+    _MATMUL_FNS = ("matmul", "dot", "dot_general", "einsum", "tensordot",
+                   "vdot")
+    _RTM_NAME_RE = re.compile(r"(^|_)rtm($|_)", re.IGNORECASE)
+    # rtm-PREFIXED metadata/vector identifiers that are not the matrix:
+    # a contraction against the int8 scale vector (or passing the dtype/
+    # name strings around) must not trip an error-severity rule
+    _RTM_META_RE = re.compile(
+        r"(^|_)rtm_(scale|dtype|name|names|stats|files|frame_masks)s?$",
+        re.IGNORECASE,
+    )
+
+    def _names_rtm(self, ident: str) -> bool:
+        return bool(self._RTM_NAME_RE.search(ident)
+                    and not self._RTM_META_RE.search(ident)
+                    and ident != "sparse_rtm")
+
+    def _mentions_rtm(self, expr: ast.AST) -> bool:
+        """True when the DIRECT operand is the raw matrix: a Name or an
+        attribute/subscript chain whose links name it (``rtm``,
+        ``problem.rtm``, ``self.rtm.T``, ``rtm[0]``). Deliberately does
+        NOT descend into calls or nested expressions — a product against
+        ``back_project(rtm, w)``'s RESULT is routed through the operator
+        layer and must stay clean (and nested ``(w @ rtm) @ y`` reports
+        once, at the inner product)."""
+        while isinstance(expr, (ast.Attribute, ast.Subscript)):
+            if isinstance(expr, ast.Attribute) and self._names_rtm(
+                expr.attr
+            ):
+                return True
+            expr = expr.value
+        return isinstance(expr, ast.Name) and self._names_rtm(expr.id)
+
+    def run(self, model: ModuleModel) -> Iterator[Finding]:
+        path = model.path.replace("\\", "/")
+        if any(path.endswith(sfx) for sfx in self._ALLOWED_SUFFIXES):
+            return
+        for node in ast.walk(model.tree):
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.MatMult
+            ):
+                if self._mentions_rtm(node.left) or self._mentions_rtm(
+                    node.right
+                ):
+                    yield self.finding(
+                        model, node,
+                        "dense `@` contraction against the RTM outside "
+                        "the operator layer (bypasses sparse/fused "
+                        "dispatch)",
+                    )
+            elif isinstance(node, ast.Call):
+                fn_path = _attr_path(node.func)
+                if fn_path is None:
+                    continue
+                head, _, tail = fn_path.rpartition(".")
+                is_matmul = tail in self._MATMUL_FNS and (
+                    head in model.jnp_aliases | model.lax_aliases
+                    | model.np_aliases
+                    or (head.split(".")[0] in model.jax_aliases)
+                )
+                if not is_matmul:
+                    continue
+                if any(self._mentions_rtm(a) for a in node.args):
+                    yield self.finding(
+                        model, node,
+                        f"dense `{fn_path}` contraction against the RTM "
+                        "outside the operator layer (bypasses sparse/"
+                        "fused dispatch)",
+                    )
+
+
 JAX_RULES: Tuple[Rule, ...] = (
     TracerControlFlow(), HostSyncInLoop(), ImplicitDtype(),
     MissingDonation(), StaticArgCandidate(), BroadExceptDeviceCode(),
+    DenseRtmContraction(),
 )
 
 # Filled in at the bottom of this module: JAX_RULES plus the SL1xx

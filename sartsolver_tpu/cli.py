@@ -17,6 +17,7 @@ grouped under "tpu options".
 from __future__ import annotations
 
 import argparse
+import math
 import sys
 import time as _time
 from typing import List, Optional
@@ -186,6 +187,19 @@ def build_parser() -> argparse.ArgumentParser:
                           "SART_FUSED_PANEL_BYTES). 'interpret' runs the "
                           "kernel in the Pallas interpreter (works off-TPU; "
                           "slow, for validation).")
+    tpu.add_argument("--sparse_rtm", default=None, metavar="auto|off|EPS",
+                     help="Block-sparse RTM mode (PERFORMANCE.md §10): "
+                          "'auto' builds a lossless tile-occupancy index "
+                          "at ingest and skips all-zero (pixel-block x "
+                          "voxel-panel) tiles in the iteration sweep — "
+                          "bit-identical results, FLOPs/bytes scale with "
+                          "occupancy. A numeric EPS in [0, 1) drops tiles "
+                          "whose entries are all <= EPS*max|H| (lossy; "
+                          "rho/lambda and the Eq. 6 masks come from the "
+                          "thresholded operator). 'auto' declines on "
+                          "voxel-sharded meshes and multi-process runs; "
+                          "an explicit EPS fails loudly there. Also via "
+                          "SART_SPARSE_RTM.")
     tpu.add_argument("--debug_nans", action="store_true",
                      help="Enable jax debug-NaN checking: abort with a "
                           "traceback at the first NaN-producing op instead "
@@ -331,6 +345,28 @@ def _validate(args) -> None:
         fail(f"Argument os_subsets > 1 runs the subset-cycle sweep; "
              f"--fused_sweep {args.fused_sweep} cannot be honored there — "
              "use auto or off.")
+    if args.sparse_rtm is None:
+        # flag > SART_SPARSE_RTM env > off (the schedule_stride pattern)
+        import os as _os_sparse
+
+        args.sparse_rtm = _os_sparse.environ.get("SART_SPARSE_RTM", "off")
+    if args.sparse_rtm not in ("auto", "off"):
+        try:
+            eps = float(args.sparse_rtm)
+            ok = 0.0 <= eps < 1.0 and math.isfinite(eps)
+        except ValueError:
+            ok = False
+        if not ok:
+            fail("Argument sparse_rtm must be 'auto', 'off' or a relative "
+                 f"threshold in [0, 1), {args.sparse_rtm!r} given.")
+        if args.use_cpu:
+            fail("Argument sparse_rtm needs the fp32 device profile; an "
+                 "explicit threshold cannot be combined with --use_cpu "
+                 "(use 'auto', which declines there).")
+    if args.sparse_rtm != "off" and args.fused_sweep in ("on", "interpret"):
+        fail("Argument sparse_rtm engages the block-sparse panel sweep; "
+             f"--fused_sweep {args.fused_sweep} cannot be honored there — "
+             "use auto or off.")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -383,6 +419,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # passes through.
         raise SystemExit(1 if err.code else 0) from None
     _validate(args)
+
+    # Per-RUN warning scope: re-arm the once-per-run non-finite-pixel
+    # warning so repeated runs in one interpreter (tests, notebooks)
+    # each surface it (models/sart.py latch; the serving engine re-arms
+    # per request instead).
+    from sartsolver_tpu.models.sart import reset_nonfinite_warning
+
+    reset_nonfinite_warning()
 
     # Heavy imports deferred so `--help` stays instant.
     import jax
@@ -654,6 +698,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 momentum=args.momentum,
                 rtm_dtype=args.rtm_dtype,
                 fused_sweep=args.fused_sweep,
+                sparse_rtm=args.sparse_rtm,
             )
             devices = jax.devices()
 
@@ -779,6 +824,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"rtm_dtype={opts.rtm_dtype or opts.dtype} "
                 f"compute={opts.dtype} "
                 f"fused_sweep={args.fused_sweep}->{opts.fused_sweep} "
+                f"sparse_rtm={opts.sparse_rtm} "
                 f"os_subsets={opts.os_subsets} momentum={opts.momentum} "
                 f"processes={jax.process_count()}"
             )
@@ -847,6 +893,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             integ_mod.IngestStats(npixel, nvoxel)
             if integrity_on and not args.multihost else None
         )
+        # Block-sparse layer (docs/PERFORMANCE.md §10): the tile-occupancy
+        # pass rides the chunked ingest — the accumulator is fed the same
+        # storage-rounded (double-read/CRC32-verified) pieces the
+        # integrity layer sums, so the index covers the packed matrix at
+        # no extra read. Single-process + pixel-major only; 'auto'
+        # declines elsewhere (an explicit threshold fails loudly in the
+        # solver/make_tile_stats with the actual reason).
+        # the one shared gate (multihost.sparse_tile_stats_or_decline):
+        # explicit thresholds fail loudly BEFORE the ingest with the
+        # actual reason, 'auto' warns and runs dense, voxel-sharded
+        # meshes defer to the solver ctor's refusal
+        from sartsolver_tpu.parallel.multihost import (
+            sparse_tile_stats_or_decline,
+        )
+
+        tile_stats = sparse_tile_stats_or_decline(
+            opts, mesh, npixel, nvoxel, n_vox
+        )
         with obs_trace.span("ingest.rtm", npixel=npixel, nvoxel=nvoxel):
             if opts.rtm_dtype == "int8":
                 # two-pass ingest: quantize fp32 chunks host-side into
@@ -859,30 +923,65 @@ def main(argv: Optional[List[str]] = None) -> int:
 
                 rtm, rtm_scale = read_and_quantize_rtm(
                     sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
-                    ingest_stats=ingest_stats,
+                    ingest_stats=ingest_stats, tile_stats=tile_stats,
                 )
             else:
                 rtm = read_and_shard_rtm(
                     sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
                     dtype=opts.rtm_dtype or opts.dtype,
                     serialize=args.multihost and not args.parallel_read,
-                    ingest_stats=ingest_stats,
+                    ingest_stats=ingest_stats, tile_stats=tile_stats,
                 )
+            tile_occ = (
+                tile_stats.occupancy(opts.sparse_epsilon())
+                if tile_stats is not None else None
+            )
             solver = DistributedSARTSolver(
                 rtm, lap, opts=opts, mesh=mesh, npixel=npixel,
                 nvoxel=nvoxel, rtm_scale=rtm_scale,
+                tile_occupancy=tile_occ,
+            )
+        if tile_occ is not None:
+            # this is the INDEX, known at ingest; whether the sweep
+            # engaged it is a trace-time decision — --timing's engaged=
+            # line (FUSED_ENGAGEMENT) is the post-compile provenance
+            print(
+                f"sparse: tile occupancy "
+                f"{tile_occ.occupancy_fraction():.3f} "
+                f"(threshold {tile_occ.threshold:g}, eps "
+                f"{tile_occ.epsilon:g}, digest {tile_occ.digest:#010x}; "
+                "engagement in --timing)"
             )
         if ingest_stats is not None:
-            # post-upload verification: the device's rho/lambda must match
-            # the host sums the ingest just accumulated — a mismatch means
-            # the staging DMA or on-device layout corrupted the matrix,
-            # and every solve it would serve is poisoned: quarantine now
-            issues = solver.verify_ray_stats(ingest_stats)
-            if issues:
-                sdc_policy.resident_failure(
-                    "post-upload ray-stats verification: "
-                    + "; ".join(issues)
+            if (opts.sparse_epsilon() or 0) > 0 and tile_occ is not None \
+                    and not tile_occ.mask.all():
+                # a nonzero sparse threshold zeroes dropped tiles ON
+                # DEVICE after ingest, so host sums (which include the
+                # dropped entries) can no longer match the device's
+                # rho/lambda — comparing them would quarantine a healthy
+                # run with a bogus corruption diagnosis. The stripe
+                # digests, in-solve ABFT and the resident re-audit (all
+                # self-consistent with the thresholded operator) still
+                # run.
+                print(
+                    "Warning: post-upload ray-stats verification "
+                    "skipped: sparse_rtm threshold zeroed tiles after "
+                    "the host sums were accumulated (stripe digests, "
+                    "in-solve ABFT and the resident re-audit still "
+                    "cover the matrix).", file=sys.stderr,
                 )
+            else:
+                # post-upload verification: the device's rho/lambda must
+                # match the host sums the ingest just accumulated — a
+                # mismatch means the staging DMA or on-device layout
+                # corrupted the matrix, and every solve it would serve
+                # is poisoned: quarantine now
+                issues = solver.verify_ray_stats(ingest_stats)
+                if issues:
+                    sdc_policy.resident_failure(
+                        "post-upload ray-stats verification: "
+                        + "; ".join(issues)
+                    )
         _mark("ingest RTM + upload")
 
         grid = make_voxel_grid(

@@ -259,6 +259,22 @@ class SolverOptions:
     # extent, and pins the per-iteration psum count (= nvoxel_local/width)
     # — the compile audit uses it to hold a deterministic collective count.
     fused_panel_voxels: int | None = None
+    # Block-sparse RTM mode (docs/PERFORMANCE.md §10): "off" (default) is
+    # the dense solver, byte-identical to every pre-sparse trace. "auto"
+    # builds a lossless tile-occupancy index (exact-zero tiles only) and
+    # hosts the iteration sweep on the voxel-panel scan, skipping every
+    # all-zero (pixel-block x voxel-panel) column panel's dots — FLOPs
+    # and bytes scale with occupancy instead of matrix shape, and the
+    # solve is bit-identical to dense (a skipped panel's back-projection
+    # is exactly the zero the dense dot would produce). A numeric value
+    # in [0, 1) is a relative threshold: tiles whose every entry
+    # satisfies |H_ij| <= eps * max|H| are DROPPED (zeroed in storage)
+    # before rho/lambda and the Eq. 6 masks are computed, so the solve
+    # is self-consistent with the thresholded operator (residual-matched
+    # vs dense, not bit-exact). "auto" declines quietly where the sparse
+    # sweep cannot engage (voxel-sharded meshes, fp64 compute, no index
+    # for a pre-sharded matrix); a numeric threshold raises instead.
+    sparse_rtm: str = "off"
     # In-solve divergence recovery (resilience layer, docs/RESILIENCE.md):
     # the iteration body watches the residual metric for non-finite or
     # exploding values; a tripped frame rolls back to its last good
@@ -323,6 +339,22 @@ class SolverOptions:
         kw.setdefault("log_epsilon", 1.0e-30)
         return cls(logarithmic=logarithmic, **kw)
 
+    def sparse_epsilon(self) -> float | None:
+        """The relative block-sparse threshold this option set requests:
+        ``None`` when sparse mode is off, ``0.0`` for ``"auto"``
+        (lossless — exact-zero tiles only), else the parsed value."""
+        if self.sparse_rtm == "off":
+            return None
+        if self.sparse_rtm == "auto":
+            return 0.0
+        return float(self.sparse_rtm)
+
+    def sparse_explicit(self) -> bool:
+        """An explicit numeric ``sparse_rtm`` threshold was requested:
+        inability to engage the sparse sweep raises instead of quietly
+        running dense (the fused_sweep='on' contract, applied here)."""
+        return self.sparse_rtm not in ("off", "auto")
+
     def __post_init__(self) -> None:
         if self.ray_density_threshold < 0:
             raise ValueError("Ray density threshold must be non-negative.")
@@ -383,6 +415,29 @@ class SolverOptions:
             raise ValueError(
                 "Attribute fused_panel_voxels must be a positive multiple "
                 "of 128 (or None to derive from SART_FUSED_PANEL_BYTES)."
+            )
+        if self.sparse_rtm not in ("auto", "off"):
+            try:
+                eps = float(self.sparse_rtm)
+            except ValueError:
+                raise ValueError(
+                    "Attribute sparse_rtm must be 'auto', 'off' or a "
+                    "relative threshold in [0, 1), "
+                    f"{self.sparse_rtm!r} given."
+                ) from None
+            if not (0.0 <= eps < 1.0) or not math.isfinite(eps):
+                raise ValueError(
+                    "Attribute sparse_rtm threshold must lie in [0, 1) "
+                    f"(a fraction of max|H|), {self.sparse_rtm!r} given."
+                )
+        if self.sparse_rtm != "off" and self.fused_sweep in (
+            "on", "interpret"
+        ):
+            raise ValueError(
+                "Attribute sparse_rtm engages the block-sparse panel "
+                "sweep, which replaces the Pallas kernel; an explicit "
+                f"fused_sweep='{self.fused_sweep}' cannot be honored "
+                "there — use 'auto' or 'off'."
             )
         if self.divergence_recovery < 0:
             raise ValueError(

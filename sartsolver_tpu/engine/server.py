@@ -465,6 +465,12 @@ class EngineServer:
                 continue
             with self._lock:
                 self.journal.dispatched(req)
+            # per-REQUEST warning scope: a resident process must surface
+            # the non-finite-pixel warning for every affected request,
+            # not once per process lifetime (models/sart.py latch)
+            from sartsolver_tpu.models.sart import reset_nonfinite_warning
+
+            reset_nonfinite_warning()
             try:
                 image = self.session.attach(req)
             except (SartInputError,) + RECOVERABLE_FRAME_ERRORS as err:

@@ -108,7 +108,11 @@ class ResidentSession:
             jax.config.update("jax_enable_x64", True)
             devices = jax.devices("cpu")
         else:
-            opts = SolverOptions(rtm_dtype=args.rtm_dtype, **kw)
+            opts = SolverOptions(
+                rtm_dtype=args.rtm_dtype,
+                sparse_rtm=getattr(args, "sparse_rtm", None) or "off",
+                **kw,
+            )
             devices = jax.devices()
             resolved = resolve_fused_auto(opts, pixel_sharded=False)
             if resolved is not opts:
@@ -131,6 +135,19 @@ class ResidentSession:
             n_pix = args.pixel_shards or max(len(devices) // n_vox, 1)
         mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
 
+        # block-sparse tile-occupancy pass riding the resident session's
+        # ingest (docs/PERFORMANCE.md §10) — same gating as the one-shot
+        # CLI: single-process, pixel-major, 'auto' declines elsewhere
+        # the one shared block-sparse ingest gate (the one-shot CLI uses
+        # the same call, so solve and serve can never disagree on when
+        # an explicit threshold refuses vs 'auto' declines)
+        from sartsolver_tpu.parallel.multihost import (
+            sparse_tile_stats_or_decline,
+        )
+
+        tile_stats = sparse_tile_stats_or_decline(
+            opts, mesh, npixel, nvoxel, n_vox
+        )
         rtm_scale = None
         if opts.rtm_dtype == "int8":
             from sartsolver_tpu.parallel.multihost import (
@@ -139,15 +156,21 @@ class ResidentSession:
 
             rtm, rtm_scale = read_and_quantize_rtm(
                 sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
+                tile_stats=tile_stats,
             )
         else:
             rtm = read_and_shard_rtm(
                 sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
                 dtype=opts.rtm_dtype or opts.dtype,
+                tile_stats=tile_stats,
             )
         solver = DistributedSARTSolver(
             rtm, lap, opts=opts, mesh=mesh, npixel=npixel,
             nvoxel=nvoxel, rtm_scale=rtm_scale,
+            tile_occupancy=(
+                tile_stats.occupancy(opts.sparse_epsilon())
+                if tile_stats is not None else None
+            ),
         )
         grid = make_voxel_grid(
             next(iter(sorted_matrix_files.values())), "rtm/voxel_map"

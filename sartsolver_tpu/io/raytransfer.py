@@ -174,6 +174,7 @@ def read_rtm_block(
     sparse_cache: Optional[dict] = None,
     cache_rows: Optional[Tuple[int, int]] = None,
     cache_cols: Optional[Tuple[int, int]] = None,
+    tile_stats=None,
 ) -> np.ndarray:
     """Read rows ``[offset_pixel, offset_pixel + npixel_local)`` x columns
     ``[offset_voxel, offset_voxel + nvoxel_local)`` of the global RTM.
@@ -183,6 +184,15 @@ def read_rtm_block(
     owned by the caller, shared across chunked calls) enables the one-pass
     sparse path; ``cache_rows``/``cache_cols`` bound what it retains — pass
     the caller's full row/column window.
+
+    ``tile_stats`` (an ``ops.sparse.TileMaxStats``): the block-sparse
+    tile-occupancy pass — each assembled window folds its per-tile
+    max |H| into the accumulator at its global offset, so a chunked read
+    of the whole matrix yields exactly the one-shot index (max is
+    idempotent: the integrity layer's double reads cost nothing). Callers
+    staging a reduced-precision representation accumulate the storage-
+    rounded pieces instead (``parallel/multihost.read_and_shard_rtm``) so
+    the index covers the packed matrix.
 
     ``scatter_coo(mat, rows, cols, vals)`` may be supplied to override the
     sparse scatter; by default the native C++ helper is used when the
@@ -263,4 +273,6 @@ def read_rtm_block(
         if last_pixel < start_pixel:
             break
 
+    if tile_stats is not None:
+        tile_stats.add(mat, offset_pixel, offset_voxel)
     return mat

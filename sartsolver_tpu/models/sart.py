@@ -40,13 +40,20 @@ from sartsolver_tpu.config import (
     SolverOptions,
 )
 from sartsolver_tpu.ops.fused_sweep import (
+    SPARSE_STATIC_UNROLL_MAX,
     fused_available,
     fused_sweep,
     os_subset_back,
     os_subset_forward,
     os_subset_pixels,
     os_subset_rows,
+    panel_available,
+    pick_panel_voxels,
     sharded_panel_sweep,
+    sparse_gather_sweep,
+    sparse_os_back,
+    sparse_os_forward,
+    sparse_panel_sweep,
 )
 from sartsolver_tpu.ops.laplacian import (
     LaplacianCOO,
@@ -411,6 +418,52 @@ def make_problem(
     return SARTProblem(rtm.astype(rtm_dtype), dens, length, laplacian)
 
 
+def make_sparse_problem(
+    rtm,
+    laplacian: Optional[LaplacianCOO] = None,
+    *,
+    opts: SolverOptions,
+    axis_name=None,
+):
+    """:func:`make_problem` plus the block-sparse tile-occupancy pass
+    (docs/PERFORMANCE.md §10): returns ``(problem, occupancy)``.
+
+    With ``opts.sparse_rtm`` active the host matrix is indexed at
+    8x128-tile granularity and — for a nonzero threshold — every tile
+    whose entries all satisfy ``|H_ij| <= eps * max|H|`` is ZEROED before
+    the problem is built, so rho/lambda and the Eq. 6 masks come from the
+    thresholded operator the sweeps actually multiply by (the solve is
+    self-consistent; parity vs dense is residual-matched at eps > 0 and
+    bit-exact at eps == 0, where nothing is dropped). The returned
+    occupancy is the jit-static index the solver cores take as
+    ``tile_occupancy=``; ``(problem, None)`` when sparse mode is off.
+    The chunked-ingest equivalent lives in ``parallel/multihost.py``
+    (``TileMaxStats`` fed by the striped read).
+
+    Representation note: THIS path indexes the pre-storage fp32 values,
+    so with reduced-precision storage (bf16/int8) a tile whose every
+    entry rounds to zero in storage stays marked occupied — strictly
+    conservative (a missed skip, never a skipped live tile), but the
+    digest can differ from the ingest-built index of the same matrix,
+    which covers the PACKED representation (docs/FORMATS.md).
+    """
+    eps = opts.sparse_epsilon()
+    if eps is None:
+        return make_problem(rtm, laplacian, opts=opts,
+                            axis_name=axis_name), None
+    from sartsolver_tpu.ops.sparse import (
+        build_tile_occupancy,
+        threshold_matrix,
+    )
+
+    mat = np.asarray(rtm, np.float32)
+    occ = build_tile_occupancy(mat, epsilon=eps)
+    if eps > 0:
+        mat = threshold_matrix(mat, occ)
+    return make_problem(mat, laplacian, opts=opts,
+                        axis_name=axis_name), occ
+
+
 def solve_normalized(
     problem: SARTProblem,
     g: Array,
@@ -421,6 +474,7 @@ def solve_normalized(
     axis_name=None,
     voxel_axis=None,
     use_guess: bool,
+    tile_occupancy=None,
 ) -> SolveResult:
     """Jit-compiled solver core on a pre-normalized measurement.
 
@@ -450,7 +504,7 @@ def solve_normalized(
         jnp.reshape(jnp.asarray(msq, dtype), (1,)),
         f0[None, :],
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
-        use_guess=use_guess,
+        use_guess=use_guess, tile_occupancy=tile_occupancy,
     )
     return SolveResult(
         res.solution[0], res.status[0], res.iterations[0], res.convergence[0]
@@ -459,7 +513,7 @@ def solve_normalized(
 
 _SOLVER_STATIC_ARGS = (
     "opts", "axis_name", "voxel_axis", "use_guess", "return_fitted",
-    "_vmem_raised",
+    "_vmem_raised", "tile_occupancy",
 )
 
 
@@ -491,6 +545,7 @@ def solve_normalized_batch(
     fitted0: Optional[Array] = None,
     return_fitted: bool = False,
     _vmem_raised: bool = False,
+    tile_occupancy=None,
 ) -> "SolveResult | Tuple[SolveResult, Array]":
     """Batched solver core: B independent frames in one while_loop.
 
@@ -518,6 +573,7 @@ def solve_normalized_batch(
     kwargs = dict(
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
         use_guess=use_guess, fitted0=fitted0, return_fitted=return_fitted,
+        tile_occupancy=tile_occupancy,
     )
     if any(
         isinstance(leaf, jax.core.Tracer)
@@ -567,6 +623,7 @@ def solve_chain_normalized(
     use_guess_first: bool,
     fitted0: Optional[Array] = None,
     _vmem_raised: bool = False,
+    tile_occupancy=None,
 ) -> Tuple[SolveResult, Array]:
     """K warm-chained frames in ONE device program.
 
@@ -600,6 +657,7 @@ def solve_chain_normalized(
         problem,
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
         return_fitted=True, _vmem_raised=_vmem_raised,
+        tile_occupancy=tile_occupancy,
     )
     K = g.shape[0]
     if use_guess_first and fitted0 is not None:
@@ -664,7 +722,8 @@ class _SweepContext:
     """
 
     def __init__(self, problem: SARTProblem, opts: SolverOptions,
-                 axis_name, voxel_axis, B: int, _vmem_raised: bool):
+                 axis_name, voxel_axis, B: int, _vmem_raised: bool,
+                 tile_occupancy=None):
         dtype = self.dtype = jnp.dtype(opts.dtype)
         rtm = self.rtm = problem.rtm
         self.opts = opts
@@ -770,6 +829,121 @@ class _SweepContext:
                 0,
             ).astype(dtype)
 
+        # Block-sparse RTM mode (docs/PERFORMANCE.md §10): when the
+        # options request it AND the caller supplied the RTM's static
+        # tile-occupancy index (ops/sparse.py), the iteration sweep is
+        # hosted on the voxel-panel scan with all-zero column panels'
+        # dots skipped entirely — FLOPs/bytes scale with occupancy. The
+        # index is per-RTM static state (hashable, jit-static), so the
+        # skip pattern is baked at trace time and lanes/occupancies never
+        # recompile. Resolution mirrors the fused contract: "auto"
+        # declines quietly where the sparse sweep cannot engage, an
+        # explicit numeric threshold raises with the actual reason.
+        self.sparse = None
+        self._sparse_gather = False
+        self._sparse_occ_panels = None
+        self._sparse_bs = 0
+        sparse_eps = opts.sparse_epsilon()
+        if sparse_eps is not None:
+            pv = opts.fused_panel_voxels
+            bs = pv or pick_panel_voxels(
+                rtm.shape[0], nvoxel, rtm.dtype.itemsize, B
+            )
+            from sartsolver_tpu.ops.sparse import occupancy_matches
+
+            reasons = []
+            if tile_occupancy is None:
+                reasons.append(
+                    "no tile-occupancy index was supplied (build one at "
+                    "ingest, or via models.sart.make_sparse_problem)"
+                )
+            if voxel_axis is not None:
+                reasons.append(
+                    "the voxel axis is sharded (per-shard column panels "
+                    "map to different global panels, so the static skip "
+                    "is not SPMD-uniform)"
+                )
+            if dtype != jnp.float32 or rtm.dtype not in (
+                jnp.float32, jnp.bfloat16, jnp.int8
+            ):
+                reasons.append(
+                    f"dtype={opts.dtype} / rtm dtype={rtm.dtype} (the "
+                    "sparse panel sweep computes in fp32 over fp32/"
+                    "bfloat16/int8 storage)"
+                )
+            if (opts.divergence_recovery and opts.logarithmic
+                    and self.os == 1):
+                # the OS cycle applies the guard's exponent in plain XLA,
+                # so only the closure-hosted classic sweep is restricted
+                reasons.append(
+                    "divergence_recovery on the logarithmic solver (the "
+                    "panel closures cannot carry the per-frame traced "
+                    "exponent)"
+                )
+            if bs <= 0 or nvoxel % bs or not panel_available(
+                rtm.shape[0], nvoxel, rtm.dtype.itemsize, B
+            ):
+                reasons.append(
+                    f"RTM block {tuple(rtm.shape)} (batch {B}, panel "
+                    f"{bs}) is not tile-aligned for the panel sweep"
+                )
+            elif tile_occupancy is not None and not occupancy_matches(
+                tile_occupancy, nvoxel, bs
+            ):
+                reasons.append(
+                    f"the occupancy index covers "
+                    f"[{tile_occupancy.rows}, {tile_occupancy.cols}] and "
+                    f"cannot drive {bs}-wide panels over this "
+                    f"{nvoxel}-column block"
+                )
+            if reasons:
+                if opts.sparse_explicit():
+                    raise ValueError(
+                        f"sparse_rtm='{opts.sparse_rtm}' requested but "
+                        "the block-sparse sweep cannot engage: "
+                        + "; ".join(reasons) + "."
+                    )
+            occ_panels = (
+                tile_occupancy.col_panel_occupied(bs)
+                if not reasons and tile_occupancy is not None else None
+            )
+            if occ_panels is not None and self.os > 1 and (
+                int(occ_panels.sum()) > SPARSE_STATIC_UNROLL_MAX
+            ):
+                # the OS cycle's subset dots unroll per occupied panel
+                # (no gather form there); past the unroll cap that would
+                # bloat the traced program by orders of magnitude for
+                # little skip benefit — decline instead
+                reasons.append(
+                    f"os_subsets > 1 with {int(occ_panels.sum())} "
+                    "occupied panels exceeds SART_SPARSE_UNROLL_MAX="
+                    f"{SPARSE_STATIC_UNROLL_MAX} (the subset cycle has "
+                    "no gather fallback; raise the env or widen "
+                    "fused_panel_voxels)"
+                )
+                if opts.sparse_explicit():
+                    raise ValueError(
+                        f"sparse_rtm='{opts.sparse_rtm}' requested but "
+                        "the block-sparse sweep cannot engage: "
+                        + "; ".join(reasons) + "."
+                    )
+            elif not reasons:
+                tile_occupancy.verify()
+                self.sparse = tile_occupancy
+                self._sparse_bs = bs
+                self._sparse_occ_panels = occ_panels
+                n_occupied = int(occ_panels.sum())
+                # gather-of-occupied-panels fallback: a huge occupied-
+                # panel count would bloat the unrolled static-skip
+                # program; the fori_loop form is bit-identical
+                self._sparse_gather = (
+                    self.os == 1 and n_occupied > SPARSE_STATIC_UNROLL_MAX
+                )
+                if self._sparse_gather:
+                    self._sparse_panel_ids = jnp.asarray(
+                        np.nonzero(occ_panels)[0].astype(np.int32)
+                    )
+
         # Fused sweep: one HBM pass over the RTM per iteration instead of
         # two (ops/fused_sweep.py) — the Pallas kernel when the pixel
         # extent is whole on-device, the per-panel-psum scan ("panel")
@@ -783,7 +957,18 @@ class _SweepContext:
         # 'on'/'interpret' with os_subsets > 1 at construction).
         if self.os > 1:
             fused = self.fused = None
-            FUSED_ENGAGEMENT["last"] = "os-subset"
+            FUSED_ENGAGEMENT["last"] = (
+                "os-subset-sparse" if self.sparse is not None
+                else "os-subset"
+            )
+        elif self.sparse is not None:
+            # the sparse panel scan replaces both the Pallas kernel and
+            # the two-matmul path (SolverOptions already rejects an
+            # explicit fused_sweep='on'/'interpret' with sparse_rtm)
+            fused = self.fused = "sparse"
+            FUSED_ENGAGEMENT["last"] = (
+                "sparse-gather" if self._sparse_gather else "sparse-panel"
+            )
         else:
             fused = self.fused = _resolve_fused(
                 opts, axis_name, rtm, B, vmem_raised=_vmem_raised
@@ -949,6 +1134,38 @@ class _SweepContext:
         dtype = self.dtype
         scale = self.scale if self.is_int8 else None
         pen_scale = 1.0 / self.os
+        # Block-sparse composition (docs/PERFORMANCE.md §10): with the
+        # tile index resolved, every subset dot decomposes over voxel
+        # panels and skips the all-zero ones — the occupancy is a COLUMN
+        # property, so a panel empty in the full matrix is empty in
+        # every interleaved row subset. Collective counts are unchanged
+        # (sparse_os_back psums the reassembled [B, V] vector once).
+        occ_sp, bs_sp = self._sparse_occ_panels, self._sparse_bs
+        if self.sparse is None:
+            occ_sp = None
+        if occ_sp is not None:
+            from sartsolver_tpu.ops.fused_sweep import _sparse_trace_obs
+
+            _sparse_trace_obs(
+                self.sparse, len(occ_sp), int((~occ_sp).sum()), bs_sp,
+                "sparse_os",
+            )
+
+        def subset_fwd(panel, x):
+            if occ_sp is not None:
+                return sparse_os_forward(
+                    panel, x, scale, occ_panels=occ_sp, panel_voxels=bs_sp
+                )
+            return os_subset_forward(panel, x, scale)
+
+        def subset_back(panel, w_):
+            if occ_sp is not None:
+                return sparse_os_back(
+                    panel, w_, scale, occ_panels=occ_sp,
+                    panel_voxels=bs_sp, axis_name=self.axis_name,
+                )
+            return os_subset_back(panel, w_, scale,
+                                  axis_name=self.axis_name)
 
         def substep(t, f):
             panel = os_subset_rows(self.rtm, t, self.os)
@@ -958,13 +1175,10 @@ class _SweepContext:
             vm_t = lax.dynamic_index_in_dim(
                 self.vmask_sub, t, axis=0, keepdims=False
             )[None, :]
-            fitted_t = _psum(
-                os_subset_forward(panel, f, scale), self.voxel_axis
-            )
+            fitted_t = _psum(subset_fwd(panel, f), self.voxel_axis)
             if opts.logarithmic:
                 w = jnp.where(m_t, fitted_t, 0) * il_t
-                fit = os_subset_back(panel, w, scale,
-                                     axis_name=self.axis_name)
+                fit = subset_back(panel, w)
                 fit = jnp.where(vm_t, fit, 0)
                 obs_t = lax.dynamic_index_in_dim(
                     obs_sub, t, axis=1, keepdims=False
@@ -985,7 +1199,7 @@ class _SweepContext:
                 w = w * dk
             if ascale is not None:
                 w = w * ascale[:, None]
-            bp = os_subset_back(panel, w, scale, axis_name=self.axis_name)
+            bp = subset_back(panel, w)
             invd_t = lax.dynamic_index_in_dim(
                 self.inv_density_sub, t, axis=0, keepdims=False
             )[None, :]
@@ -1003,12 +1217,19 @@ class _SweepContext:
         # parts[t][:, q], i.e. stack on a trailing subset axis + reshape.
         if self.is_int8:
             parts = [
-                os_subset_forward(os_subset_rows(self.rtm, t, self.os),
-                                  f_upd, scale)
+                subset_fwd(os_subset_rows(self.rtm, t, self.os), f_upd)
                 for t in range(self.os)
             ]
             fitted_upd = jnp.stack(parts, axis=2).reshape(
                 f_upd.shape[0], self.rtm.shape[0]
+            )
+        elif occ_sp is not None:
+            # panel-decomposed full projection: same occupancy skips as
+            # the sub-steps, so the exact-projection contract holds on
+            # exactly the operator the loop multiplies by
+            fitted_upd = sparse_os_forward(
+                self.rtm, f_upd, None, occ_panels=occ_sp,
+                panel_voxels=bs_sp,
             )
         else:
             fitted_upd = forward_project(self.rtm, f_upd,
@@ -1046,6 +1267,42 @@ class _SweepContext:
     def run_fused(self, w, f, aux):
         if self.is_int8:
             aux = [self.scale[None, :]] + aux
+        if self.fused == "sparse":
+            # block-sparse voxel-panel scan (docs/PERFORMANCE.md §10):
+            # same update closures; all-zero column panels' dots are
+            # skipped (statically, or via the gather fallback when the
+            # occupied-panel count would bloat the unrolled program)
+            if self._sparse_gather:
+                from sartsolver_tpu.ops.fused_sweep import _sparse_trace_obs
+
+                occ_p = self._sparse_occ_panels
+                _sparse_trace_obs(
+                    self.sparse, len(occ_p), int((~occ_p).sum()),
+                    self._sparse_bs, "sparse_gather",
+                )
+                if self.axis_name is not None:
+                    # the gather loop issues one bp psum per occupied
+                    # panel, exactly like the static scan — keep the
+                    # collective-plan observability identical
+                    from sartsolver_tpu.obs import metrics as _obs_metrics
+
+                    _obs_metrics.get_registry().counter(
+                        "collectives_planned_total", collective="psum",
+                        site="sparse_panel_bp",
+                    ).inc(int(occ_p.sum()))
+                return sparse_gather_sweep(
+                    self.rtm, w, f, aux, self.update_fn,
+                    panel_ids=self._sparse_panel_ids,
+                    panel_voxels=self._sparse_bs,
+                    axis_name=self.axis_name,
+                    fwd_scale=0 if self.is_int8 else None,
+                )
+            return sparse_panel_sweep(
+                self.rtm, w, f, aux, self.update_fn,
+                occupancy=self.sparse, axis_name=self.axis_name,
+                fwd_scale=0 if self.is_int8 else None,
+                panel_voxels=self._sparse_bs,
+            )
         if self.fused == "panel":
             # pixel-sharded voxel-panel scan: same update closures, but
             # the back-projection panel arrives already psummed over the
@@ -1186,12 +1443,14 @@ def _solve_normalized_batch_impl(
     fitted0: Optional[Array] = None,
     return_fitted: bool = False,
     _vmem_raised: bool = False,
+    tile_occupancy=None,
 ) -> "SolveResult | Tuple[SolveResult, Array]":
     dtype = jnp.dtype(opts.dtype)
     rtm = problem.rtm
     B = g.shape[0]
 
-    kit = _SweepContext(problem, opts, axis_name, voxel_axis, B, _vmem_raised)
+    kit = _SweepContext(problem, opts, axis_name, voxel_axis, B,
+                        _vmem_raised, tile_occupancy=tile_occupancy)
     vmask, safe_dens = kit.vmask, kit.safe_dens
     bp_any, fp_any = kit.bp_any, kit.fp_any
     meas_mask = g >= 0  # [B, P]
@@ -1572,6 +1831,7 @@ def sched_step_normalized(
     voxel_axis=None,
     use_guess: bool = True,
     _vmem_raised: bool = False,
+    tile_occupancy=None,
 ) -> SchedState:
     """One scheduler stride: backfill the ``refill`` lanes, then run at
     most ``opts.schedule_stride`` masked iterations.
@@ -1589,7 +1849,7 @@ def sched_step_normalized(
     dtype = jnp.dtype(opts.dtype)
     B = state.g.shape[0]
     kit = _SweepContext(problem, opts, axis_name, voxel_axis, B,
-                        _vmem_raised)
+                        _vmem_raised, tile_occupancy=tile_occupancy)
     recovery = int(opts.divergence_recovery)
     explode = float(opts.divergence_threshold)
     tol = jnp.asarray(opts.conv_tolerance, dtype)
@@ -2064,6 +2324,40 @@ def _audit_log_accel_sweep():
     return fn.lower(_audit_problem(), *_audit_batch_args(2))
 
 
+# Once-per-RUN latch for the non-finite-pixel warning below. The old
+# behavior leaned on Python's per-location warning dedup, which fires once
+# per PROCESS — a resident `sartsolve serve` session silently swallowed
+# the warning for every request after the first. The latch is ours now
+# (warn_explicit with a fresh registry bypasses Python's dedup entirely)
+# and the drivers re-arm it per run/request; the per-pixel count still
+# lands in the nonfinite_pixels_total counter on every call either way.
+_NONFINITE_WARN_STATE = {"latched": False}
+
+
+def reset_nonfinite_warning() -> None:
+    """Re-arm the once-per-run non-finite-pixel warning. Called at the
+    start of every CLI run and of every serving-engine request, so a
+    resident process warns once per unit of user-visible work instead of
+    once per process lifetime."""
+    _NONFINITE_WARN_STATE["latched"] = False
+
+
+def _warn_nonfinite(n_bad: int) -> None:
+    if _NONFINITE_WARN_STATE["latched"]:
+        return
+    _NONFINITE_WARN_STATE["latched"] = True
+    import warnings
+
+    # warn_explicit with a throwaway registry: Python's own per-location
+    # dedup never latches, so OUR latch is the only once-per-run gate
+    warnings.warn_explicit(
+        f"measurement frames contain {n_bad} non-finite pixel(s); they "
+        "are excluded from normalization, ||g||^2 and the solve "
+        "(counted in the nonfinite_pixels_total metric)",
+        RuntimeWarning, __file__, 0, registry={},
+    )
+
+
 def prepare_measurement(measurement, opts: SolverOptions):
     """Host-side pre-step shared by the single-device and sharded drivers —
     the reference's ``pre_iteration_setup`` (sartsolver_cuda.cpp:138-194).
@@ -2085,22 +2379,16 @@ def prepare_measurement(measurement, opts: SolverOptions):
         # Non-finite pixels used to be *silently* excluded (from the
         # normalization max, ||g||^2 and — NaN compares false — the Eq. 6
         # measurement mask). They still are, but visibly now: counted
-        # into the nonfinite_pixels_total obs counter and warned once per
-        # run (warnings' per-location dedup makes repeats free).
-        import warnings
-
+        # into the nonfinite_pixels_total obs counter on EVERY call and
+        # warned once per run/request (the _NONFINITE_WARN_STATE latch,
+        # re-armed by reset_nonfinite_warning — never Python's
+        # once-per-process warning dedup).
         from sartsolver_tpu.obs import metrics as obs_metrics
 
         obs_metrics.get_registry().counter("nonfinite_pixels_total").inc(
             n_bad
         )
-        warnings.warn(
-            "measurement frames contain non-finite pixels; they are "
-            "excluded from normalization, ||g||^2 and the solve "
-            "(counted in the nonfinite_pixels_total metric)",
-            RuntimeWarning,
-            stacklevel=2,
-        )
+        _warn_nonfinite(n_bad)
     if opts.normalize:
         norm = float(np.max(g64, initial=0.0))
         if not np.isfinite(norm):
@@ -2126,6 +2414,7 @@ def solve(
     f0=None,
     *,
     opts: SolverOptions,
+    tile_occupancy=None,
 ) -> SolveResult:
     """Single-device solve on a full (unsharded) problem. The sharded
     equivalent lives in ``sartsolver_tpu.parallel.sharded``."""
@@ -2148,5 +2437,6 @@ def solve(
     res = solve_normalized(
         problem, g, jnp.asarray(msq, dtype), f0,
         opts=opts, axis_name=None, use_guess=use_guess,
+        tile_occupancy=tile_occupancy,
     )
     return SolveResult(res.solution * jnp.asarray(norm, dtype), res.status, res.iterations, res.convergence)

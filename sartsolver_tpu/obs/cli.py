@@ -202,6 +202,20 @@ def summarize(records: List[dict]) -> dict:
                 }
                 for name, sec in tts.items() if isinstance(sec, dict)
             }
+        # block-sparse section (bench.py sparse items, docs §10): the
+        # occ50 sparse-vs-dense iteration-rate speedup is a gated rate —
+        # a run-over-run drop means the tile-skip stopped paying (or
+        # silently densified), which raw iter/s never isolates
+        sparse = (bench[0].get("detail") or {}).get("sparse")
+        if isinstance(sparse, dict):
+            out["sparse"] = {
+                name: {
+                    "iter_speedup": sec.get("iter_speedup"),
+                    "tile_occupancy": sec.get("tile_occupancy"),
+                    "parity": sec.get("parity"),
+                }
+                for name, sec in sparse.items() if isinstance(sec, dict)
+            }
         # roofline section (bench.py + obs/roofline.py): the headline
         # config's achieved-vs-peak MXU and HBM-bandwidth fractions —
         # gated rates like the headline itself (a utilization drop is a
@@ -269,6 +283,13 @@ def _print_summary(path: str, summary: dict) -> None:
                 print(f"  tts {name}: {sec['iters_base']} -> "
                       f"{sec['iters_accel']} iters "
                       f"({sec['iter_speedup']:g}x, parity="
+                      f"{sec.get('parity')})")
+    if "sparse" in summary:
+        for name, sec in sorted(summary["sparse"].items()):
+            if sec.get("iter_speedup") is not None:
+                print(f"  sparse {name}: {sec['iter_speedup']:g}x iter/s "
+                      f"vs dense (occupancy "
+                      f"{sec.get('tile_occupancy')}, parity="
                       f"{sec.get('parity')})")
 
 
@@ -355,6 +376,23 @@ def diff(old: dict, new: dict) -> dict:
         name for name, sec in (new.get("tts") or {}).items()
         if isinstance(sec, dict) and sec.get("parity") is False
     )
+    # block-sparse occ50 iteration-rate speedup (bench detail.sparse,
+    # docs §10): a rate, gated like the bench value — a drop means the
+    # tile-skip stopped paying or silently densified
+    sparse_pct = None
+    a = ((old.get("sparse") or {}).get("occ50") or {}).get("iter_speedup")
+    b = ((new.get("sparse") or {}).get("occ50") or {}).get("iter_speedup")
+    if a and b and a > 0:
+        sparse_pct = 100.0 * (b / a - 1.0)
+        out["sparse"] = {"old": a, "new": b}
+    out["sparse_occ50_speedup_pct"] = sparse_pct
+    # sparse parity is a hard gate like tts parity: a solve that drifted
+    # from the dense reference is a correctness regression whatever the
+    # speedup says
+    out["sparse_parity_failed"] = sorted(
+        name for name, sec in (new.get("sparse") or {}).items()
+        if isinstance(sec, dict) and sec.get("parity") is False
+    )
     # solver-variant guard: run artifacts from different convergence
     # accelerators (os_subsets/momentum/logarithmic) are different
     # algorithms — their convergence-behavior and solve-ms gates are
@@ -416,7 +454,7 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
         notes.append(f"solver-variant meta missing from the {side} "
                      "artifact — variant comparability unknown")
     for section in ("bench", "straggler", "integrity", "roofline", "tts",
-                    "engine"):
+                    "sparse", "engine"):
         if (section in old) != (section in new):
             side = "baseline" if section in new else "new"
             notes.append(f"{section} section missing from the {side} "
@@ -443,6 +481,12 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
             a = (summ["tts"].get("log") or {}).get("iter_speedup")
             if not (a or 0) > 0:
                 notes.append(f"{side} tts log iteration speedup is zero/"
+                             "absent — its rate gate skipped")
+    if "sparse" in old and "sparse" in new:
+        for side, summ in (("baseline", old), ("new", new)):
+            a = (summ["sparse"].get("occ50") or {}).get("iter_speedup")
+            if not (a or 0) > 0:
+                notes.append(f"{side} sparse occ50 speedup is zero/"
                              "absent — its rate gate skipped")
     for section, key, label in zero_checks:
         if (section in old and section in new
@@ -547,6 +591,11 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['tts']['old']:g}x -> "
                       f"{delta['tts']['new']:g}x "
                       f"({delta['tts_log_speedup_pct']:+.1f}%)")
+            if delta["sparse_occ50_speedup_pct"] is not None:
+                print(f"  sparse occ50 iter/s speedup: "
+                      f"{delta['sparse']['old']:g}x -> "
+                      f"{delta['sparse']['new']:g}x "
+                      f"({delta['sparse_occ50_speedup_pct']:+.1f}%)")
             for key in ("mxu_util", "hbm_util"):
                 if delta[f"roofline_{key}_pct"] is not None:
                     d = delta["roofline"][key]
@@ -620,6 +669,21 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['tts_log_speedup_pct']:+.1f}% (iteration "
                       f"speedup) exceeds the {args.threshold:g}% "
                       "threshold.", file=sys.stderr)
+                return 2
+            if delta.get("sparse_parity_failed"):
+                print(f"sartsolve metrics: block-sparse parity FAILED "
+                      f"for {', '.join(delta['sparse_parity_failed'])} "
+                      "in the new artifact (bench sparse item).",
+                      file=sys.stderr)
+                return 2
+            if (delta["sparse_occ50_speedup_pct"] is not None
+                    and delta["sparse_occ50_speedup_pct"]
+                    < -args.threshold):
+                print(f"sartsolve metrics: block-sparse occ50 speedup "
+                      f"regression "
+                      f"{delta['sparse_occ50_speedup_pct']:+.1f}% "
+                      f"exceeds the {args.threshold:g}% threshold.",
+                      file=sys.stderr)
                 return 2
             for key in ("mxu_util", "hbm_util"):
                 pct = delta[f"roofline_{key}_pct"]

@@ -124,6 +124,7 @@ def read_and_quantize_rtm(
     *,
     chunk_rows: Optional[int] = None,
     ingest_stats=None,
+    tile_stats=None,
 ):
     """Two-pass chunked int8 ingest: ``(codes jax.Array, scale jax.Array)``.
 
@@ -198,7 +199,8 @@ def read_and_quantize_rtm(
     codes = read_and_shard_rtm(
         sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
         dtype="int8", chunk_rows=chunk, _quantize_chunk=quantize_chunk,
-        ingest_stats=ingest_stats, _stats_dequant=stats_dequant,
+        ingest_stats=ingest_stats, tile_stats=tile_stats,
+        _stats_dequant=stats_dequant,
         # share the pass-1 sparse cache: sparse segments are read once for
         # the whole two-pass ingest (dense hyperslabs still stream twice —
         # caching them would defeat the bounded-memory design)
@@ -211,6 +213,68 @@ def read_and_quantize_rtm(
         P(VOXEL_AXIS if VOXEL_AXIS in mesh.shape else None),
     )
     return codes, scale
+
+
+def make_tile_stats(npixel: int, nvoxel: int, mesh):
+    """A :class:`~sartsolver_tpu.ops.sparse.TileMaxStats` accumulator
+    sized for THIS mesh's padded RTM grid — the ingest half of the
+    block-sparse path (docs/PERFORMANCE.md §10). Thread it through
+    :func:`read_and_shard_rtm`/:func:`read_and_quantize_rtm` as
+    ``tile_stats=`` and cut it into an index afterwards
+    (``stats.occupancy(eps)``); the padding rows/columns never receive a
+    value, so padded panels are born unoccupied and the sparse sweep
+    skips them for free. Single-process only (a pod's processes each see
+    only their own rows/columns; the sparse 'auto' mode declines there)."""
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.ops.sparse import TileMaxStats
+
+    if jax.process_count() > 1:
+        raise SartInputError(
+            "The ingest tile-occupancy pass is single-process: each "
+            "process of a pod sees only its own stripes, so a global "
+            "index cannot be accumulated host-side. Use sparse_rtm="
+            "'off' (or 'auto', which declines) on multi-process runs."
+        )
+    n_pix = mesh.shape.get(PIXEL_AXIS, 1)
+    n_vox = mesh.shape.get(VOXEL_AXIS, 1)
+    return TileMaxStats(
+        padded_size(npixel, n_pix * ROW_ALIGN),
+        padded_size(nvoxel, n_vox * COL_ALIGN),
+    )
+
+
+def sparse_tile_stats_or_decline(opts, mesh, npixel: int, nvoxel: int,
+                                 n_vox: int):
+    """The drivers' shared block-sparse ingest gate: the one definition
+    of 'build the index, decline quietly, or refuse loudly' consumed by
+    BOTH the one-shot CLI and the serving engine (they must never
+    disagree). Returns a :class:`~sartsolver_tpu.ops.sparse.TileMaxStats`
+    to feed through the chunked read, or None when sparse mode is off /
+    statically declined ('auto' — with a stderr warning) / the mesh
+    voxel-shards (the solver ctor owns that refusal). An explicit
+    numeric threshold raises ``SartInputError`` with the actual reason
+    instead of letting a downstream gate refuse for the wrong one."""
+    import sys
+
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.ops.sparse import static_decline_reason
+
+    if opts.sparse_epsilon() is None:
+        return None
+    reason = static_decline_reason(opts, jax.process_count())
+    if reason is not None:
+        if opts.sparse_explicit():
+            raise SartInputError(
+                f"Argument sparse_rtm={opts.sparse_rtm}: {reason}."
+            )
+        print(
+            f"Warning: sparse_rtm declines here ({reason}); running "
+            "dense.", file=sys.stderr,
+        )
+        return None
+    if n_vox != 1:
+        return None
+    return make_tile_stats(npixel, nvoxel, mesh)
 
 
 def _read_stripe_retried(
@@ -289,6 +353,7 @@ def read_and_shard_rtm(
     serialize: bool = False,
     chunk_rows: Optional[int] = None,
     ingest_stats=None,
+    tile_stats=None,
     _quantize_chunk=None,
     _sparse_cache: Optional[dict] = None,
     _stats_dequant=None,
@@ -321,6 +386,13 @@ def read_and_shard_rtm(
     host-side rho/lambda the post-upload verification compares against
     (``DistributedSARTSolver.verify_ray_stats``). Single-process only
     (each process sees only its own rows/columns of a pod's matrix).
+
+    ``tile_stats`` (block-sparse layer, docs/PERFORMANCE.md §10): a
+    :func:`make_tile_stats` accumulator fed the same storage-rounded
+    pieces, folded into this pass — the tile-occupancy index costs no
+    extra read, rides the same double-read/CRC32-verified stripes the
+    integrity layer checks, and covers the packed representation
+    (quantized codes, not the pre-quantization floats).
     """
     n_pix = mesh.shape.get(PIXEL_AXIS, 1)
     n_vox = mesh.shape.get(VOXEL_AXIS, 1)
@@ -446,11 +518,18 @@ def read_and_shard_rtm(
                             piece[:n, :cols_have] = (
                                 _quantize_chunk(sl, c0) if _quantize_chunk else sl
                             )
-                            if ingest_stats is not None and n > 0:
+                            if (ingest_stats is not None
+                                    or tile_stats is not None) and n > 0:
                                 from sartsolver_tpu.resilience import (
                                     integrity as _integ,
                                 )
 
+                                # one storage-rounded view feeds BOTH
+                                # accumulators: the integrity rho/lambda
+                                # sums and the block-sparse tile-occupancy
+                                # pass index exactly the packed
+                                # representation the device will hold
+                                # (int8: dequantized codes, bf16: rounded)
                                 block = piece[:n, :cols_have]
                                 if _stats_dequant is not None:
                                     vals = _stats_dequant(block, c0)
@@ -458,7 +537,10 @@ def read_and_shard_rtm(
                                     vals = _integ.storage_round(
                                         block, jdtype
                                     )
-                                ingest_stats.add(vals, r0 + cs, c0)
+                                if ingest_stats is not None:
+                                    ingest_stats.add(vals, r0 + cs, c0)
+                                if tile_stats is not None:
+                                    tile_stats.add(vals, r0 + cs, c0)
                         bufs[j] = _scatter(
                             bufs[j], jax.device_put(piece, dev),
                             np.int32(cs),

@@ -290,6 +290,7 @@ class DistributedSARTSolver:
         npixel: Optional[int] = None,
         nvoxel: Optional[int] = None,
         rtm_scale=None,
+        tile_occupancy=None,
     ):
         """``rtm`` is either a host ``np.ndarray`` (padded, cast and
         device_put here — single-host path) or an already-sharded global
@@ -300,7 +301,18 @@ class DistributedSARTSolver:
         ``multihost.read_and_quantize_rtm`` may be passed together with its
         ``rtm_scale``; otherwise the matrix is staged fp32 and quantized on
         device here (a 5-bytes/element transient — use the two-pass ingest
-        when the matrix only fits as int8)."""
+        when the matrix only fits as int8).
+
+        ``tile_occupancy`` (``opts.sparse_rtm`` active): the RTM's
+        tile-occupancy index, built by the chunked ingest
+        (``multihost.make_tile_stats`` fed through
+        ``read_and_shard_rtm``). Host-staged matrices may omit it — the
+        index is built (and, for a nonzero threshold, the dropped tiles
+        zeroed) from the padded host buffer here, BEFORE the ray stats,
+        so rho/lambda and the Eq. 6 masks always describe the thresholded
+        operator the sweeps multiply by. ``sparse_rtm='auto'`` declines
+        quietly on voxel-sharded meshes and index-less pre-sharded
+        matrices; an explicit numeric threshold raises."""
         self.opts = opts
         self.mesh = mesh if mesh is not None else make_mesh()
         if PIXEL_AXIS not in self.mesh.shape or VOXEL_AXIS not in self.mesh.shape:
@@ -383,6 +395,39 @@ class DistributedSARTSolver:
         self.padded_nvoxel = target_cols
         self.voxel_block = target_cols // self.n_voxel_shards
 
+        # Block-sparse RTM mode (docs/PERFORMANCE.md §10): resolve whether
+        # THIS driver can carry a tile-occupancy index at all. The sparse
+        # panel sweep's skip predicate must be SPMD-uniform, which a
+        # voxel-sharded mesh breaks (each shard's local panels map to
+        # different global panels), and a pre-sharded matrix has no host
+        # bytes to index unless the ingest built the index (the padding
+        # tiles of the padded grid are zero, so padded panels skip free).
+        sparse_eps = opts.sparse_epsilon()
+        self._tile_occupancy = None
+        if sparse_eps is not None:
+            from sartsolver_tpu.config import SartInputError
+
+            reason = None
+            if self.n_voxel_shards > 1:
+                reason = (
+                    "the mesh shards the voxel axis; the block-sparse "
+                    "panel skip is not SPMD-uniform there — use a "
+                    "pixel-major mesh (--voxel_shards 1) or dense storage"
+                )
+            elif presharded and tile_occupancy is None:
+                reason = (
+                    "the RTM is pre-sharded and no ingest-built "
+                    "tile-occupancy index was supplied (thread "
+                    "multihost.make_tile_stats through the chunked read)"
+                )
+            if reason is not None:
+                if opts.sparse_explicit():
+                    # reachable from CLI flags -> polite exit(1) contract
+                    raise SartInputError(
+                        f"Argument sparse_rtm={opts.sparse_rtm}: {reason}."
+                    )
+                sparse_eps = None  # auto declines; the dense paths run
+
         if presharded:
             if rtm.shape != (target_rows, target_cols):
                 raise ValueError(
@@ -391,15 +436,82 @@ class DistributedSARTSolver:
                     f"{self.npixel}x{self.nvoxel} on this mesh."
                 )
             rtm_dev = rtm if rtm.dtype == rtm_dtype else rtm.astype(rtm_dtype)
+            if sparse_eps is not None:
+                tile_occupancy.verify()
+                self._tile_occupancy = tile_occupancy
+                if sparse_eps > 0 and not tile_occupancy.mask.all():
+                    # nonzero threshold on an ingest-staged matrix: zero
+                    # the dropped tiles ON DEVICE (donated, sharding
+                    # preserved) before the ray stats are computed, so
+                    # the solve is self-consistent with what the sweeps
+                    # multiply by — the host never holds the matrix here
+                    from sartsolver_tpu.parallel.multihost import (
+                        make_global,
+                    )
+
+                    occ = tile_occupancy
+                    tr, tc = occ.tile_rows, occ.tile_cols
+                    tm = make_global(occ.mask, self.mesh, P())
+
+                    def _apply_tile_mask(m, keep):
+                        # blocked-reshape + broadcast select: fusible,
+                        # never materializes a matrix-sized mask (the
+                        # padded shape is whole tiles by construction)
+                        gr, gc = keep.shape
+                        blocked = jnp.where(
+                            keep[:, None, :, None],
+                            m.reshape(gr, tr, gc, tc),
+                            jnp.zeros((), m.dtype),
+                        )
+                        return blocked.reshape(gr * tr, gc * tc)
+
+                    rtm_dev = jax.jit(
+                        _apply_tile_mask, donate_argnums=0,
+                        out_shardings=NamedSharding(
+                            self.mesh, P(PIXEL_AXIS, VOXEL_AXIS)
+                        ),
+                    )(rtm_dev, tm)
         else:
             # Single-copy staging: the RTM is the dominant host allocation
             # (the reference targets tens-to-hundreds of GB), so pad+cast in
             # one buffer, and skip the copy when layout already matches.
             rtm_np = np.asarray(rtm)
-            if (target_rows, target_cols) != rtm_np.shape or rtm_np.dtype != np.dtype(rtm_dtype):
+            owns_buf = (
+                (target_rows, target_cols) != rtm_np.shape
+                or rtm_np.dtype != np.dtype(rtm_dtype)
+            )
+            if owns_buf:
                 buf = np.zeros((target_rows, target_cols), dtype=np.dtype(rtm_dtype))
                 buf[: self.npixel, : self.nvoxel] = rtm_np
                 rtm_np = buf
+            if sparse_eps is not None:
+                # ingest-time occupancy pass over the PADDED storage-dtype
+                # buffer (the packed representation the device will hold);
+                # a nonzero threshold zeroes the dropped tiles BEFORE
+                # staging, so the ray stats below describe the thresholded
+                # operator (Eq. 6 self-consistency)
+                from sartsolver_tpu.ops.sparse import (
+                    TileMaxStats,
+                    accumulate_tile_max,
+                    threshold_matrix,
+                )
+
+                occ = tile_occupancy
+                if occ is None:
+                    # banded accumulation: no matrix-sized fp32
+                    # transient on the path whose dominant allocation
+                    # is the matrix itself
+                    occ = accumulate_tile_max(
+                        TileMaxStats(*rtm_np.shape), rtm_np
+                    ).occupancy(sparse_eps)
+                occ.verify()
+                if sparse_eps > 0:
+                    # in place when we own the padded staging buffer —
+                    # the RTM is the dominant host allocation, so the
+                    # threshold pass must not add a matrix-sized copy
+                    rtm_np = threshold_matrix(rtm_np, occ,
+                                              inplace=owns_buf)
+                self._tile_occupancy = occ
             rtm_dev = jax.device_put(
                 rtm_np, NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
             )
@@ -499,6 +611,14 @@ class DistributedSARTSolver:
         self.problem = SARTProblem(
             rtm_dev, ray_density, ray_length, laplacian, rtm_scale
         )
+        if self._tile_occupancy is not None:
+            # run-artifact provenance: the resident operator's occupancy
+            # (the sweeps additionally record their per-compile skip plan)
+            from sartsolver_tpu.obs import metrics as _obs_metrics
+
+            _obs_metrics.get_registry().gauge("rtm_tile_occupancy").set(
+                self._tile_occupancy.occupancy_fraction()
+            )
         self._solve_fns = {}
         # Integrity layer (docs/RESILIENCE.md §8): keep the stats program
         # and an upload-time host snapshot of rho/lambda so the resident
@@ -733,6 +853,7 @@ class DistributedSARTSolver:
                     use_guess=use_guess,
                     fitted0=fitted0[0] if with_fitted0 else None,
                     return_fitted=True, _vmem_raised=vmem_raised,
+                    tile_occupancy=self._tile_occupancy,
                 )
 
             fn = shard_map(
@@ -782,6 +903,7 @@ class DistributedSARTSolver:
                     use_guess_first=use_guess_first,
                     fitted0=fitted0[0] if with_fitted0 else None,
                     _vmem_raised=vmem_raised,
+                    tile_occupancy=self._tile_occupancy,
                 )
 
             fn = shard_map(
@@ -1195,6 +1317,7 @@ class DistributedSARTSolver:
                     msq_new, refill,
                     opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
                     use_guess=True, _vmem_raised=vmem_raised,
+                    tile_occupancy=self._tile_occupancy,
                 )
 
             state_spec = self._sched_state_spec()
@@ -1425,12 +1548,14 @@ _AUDIT_PANEL_VOXELS = 256
 _AUDIT_PANELS = _AUDIT_V // _AUDIT_PANEL_VOXELS
 
 
-def _audit_sharded_lowering(opts: SolverOptions):
+def _audit_sharded_lowering(opts: SolverOptions, H=None):
     """Shared fixture: lower the batched solve step of a 2x1 pixel-sharded
     mesh under the given options (the unfused and fused-panel entries
-    differ only in their SolverOptions)."""
-    rng = np.random.default_rng(7)
-    H = rng.random((_AUDIT_P, _AUDIT_V)).astype(np.float32)
+    differ only in their SolverOptions; the sparse entry additionally
+    supplies a half-empty matrix)."""
+    if H is None:
+        rng = np.random.default_rng(7)
+        H = rng.random((_AUDIT_P, _AUDIT_V)).astype(np.float32)
     solver = DistributedSARTSolver(
         H, opts=opts, mesh=make_mesh(_AUDIT_SHARDS, 1)
     )
@@ -1554,3 +1679,40 @@ def _audit_sched_step():
         jnp.ones(2, jnp.float32),
         jnp.asarray(np.asarray([True, False])),
     )
+
+
+# 50% panel occupancy on the sparse entries' shared fixture: the first
+# half of the voxel extent carries data, the second half is exactly zero
+# — 2 of 4 256-wide panels occupied at eps=0 (lossless).
+_AUDIT_SPARSE_PANELS_OCCUPIED = 2
+
+
+@_register_audit_entry(
+    "sharded_sparse_panel_sweep",
+    description=f"pixel-sharded BLOCK-SPARSE panel-scan solve step "
+                f"({_AUDIT_SHARDS}x1 mesh, fp32, {_AUDIT_PANELS} panels, "
+                f"{_AUDIT_SPARSE_PANELS_OCCUPIED} occupied): one psum per "
+                "OCCUPIED panel — the cost golden pins FLOPs/bytes "
+                "scaling with occupancy, and the collective budget pins "
+                "that skipped panels skip their psum too",
+    loop_copy_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    loop_convert_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    # one back-projection psum PER OCCUPIED PANEL plus the convergence-
+    # metric psum; a silent densification would issue _AUDIT_PANELS + 1
+    # and fail this budget before it even reaches the cost band
+    loop_collective_budget={
+        "all-reduce": _AUDIT_SPARSE_PANELS_OCCUPIED + 1, "all-gather": 0,
+        "all-to-all": 0, "collective-permute": 0,
+    },
+    min_devices=_AUDIT_SHARDS,
+    # densification must trip the band (see sparse_panel_sweep)
+    cost_rtol=0.25,
+)
+def _audit_sharded_sparse_panel_sweep():
+    rng = np.random.default_rng(7)
+    H = rng.random((_AUDIT_P, _AUDIT_V)).astype(np.float32)
+    H[:, _AUDIT_SPARSE_PANELS_OCCUPIED * _AUDIT_PANEL_VOXELS:] = 0.0
+    return _audit_sharded_lowering(SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
+        sparse_rtm="auto", fused_panel_voxels=_AUDIT_PANEL_VOXELS,
+    ), H=H)

@@ -121,6 +121,38 @@ RULE_FIXTURES = {
                 return None
         """,
     ),
+    "SL007": (
+        # TP: a dense matmul against the RTM outside the operator layer
+        # bypasses the block-sparse tile-skip and fused-sweep dispatch
+        """
+        def fit(problem, f):
+            return jnp.matmul(problem.rtm, f)
+
+        def bp(rtm, w):
+            return w @ rtm
+        """,
+        # near miss: the same products routed through the operator layer,
+        # a matmul on non-RTM operands, and a contraction against an
+        # rtm-prefixed METADATA vector (the int8 scale is not the matrix)
+        """
+        from sartsolver_tpu.ops.projection import back_project, forward_project
+
+        def fit(problem, f):
+            return forward_project(problem.rtm, f)
+
+        def bp(rtm, w):
+            return back_project(rtm, w)
+
+        def unrelated(a, b):
+            return a @ b
+
+        def rescale(w, rtm_scale):
+            return jnp.dot(w, rtm_scale)
+
+        def residual(rtm, w, basis):
+            return back_project(rtm, w) @ basis
+        """,
+    ),
     # ---- concurrency family (docs/STATIC_ANALYSIS.md SL1xx) -------------
     "SL101": (
         # TP: attribute declared guarded accessed without the lock

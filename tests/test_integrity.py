@@ -589,9 +589,13 @@ def test_cli_resume_refuses_corrupt_row(world, capsys):
 
 
 def test_prepare_measurement_counts_nonfinite_pixels():
+    from sartsolver_tpu.models.sart import reset_nonfinite_warning
     from sartsolver_tpu.obs import metrics as obs_metrics
 
     registry = obs_metrics.reset_registry()
+    # the warning is once-per-RUN now (not once-per-location like the
+    # old Python-dedup behavior); start this test's "run" fresh
+    reset_nonfinite_warning()
     opts = SolverOptions()
     g = np.ones(16)
     g[3] = np.nan

@@ -1,0 +1,784 @@
+"""Block-sparse RTM drill matrix (docs/PERFORMANCE.md §10, `make sparse`).
+
+Parity gates, in decreasing strictness:
+
+- **Sweep-level bit parity** — skipping an all-zero voxel panel is
+  bit-neutral: the sparse panel sweep with the real occupancy index is
+  ``array_equal`` to the same sweep with a full (dense-equivalent)
+  index across multi-iteration compositions, every update closure, and
+  the gather fallback. This is the "skipping changes nothing" proof the
+  eps=0 mode rests on.
+- **Solver-level parity** — end-to-end solves against the classic dense
+  paths agree in iteration counts/statuses exactly and in values to the
+  reassociation tolerance (``utils.fused_parity.PARITY_RTOL`` — XLA may
+  regroup the dense comparator's reductions differently, the same bound
+  the fused-vs-unfused gate uses), across linear/log/int8 x meshes x
+  os_subsets/momentum.
+- **eps > 0** — the thresholded solve is residual-matched: it fits the
+  measurement about as well as dense while the dropped tiles' voxels
+  mask out via the Eq. 6 stats of the thresholded operator.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sartsolver_tpu.config import SolverOptions
+from sartsolver_tpu.models.sart import (
+    FUSED_ENGAGEMENT,
+    make_problem,
+    make_sparse_problem,
+    solve_normalized_batch,
+)
+from sartsolver_tpu.ops.fused_sweep import (
+    sparse_gather_sweep,
+    sparse_panel_sweep,
+)
+from sartsolver_tpu.ops.sparse import (
+    TILE_COLS,
+    TILE_ROWS,
+    TileMaxStats,
+    TileOccupancy,
+    build_tile_occupancy,
+    threshold_matrix,
+)
+from sartsolver_tpu.utils.fused_parity import PARITY_RTOL
+
+P, V, BS = 32, 512, 128  # 4 voxel panels of 128; panels 1 and 3 empty
+
+
+def _world(seed=0, empty_panels=(1, 3)):
+    rng = np.random.default_rng(seed)
+    H = (rng.random((P, V), dtype=np.float32) * 0.9 + 0.1)
+    for j in empty_panels:
+        H[:, j * BS:(j + 1) * BS] = 0.0
+    f_true = rng.random(V).astype(np.float32) + 0.5
+    G = (H.astype(np.float64) @ f_true.astype(np.float64))[None, :]
+    norm = G.max()
+    msq = float(np.sum(np.where(G > 0, G, 0.0) ** 2) / norm ** 2)
+    g = (G / norm).astype(np.float32)
+    return H, g, msq
+
+
+def _solve(H, g, msq, opts, tile_occupancy=None, B=1, axis_name=None):
+    if opts.sparse_epsilon() is not None and tile_occupancy is None:
+        problem, tile_occupancy = make_sparse_problem(H, opts=opts)
+    else:
+        problem = make_problem(H, opts=opts)
+    gd = jnp.asarray(np.broadcast_to(g, (B, g.shape[1])).copy())
+    msqd = jnp.full((B,), msq, jnp.float32)
+    f0 = jnp.zeros((B, H.shape[1]), jnp.float32)
+    return solve_normalized_batch(
+        problem, gd, msqd, f0, opts=opts, axis_name=axis_name,
+        voxel_axis=None, use_guess=True, tile_occupancy=tile_occupancy,
+    )
+
+
+# --------------------------------------------------------------------------
+# tile-occupancy index units
+# --------------------------------------------------------------------------
+
+
+def test_occupancy_build_and_queries():
+    H, _, _ = _world()
+    occ = build_tile_occupancy(H)
+    assert occ.grid_shape == (P // TILE_ROWS, V // TILE_COLS)
+    assert occ.occupancy_fraction() == pytest.approx(0.5)
+    np.testing.assert_array_equal(
+        occ.col_panel_occupied(BS), [True, False, True, False]
+    )
+    # coarser panels: a panel is occupied if ANY covered tile is
+    np.testing.assert_array_equal(
+        occ.col_panel_occupied(2 * BS), [True, True]
+    )
+    occ.verify()  # round trip through its own digest
+
+
+def test_occupancy_digest_guards_the_packed_bits():
+    H, _, _ = _world()
+    occ = build_tile_occupancy(H)
+    payload = occ.to_payload()
+    assert TileOccupancy.from_payload(payload) == occ
+    # a flipped bit in the packed grid must fail the digest, not
+    # silently skip (or densify) tiles
+    tampered = dict(payload)
+    raw = bytearray(bytes.fromhex(tampered["packed_hex"]))
+    raw[0] ^= 0x80
+    tampered["packed_hex"] = bytes(raw).hex()
+    with pytest.raises(ValueError, match="digest"):
+        TileOccupancy.from_payload(tampered)
+
+
+def test_chunked_tile_stats_match_one_shot_and_are_idempotent():
+    H, _, _ = _world(seed=3)
+    one_shot = build_tile_occupancy(H, epsilon=0.01)
+    stats = TileMaxStats(P, V)
+    rng = np.random.default_rng(7)
+    # arbitrary, unaligned, OVERLAPPING chunk windows (double reads are
+    # free: max-accumulation is idempotent)
+    for _ in range(40):
+        r0, c0 = int(rng.integers(0, P - 1)), int(rng.integers(0, V - 1))
+        h = int(rng.integers(1, P - r0 + 1))
+        w = int(rng.integers(1, V - c0 + 1))
+        stats.add(H[r0:r0 + h, c0:c0 + w], r0, c0)
+    stats.add(H, 0, 0)  # ensure full coverage
+    stats.add(H, 0, 0)  # and a verbatim double read
+    assert stats.occupancy(0.01) == one_shot
+
+
+def test_nan_poisoned_matrix_refuses_an_index():
+    """One non-finite RTM entry must fail the occupancy build loudly —
+    a NaN threshold would compare False against every tile and the
+    sparse solve would silently skip the whole matrix."""
+    H, _, _ = _world()
+    H = H.copy()
+    H[3, 7] = np.nan
+    with pytest.raises(ValueError, match="non-finite"):
+        build_tile_occupancy(H)
+
+
+def test_threshold_matrix_zeroes_dropped_tiles_only():
+    H, _, _ = _world(seed=5)
+    # bury sub-threshold noise in panel 1 (otherwise empty)
+    H[:, BS:2 * BS] = 1e-6
+    occ = build_tile_occupancy(H, epsilon=1e-3)
+    assert occ.occupancy_fraction() == pytest.approx(0.5)
+    Ht = threshold_matrix(H, occ)
+    assert np.all(Ht[:, BS:2 * BS] == 0)
+    np.testing.assert_array_equal(Ht[:, :BS], H[:, :BS])
+    # a fully-occupied index drops nothing: the same object comes back
+    full = TileOccupancy.from_mask(
+        np.ones(build_tile_occupancy(H).grid_shape, bool), rows=P, cols=V
+    )
+    assert threshold_matrix(H, full) is H
+
+
+def test_ingest_round_trip_through_block_reader(tmp_path):
+    """The occupancy accumulated by the chunked HDF5 reader equals the
+    one-shot index of the assembled matrix — through the fixture world's
+    multi-camera, multi-segment (dense + sparse-COO) layout."""
+    from fixtures import NPIXEL, NVOXEL, write_world
+
+    from sartsolver_tpu.io.raytransfer import read_rtm_block
+
+    paths, H, *_ = write_world(tmp_path)
+    files = {"camA": [paths["rtm_a1"], paths["rtm_a2"]],
+             "camB": [paths["rtm_b"]]}
+    stats = TileMaxStats(NPIXEL, NVOXEL)
+    for r0 in range(0, NPIXEL, 3):  # deliberately unaligned chunks
+        n = min(3, NPIXEL - r0)
+        read_rtm_block(files, "with_reflections", n, NVOXEL, r0,
+                       tile_stats=stats)
+    assert stats.occupancy(0.0) == build_tile_occupancy(
+        H.astype(np.float32)
+    )
+
+
+def test_ingest_tile_stats_ride_the_striped_shard_read():
+    """multihost.make_tile_stats fed through read_and_shard_rtm covers
+    the PADDED grid (padding panels born unoccupied) and matches the
+    host-built index of the padded matrix."""
+    from fixtures import NPIXEL, NVOXEL
+
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.multihost import (
+        make_tile_stats,
+        read_and_shard_rtm,
+    )
+
+    pytest.importorskip("h5py")
+    import tempfile
+
+    from fixtures import write_world
+
+    with tempfile.TemporaryDirectory() as d:
+        paths, H, *_ = write_world(d)
+        files = {"camA": [paths["rtm_a1"], paths["rtm_a2"]],
+                 "camB": [paths["rtm_b"]]}
+        mesh = make_mesh(1, 1)
+        stats = make_tile_stats(NPIXEL, NVOXEL, mesh)
+        rtm = read_and_shard_rtm(
+            files, "with_reflections", NPIXEL, NVOXEL, mesh,
+            dtype="float32", tile_stats=stats,
+        )
+        occ = stats.occupancy(0.0)
+        padded = np.zeros((stats.rows, stats.cols), np.float32)
+        padded[:NPIXEL, :NVOXEL] = H
+        assert occ == build_tile_occupancy(padded)
+        np.testing.assert_array_equal(
+            np.asarray(rtm)[:NPIXEL, :NVOXEL], H.astype(np.float32)
+        )
+
+
+# --------------------------------------------------------------------------
+# sweep-level bit parity: skipping an all-zero panel is bit-neutral
+# --------------------------------------------------------------------------
+
+
+def _compose_sweeps(sweep_fn, rtm, w0, f0, aux, update_fn, n=3):
+    """n chained sweeps (the while-loop shape), executed op-by-op: each
+    primitive compiles standalone, so both variants run IDENTICAL
+    kernels on identical inputs and the comparison pins the math-level
+    bit-neutrality of the skip (one whole-program jit instead would let
+    XLA fuse the two differently-shaped programs differently and
+    reassociate reductions — that end-to-end reassociation bound is the
+    solver-level drill's PARITY_RTOL gate)."""
+    f, w, fitted = f0, w0, None
+    for _ in range(n):
+        f, fitted = sweep_fn(rtm, w, f, aux, update_fn)
+        w = (1.0 - fitted) * 0.25
+    return f, fitted
+
+
+@pytest.mark.parametrize("closure", ["linear", "log"])
+@pytest.mark.parametrize("host", ["static", "gather"])
+def test_sparse_sweep_bit_identical_to_dense_equivalent(closure, host):
+    import functools
+
+    H, _, _ = _world(seed=1)
+    occ = build_tile_occupancy(H)
+    full = TileOccupancy.from_mask(
+        np.ones(occ.grid_shape, bool), rows=P, cols=V
+    )
+    rng = np.random.default_rng(2)
+    w0 = jnp.asarray(rng.standard_normal((1, P)).astype(np.float32))
+    f0 = jnp.asarray(rng.random((1, V), np.float32) + 0.5)
+    if closure == "linear":
+        invd = jnp.asarray(rng.random((1, V), np.float32))
+        aux = [invd]
+        update_fn = lambda f, bp, invd_p: jnp.maximum(f + invd_p * bp, 0)
+    else:
+        obs = jnp.asarray(rng.random((1, V), np.float32))
+        aux = [obs]
+        update_fn = lambda f, bp, obs_p: f * (
+            (obs_p + 1e-7) / (bp + 1e-7)
+        )
+
+    def host_fn(o):
+        if host == "static":
+            return functools.partial(
+                sparse_panel_sweep, occupancy=o, panel_voxels=BS
+            )
+        ids = jnp.asarray(
+            np.nonzero(o.col_panel_occupied(BS))[0].astype(np.int32)
+        )
+        return functools.partial(
+            sparse_gather_sweep, panel_ids=ids, panel_voxels=BS
+        )
+
+    Hd = jnp.asarray(H)
+    a_f, a_fit = _compose_sweeps(host_fn(occ), Hd, w0, f0, aux, update_fn)
+    b_f, b_fit = _compose_sweeps(host_fn(full), Hd, w0, f0, aux, update_fn)
+    np.testing.assert_array_equal(np.asarray(a_f), np.asarray(b_f))
+    np.testing.assert_array_equal(np.asarray(a_fit), np.asarray(b_fit))
+
+
+def test_gather_sweep_bit_identical_to_static_skip():
+    H, _, _ = _world(seed=4)
+    occ = build_tile_occupancy(H)
+    rng = np.random.default_rng(5)
+    w0 = jnp.asarray(rng.standard_normal((2, P)).astype(np.float32))
+    f0 = jnp.asarray(rng.random((2, V), np.float32))
+    invd = jnp.asarray(rng.random((1, V), np.float32))
+    upd = lambda f, bp, invd_p: jnp.maximum(f + invd_p * bp, 0)
+    ids = jnp.asarray(
+        np.nonzero(occ.col_panel_occupied(BS))[0].astype(np.int32)
+    )
+    a = jax.jit(lambda r, w, f: sparse_panel_sweep(
+        r, w, f, [invd], upd, occupancy=occ, panel_voxels=BS
+    ))(jnp.asarray(H), w0, f0)
+    b = jax.jit(lambda r, w, f: sparse_gather_sweep(
+        r, w, f, [invd], upd, panel_ids=ids, panel_voxels=BS
+    ))(jnp.asarray(H), w0, f0)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_sparse_sweep_int8_panelwise_dequant():
+    """int8 storage through the sparse panel sweep: codes dequantize per
+    panel, scales ride as aux 0 (fwd_scale) — parity vs the dense-
+    equivalent full index is bitwise, like fp32."""
+    from sartsolver_tpu.models.sart import quantize_rtm
+
+    H, _, _ = _world(seed=6)
+    codes, scale = jax.jit(quantize_rtm)(jnp.asarray(H))
+    occ = build_tile_occupancy(np.asarray(codes))
+    full = TileOccupancy.from_mask(
+        np.ones(occ.grid_shape, bool), rows=P, cols=V
+    )
+    rng = np.random.default_rng(7)
+    w0 = jnp.asarray(rng.standard_normal((1, P)).astype(np.float32))
+    f0 = jnp.asarray(rng.random((1, V), np.float32))
+    sc = scale[None, :]
+    upd = lambda f, bp, s_p, invd_p: jnp.maximum(f + invd_p * bp * s_p, 0)
+    invd = jnp.asarray(rng.random((1, V), np.float32))
+
+    def run(o):
+        # op-by-op for bitwise comparability (see _compose_sweeps)
+        return sparse_panel_sweep(
+            codes, w0, f0, [sc, invd], upd, occupancy=o, panel_voxels=BS,
+            fwd_scale=0,
+        )
+
+    for x, y in zip(run(occ), run(full)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# --------------------------------------------------------------------------
+# solver-level drill matrix (eps = 0)
+# --------------------------------------------------------------------------
+
+VARIANTS = {
+    "linear": {},
+    "log": dict(logarithmic=True),
+    "os4": dict(os_subsets=4),
+    "momentum": dict(momentum="nesterov"),
+    "os4_log_momentum": dict(os_subsets=4, logarithmic=True,
+                             momentum="nesterov"),
+    "int8": dict(rtm_dtype="int8"),
+    "int8_os4": dict(rtm_dtype="int8", os_subsets=4),
+    "decay": dict(relaxation_decay=0.95),
+    "integrity": dict(integrity=True),
+    "recovery": dict(divergence_recovery=2),
+}
+
+
+def _assert_parity(res_s, res_d, label):
+    a = np.asarray(res_s.solution)
+    c = np.asarray(res_d.solution)
+    scale = max(float(np.max(np.abs(c))), 1.0)
+    d = float(np.max(np.abs(a - c)))
+    assert d <= PARITY_RTOL * scale, (label, d, scale)
+    np.testing.assert_array_equal(
+        np.asarray(res_s.iterations), np.asarray(res_d.iterations)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(res_s.status), np.asarray(res_d.status)
+    )
+
+
+@pytest.mark.parametrize("variant", sorted(VARIANTS))
+def test_eps0_solver_parity_vs_dense(variant):
+    kw = VARIANTS[variant]
+    H, g, msq = _world()
+    opts_s = SolverOptions(
+        max_iterations=25, conv_tolerance=0.0, sparse_rtm="auto",
+        fused_panel_voxels=BS, **kw,
+    )
+    dkw = dict(kw)
+    if kw.get("rtm_dtype") == "int8" and kw.get("os_subsets", 1) == 1:
+        dkw["fused_sweep"] = "interpret"  # the dense int8 comparator
+    opts_d = SolverOptions(max_iterations=25, conv_tolerance=0.0, **dkw)
+    res_s = _solve(H, g, msq, opts_s, B=2)
+    engaged = FUSED_ENGAGEMENT["last"]
+    assert engaged in ("sparse-panel", "os-subset-sparse"), (variant,
+                                                            engaged)
+    res_d = _solve(H, g, msq, opts_d, B=2)
+    _assert_parity(res_s, res_d, variant)
+
+
+def _raw_frames(H, n=1, seed=21):
+    rng = np.random.default_rng(seed)
+    f_true = rng.random(H.shape[1]) + 0.5
+    return [
+        H.astype(np.float64) @ (f_true * (1.0 + 0.1 * k))
+        for k in range(n)
+    ]
+
+
+@pytest.mark.parametrize("mesh_shape", [(2, 1), (4, 1)])
+def test_eps0_parity_on_pixel_sharded_meshes(mesh_shape):
+    """(N, 1) meshes: the sparse panel sweep psums occupied panels only;
+    results match the dense sharded solver at the reassociation bound."""
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    if len(jax.devices()) < mesh_shape[0]:
+        pytest.skip(f"needs {mesh_shape[0]} devices")
+    H, _, _ = _world()
+    meas = np.stack(_raw_frames(H, 1))
+    sols = {}
+    for mode in ("auto", "off"):
+        opts = SolverOptions(
+            max_iterations=25, conv_tolerance=0.0, sparse_rtm=mode,
+            fused_panel_voxels=BS if mode == "auto" else None,
+            fused_sweep="off" if mode == "off" else "auto",
+        )
+        solver = DistributedSARTSolver(
+            H, opts=opts, mesh=make_mesh(*mesh_shape)
+        )
+        if mode == "auto":
+            assert solver._tile_occupancy is not None
+        res = solver.solve_batch(meas)
+        sols[mode] = np.asarray(res.solution)[0]
+        if mode == "auto":
+            assert FUSED_ENGAGEMENT["last"] == "sparse-panel"
+        solver.close()
+    scale = max(float(np.max(np.abs(sols["off"]))), 1.0)
+    d = float(np.max(np.abs(sols["auto"] - sols["off"])))
+    assert d <= PARITY_RTOL * scale, (d, scale)
+
+
+def test_sparse_auto_declines_on_voxel_sharded_mesh():
+    """2-D / voxel-sharded meshes: the static panel skip is not SPMD-
+    uniform, so 'auto' declines (dense paths, parity trivially) and an
+    explicit threshold refuses loudly."""
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    H, _, _ = _world()
+    meas = np.stack(_raw_frames(H, 1))
+    opts = SolverOptions(max_iterations=10, conv_tolerance=0.0,
+                         sparse_rtm="auto")
+    solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(1, 2))
+    assert solver._tile_occupancy is None
+    res = solver.solve_batch(meas)
+    assert np.isfinite(np.asarray(res.solution)).all()
+    solver.close()
+    with pytest.raises(SartInputError, match="voxel axis"):
+        DistributedSARTSolver(
+            H,
+            opts=SolverOptions(max_iterations=10, conv_tolerance=0.0,
+                               sparse_rtm="0.0"),
+            mesh=make_mesh(1, 2),
+        )
+
+
+def test_gather_fallback_engages_past_the_unroll_bound(monkeypatch):
+    """Occupied-panel counts past SPARSE_STATIC_UNROLL_MAX route through
+    the fori_loop gather host; results stay within the parity bound and
+    the engagement record says so."""
+    import sartsolver_tpu.models.sart as sart_mod
+
+    monkeypatch.setattr(sart_mod, "SPARSE_STATIC_UNROLL_MAX", 1)
+    H, g, msq = _world(seed=9)
+    opts_s = SolverOptions(max_iterations=25, conv_tolerance=0.0,
+                           sparse_rtm="auto", fused_panel_voxels=BS)
+    res_s = _solve(H, g, msq, opts_s)
+    assert FUSED_ENGAGEMENT["last"] == "sparse-gather"
+    opts_d = SolverOptions(max_iterations=25, conv_tolerance=0.0)
+    res_d = _solve(H, g, msq, opts_d)
+    _assert_parity(res_s, res_d, "gather")
+
+
+def test_os_cycle_declines_past_the_unroll_cap(monkeypatch):
+    """The OS subset cycle has no gather form, so an occupied-panel
+    count past SPARSE_STATIC_UNROLL_MAX declines ('auto' runs the dense
+    cycle; explicit raises) instead of unrolling a dot per panel."""
+    import sartsolver_tpu.models.sart as sart_mod
+
+    monkeypatch.setattr(sart_mod, "SPARSE_STATIC_UNROLL_MAX", 1)
+    H, g, msq = _world(seed=27)
+    opts = SolverOptions(max_iterations=10, conv_tolerance=0.0,
+                         sparse_rtm="auto", fused_panel_voxels=BS,
+                         os_subsets=4)
+    res = _solve(H, g, msq, opts)  # 2 occupied panels > cap of 1
+    assert FUSED_ENGAGEMENT["last"] == "os-subset"  # declined to dense
+    assert np.isfinite(np.asarray(res.solution)).all()
+    problem, occ = make_sparse_problem(
+        H, opts=SolverOptions(max_iterations=10, conv_tolerance=0.0,
+                              sparse_rtm="0.0", fused_panel_voxels=BS,
+                              os_subsets=4),
+    )
+    with pytest.raises(ValueError, match="UNROLL_MAX"):
+        solve_normalized_batch(
+            problem, jnp.asarray(g), jnp.asarray([msq], jnp.float32),
+            jnp.zeros((1, V), jnp.float32),
+            opts=SolverOptions(max_iterations=10, conv_tolerance=0.0,
+                               sparse_rtm="0.0", fused_panel_voxels=BS,
+                               os_subsets=4),
+            axis_name=None, voxel_axis=None, use_guess=True,
+            tile_occupancy=occ,
+        )
+
+
+def test_explicit_threshold_without_index_raises():
+    H, g, msq = _world()
+    opts = SolverOptions(max_iterations=5, conv_tolerance=0.0,
+                         sparse_rtm="0.001")
+    problem = make_problem(H, opts=opts)
+    with pytest.raises(ValueError, match="no tile-occupancy index"):
+        solve_normalized_batch(
+            problem, jnp.asarray(g), jnp.asarray([msq], jnp.float32),
+            jnp.zeros((1, V), jnp.float32), opts=opts, axis_name=None,
+            voxel_axis=None, use_guess=True,
+        )
+
+
+# --------------------------------------------------------------------------
+# eps > 0: residual-matched parity, Eq. 6 self-consistency
+# --------------------------------------------------------------------------
+
+
+def test_eps_threshold_is_residual_matched_and_self_consistent():
+    H, g, msq = _world(seed=11)
+    # sub-threshold noise tiles in the otherwise-empty panels: eps must
+    # drop them; the solve then runs on the thresholded operator
+    rng = np.random.default_rng(12)
+    H = H.copy()
+    H[:, BS:2 * BS] = rng.random((P, BS), dtype=np.float32) * 1e-5
+    eps = 1e-3
+    opts_s = SolverOptions(max_iterations=60, conv_tolerance=1e-6,
+                           sparse_rtm=str(eps), fused_panel_voxels=BS)
+    problem, occ = make_sparse_problem(H, opts=opts_s)
+    assert occ.occupancy_fraction() == pytest.approx(0.5)
+    assert occ.threshold == pytest.approx(eps * np.abs(H).max(), rel=1e-6)
+    # Eq. 6 self-consistency: the dropped tiles' voxels have ZERO ray
+    # density in the problem (stats computed from the thresholded
+    # operator), so they mask out exactly like dark voxels
+    dens = np.asarray(problem.ray_density)
+    assert np.all(dens[BS:2 * BS] == 0)
+    gd = jnp.asarray(g)
+    msqd = jnp.asarray([msq], jnp.float32)
+    f0 = jnp.zeros((1, V), jnp.float32)
+    res_s = solve_normalized_batch(
+        problem, gd, msqd, f0, opts=opts_s, axis_name=None,
+        voxel_axis=None, use_guess=True, tile_occupancy=occ,
+    )
+    assert FUSED_ENGAGEMENT["last"] == "sparse-panel"
+    opts_d = SolverOptions(max_iterations=60, conv_tolerance=1e-6)
+    res_d = _solve(H, g, msq, opts_d)
+    sol_s = np.asarray(res_s.solution)[0].astype(np.float64)
+    sol_d = np.asarray(res_d.solution)[0].astype(np.float64)
+    assert np.isfinite(sol_s).all()
+    # residual-matched: the thresholded solve fits the measurement about
+    # as well as dense (the dropped energy is ~eps-sized)
+    g64 = np.asarray(g[0], np.float64)
+    r_s = np.linalg.norm(
+        g64 - threshold_matrix(H, occ).astype(np.float64) @ sol_s
+    )
+    r_d = np.linalg.norm(g64 - H.astype(np.float64) @ sol_d)
+    assert r_s <= 1.2 * r_d + 1e-3
+
+
+def test_cli_integrity_with_threshold_skips_ray_stats_verify(
+    tmp_path, capsys,
+):
+    """--integrity x a tile-dropping threshold: the post-upload host-vs-
+    device rho/lambda compare is SKIPPED with a note (host sums include
+    the dropped entries, the device matrix is thresholded — comparing
+    them would quarantine a healthy run), and the run completes."""
+    import fixtures as fx
+
+    from sartsolver_tpu.cli import main as cli_main
+
+    NP_, NV = 16, 256
+    rng = np.random.default_rng(0)
+    H = (rng.random((NP_, NV)) * 0.9 + 0.1).astype(np.float32)
+    H[:, 128:] = 1e-5  # sub-threshold tiles: eps=0.01 DROPS them
+    mask = np.ones((4, 4), np.int64)
+    cells = np.arange(NV, dtype=np.int64)
+    old = fx.NX, fx.NY, fx.NZ
+    fx.NX, fx.NY, fx.NZ = 16, 16, 1
+    try:
+        fx._write_rtm_file(str(tmp_path / "rtm.h5"), "cam", mask, H,
+                           cells, cells)
+        f_true = rng.random(NV) + 0.5
+        frames = np.stack([fx.frame_from_measurement(
+            mask, H.astype(np.float64) @ f_true)])
+        fx._write_image_file(str(tmp_path / "img.h5"), "cam", frames,
+                             [0.1])
+    finally:
+        fx.NX, fx.NY, fx.NZ = old
+    rc = cli_main([
+        "-o", str(tmp_path / "out.h5"),
+        str(tmp_path / "rtm.h5"), str(tmp_path / "img.h5"),
+        "-m", "50", "--integrity", "--sparse_rtm", "0.01",
+    ])
+    err = capsys.readouterr().err
+    assert rc == 0, err
+    assert "ray-stats verification skipped" in err
+
+
+def test_all_empty_rows_and_columns_mask_cleanly():
+    """Eq. 6 masking on degenerate operators: all-zero pixel rows and
+    voxel columns (inside occupied panels AND as whole panels) produce
+    finite solutions matching the dense path."""
+    H, g, msq = _world(seed=13)
+    H = H.copy()
+    H[5, :] = 0.0  # dead pixel row
+    H[:, 7] = 0.0  # dead voxel column inside an occupied panel
+    g = g.copy()
+    opts_s = SolverOptions(max_iterations=25, conv_tolerance=0.0,
+                           sparse_rtm="auto", fused_panel_voxels=BS)
+    res_s = _solve(H, g, msq, opts_s)
+    assert np.isfinite(np.asarray(res_s.solution)).all()
+    res_d = _solve(H, g, msq,
+                   SolverOptions(max_iterations=25, conv_tolerance=0.0))
+    _assert_parity(res_s, res_d, "masking")
+
+
+def test_fully_empty_operator_is_benign():
+    """Every panel empty: the sweep degenerates to the elementwise
+    update with zero fitted — no crash, finite output."""
+    H = np.zeros((P, V), np.float32)
+    g = np.full((1, P), 0.5, np.float32)
+    opts = SolverOptions(max_iterations=5, conv_tolerance=0.0,
+                         sparse_rtm="auto", fused_panel_voxels=BS)
+    res = _solve(H, g, 1.0, opts)
+    assert np.isfinite(np.asarray(res.solution)).all()
+
+
+# --------------------------------------------------------------------------
+# scheduler composition: one compiled program, occupancy static
+# --------------------------------------------------------------------------
+
+
+def test_sched_cache_size_pinned_under_sparse_state():
+    """Continuous batching with the sparse sweep: occupancy is per-RTM
+    static state, so refills/retirements at every lane occupancy reuse
+    ONE compiled stride program (the scheduler contract), and retired
+    lane results match the dense scheduler run."""
+    from sartsolver_tpu.parallel.mesh import make_mesh
+    from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+    H, _, _ = _world(seed=17)
+    frames = _raw_frames(H, 6, seed=23)
+    sols = {}
+    for mode in ("auto", "off"):
+        opts = SolverOptions(
+            max_iterations=40, conv_tolerance=1e-5, schedule_stride=4,
+            sparse_rtm=mode,
+            fused_panel_voxels=BS if mode == "auto" else None,
+        )
+        solver = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(1, 1))
+        lanes = solver.sched_lanes(2)
+        results = {}
+        queue = list(enumerate(frames))
+        occupant: dict = {}
+        for _ in range(200):  # bounded: 6 frames x <=10 strides each
+            refills = []
+            for b in range(2):
+                if b not in occupant and queue:
+                    idx, frame = queue.pop(0)
+                    refills.append((b, frame))
+                    occupant[b] = idx
+            if not occupant:
+                break
+            solver.sched_step(lanes, refills)
+            done, *_ = lanes.scalars()
+            for b in list(occupant):
+                if done[b]:
+                    results[occupant.pop(b)] = lanes.lane_solution_fetcher(
+                        b
+                    )()
+        assert not queue and not occupant
+        assert solver._sched_fn()._cache_size() == 1
+        sols[mode] = results
+        solver.close()
+    assert sorted(sols["off"]) == list(range(6))
+    for idx in sols["off"]:
+        a, c = sols["auto"][idx], sols["off"][idx]
+        scale = max(float(np.max(np.abs(c))), 1.0)
+        assert float(np.max(np.abs(a - c))) <= PARITY_RTOL * scale, idx
+
+
+# --------------------------------------------------------------------------
+# observability + audit pins
+# --------------------------------------------------------------------------
+
+
+def test_sparse_metrics_are_recorded():
+    from sartsolver_tpu.obs import metrics as obs_metrics
+
+    H, g, msq = _world(seed=19)
+    opts = SolverOptions(max_iterations=3, conv_tolerance=0.0,
+                         sparse_rtm="auto", fused_panel_voxels=BS)
+    _solve(H, g, msq, opts)
+    reg = obs_metrics.get_registry()
+    assert reg.gauge("rtm_tile_occupancy").value == pytest.approx(0.5)
+    assert reg.counter(
+        "sparse_tiles_skipped_total", path="sparse_panel"
+    ).value > 0
+
+
+def test_sparse_audit_entries_pass_their_goldens():
+    import jax as _jax
+
+    from sartsolver_tpu.analysis.audit import run_compile_audit
+
+    if _jax.default_backend() != "cpu":
+        pytest.skip("goldens are checked in for the cpu backend")
+    reports = run_compile_audit(
+        entries=["sparse_panel_sweep", "sharded_sparse_panel_sweep"]
+    )
+    for r in reports:
+        assert r.status in ("ok", "skipped"), r.format()
+
+
+def test_sparse_cost_golden_pins_occupancy_scaling():
+    """THE densification tripwire: the 50%-occupancy entry's loop must
+    cost about half the dense two-matmul entry's whole-module FLOPs —
+    a silent dense fallback roughly doubles it, far outside the
+    committed band."""
+    import jax as _jax
+
+    from sartsolver_tpu.analysis.audit import load_cost_golden
+
+    if _jax.default_backend() != "cpu":
+        pytest.skip("goldens are checked in for the cpu backend")
+    sparse = load_cost_golden("sparse_panel_sweep", "cpu")
+    dense = load_cost_golden("sweep", "cpu")
+    assert sparse is not None and dense is not None
+    ratio = float(sparse["flops"]) / float(dense["flops"])
+    # loop flops halve; the one-time dense setup keeps the module total
+    # above exactly 0.5 — densification would push this past ~1.0
+    assert 0.45 <= ratio <= 0.75, ratio
+
+
+def test_options_validation():
+    with pytest.raises(ValueError, match="sparse_rtm"):
+        SolverOptions(sparse_rtm="1.5")
+    with pytest.raises(ValueError, match="sparse_rtm"):
+        SolverOptions(sparse_rtm="nonsense")
+    with pytest.raises(ValueError, match="sparse_rtm"):
+        SolverOptions(sparse_rtm="auto", fused_sweep="on")
+    assert SolverOptions(sparse_rtm="0.01").sparse_epsilon() == 0.01
+    assert SolverOptions(sparse_rtm="auto").sparse_epsilon() == 0.0
+    assert SolverOptions().sparse_epsilon() is None
+    assert SolverOptions(sparse_rtm="0.01").sparse_explicit()
+    assert not SolverOptions(sparse_rtm="auto").sparse_explicit()
+
+
+def test_nonfinite_warning_rearms_per_run():
+    """The prepare_measurement non-finite warning fires once per RUN,
+    not once per process: reset_nonfinite_warning re-arms it (the
+    serving engine resets per request, the CLI per run)."""
+    import warnings
+
+    from sartsolver_tpu.models.sart import (
+        prepare_measurement,
+        reset_nonfinite_warning,
+    )
+    from sartsolver_tpu.obs import metrics as obs_metrics
+
+    opts = SolverOptions()
+    bad = np.array([1.0, np.nan, 2.0])
+    before = obs_metrics.get_registry().counter(
+        "nonfinite_pixels_total"
+    ).value
+    reset_nonfinite_warning()
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        prepare_measurement(bad, opts)
+        assert any("non-finite" in str(w.message) for w in rec)
+        rec.clear()
+        # latched: a second frame in the SAME run stays quiet...
+        prepare_measurement(bad, opts)
+        assert not rec
+        # ...but the NEXT run (or serving request) warns again
+        reset_nonfinite_warning()
+        prepare_measurement(bad, opts)
+        assert any("non-finite" in str(w.message) for w in rec)
+    after = obs_metrics.get_registry().counter(
+        "nonfinite_pixels_total"
+    ).value
+    # the counter never latches: every call counts its pixels
+    assert after == before + 3
