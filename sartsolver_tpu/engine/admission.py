@@ -206,7 +206,9 @@ class AdmissionController:
                     f"tenant {request.tenant!r} quarantined for "
                     f"{self.quarantine_cooldown:g}s after "
                     f"{self.quarantine_after} consecutive failing "
-                    "request(s); other tenants unaffected"
+                    "request(s); other tenants unaffected "
+                    f"(tripping request {request.id!r}, "
+                    f"trace={request.trace})"
                 )
         elif outcome == reqmod.REQ_COMPLETED:
             tenant.failures = 0

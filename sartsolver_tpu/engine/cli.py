@@ -92,6 +92,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
     eng.add_argument("--max_cycle_requests", type=int, default=8,
                      help="Requests co-batched into one solve cycle. "
                           "Default 8.")
+    eng.add_argument("--http_port", type=int, default=None,
+                     metavar="PORT",
+                     help="Opt-in live pull endpoints on 127.0.0.1:PORT "
+                          "(/metrics Prometheus exposition, /healthz "
+                          "admission state, /status snapshot JSON; "
+                          "docs/OBSERVABILITY.md §10). 0 binds an "
+                          "ephemeral port. Default: no endpoint, no "
+                          "thread.")
+    eng.add_argument("--slo_ms", type=float, default=None,
+                     help="Per-request latency target in milliseconds "
+                          "(acceptance to completion, queue wait "
+                          "included); tracked as the engine_slo_ok/"
+                          "breach counter pair per tenant (error-budget "
+                          "burn). Default: no SLO accounting.")
     return p
 
 
@@ -109,6 +123,12 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         return EXIT_INPUT_ERROR
     if args.max_queue < 1:
         print("Argument max_queue must be >= 1.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if args.http_port is not None and not 0 <= args.http_port <= 65535:
+        print("Argument http_port must be 0..65535.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if args.slo_ms is not None and not args.slo_ms > 0:
+        print("Argument slo_ms must be > 0.", file=sys.stderr)
         return EXIT_INPUT_ERROR
 
     from sartsolver_tpu.utils.cache import configure_compilation_cache
@@ -182,6 +202,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             idle_exit=args.idle_exit,
             max_cycle_requests=args.max_cycle_requests,
             telemetry=telem,
+            http_port=args.http_port,
+            slo_ms=args.slo_ms,
         )
         code = server.run()
         if code == EXIT_INTERRUPTED:
@@ -247,6 +269,13 @@ def build_submit_parser() -> argparse.ArgumentParser:
                         "all frames).")
     p.add_argument("--deadline", type=float, default=None,
                    help="deadline_s: wall-clock budget from acceptance.")
+    p.add_argument("--trace", default=None, metavar="ID",
+                   help="Propagate a caller-chosen trace id (payload "
+                        "'trace' field; 1-128 chars of [A-Za-z0-9._-]). "
+                        "Without it the engine assigns one at admission; "
+                        "either way it lands in the response, journal "
+                        "markers and trace spans "
+                        "(docs/OBSERVABILITY.md §10).")
     p.add_argument("--wait", type=float, default=0.0, metavar="S",
                    help="Wait up to S seconds for the outcome response "
                         "(needs --engine_dir; 0 = do not wait).")
@@ -300,7 +329,20 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
                    "time_range": args.time_range}
         if args.deadline is not None:
             payload["deadline_s"] = args.deadline
+        if args.trace is not None:
+            payload["trace"] = args.trace
         payload_text = json.dumps(payload)
+    if args.trace is not None and args.request_file is not None:
+        # propagate the caller's trace id into a file payload too; an
+        # unparseable file falls through to the local validation below,
+        # which produces the polite input-error message
+        try:
+            payload = json.loads(payload_text)
+            if isinstance(payload, dict):
+                payload["trace"] = args.trace
+                payload_text = json.dumps(payload)
+        except ValueError:
+            pass
     # local validation: a malformed request fails HERE with the polite
     # input-error exit, before it ever reaches the engine
     try:
@@ -360,7 +402,10 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
         print(f"sartsolve submit: submit failed: {err}", file=sys.stderr)
         return EXIT_INFRASTRUCTURE
     if args.wait <= 0:
-        print(json.dumps({"id": req.id, "state": "submitted"}))
+        rec = {"id": req.id, "state": "submitted"}
+        if args.trace is not None:
+            rec["trace"] = args.trace
+        print(json.dumps(rec))
         return EXIT_OK
     resp_path = os.path.join(responses, f"{req.id}.json")
     deadline = time.monotonic() + args.wait

@@ -43,6 +43,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from sartsolver_tpu.engine.request import Request
+from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience import faults
 from sartsolver_tpu.resilience.retry import retry_call
 
@@ -72,7 +73,8 @@ class RequestJournal:
 
     # ---- append ----------------------------------------------------------
 
-    def append(self, marker: str, request_id: str, **data) -> None:
+    def append(self, marker: str, request_id: str, *,
+               trace_id: Optional[str] = None, **data) -> None:
         """Durably append one marker record (flush + fsync before
         returning). The ``completed`` marker exposes the "pre-flush"
         crash window BEFORE the record lands (outputs are on disk, the
@@ -83,6 +85,11 @@ class RequestJournal:
             raise ValueError(f"Unknown journal marker {marker!r}.")
         rec = {"marker": marker, "id": str(request_id),
                "unix": round(time.time(), 3)}
+        if trace_id:
+            # the trace id rides every marker so post-mortem triage can
+            # join the journal against traces/metrics/crash bundles
+            # ("which requests were in flight when it died")
+            rec["trace"] = str(trace_id)
         rec.update(data)
         line = json.dumps(rec) + "\n"
         if marker == MARKER_COMPLETED:
@@ -99,19 +106,23 @@ class RequestJournal:
         # with the shared policy; exhaustion raises RetriesExhausted,
         # which the server maps to the infrastructure abort — an engine
         # that cannot journal must stop, not serve unjournaled work
-        retry_call(write, site=faults.SITE_JOURNAL_APPEND,
-                   retry_on=(OSError,))
+        with obs_trace.request_span(trace_id, f"journal.{marker}"):
+            retry_call(write, site=faults.SITE_JOURNAL_APPEND,
+                       retry_on=(OSError,))
         if marker != MARKER_COMPLETED:
             _crash_window(marker)
 
     def accepted(self, request: Request) -> None:
-        self.append(MARKER_ACCEPTED, request.id, request=request.to_dict())
+        self.append(MARKER_ACCEPTED, request.id, trace_id=request.trace,
+                    request=request.to_dict())
 
     def dispatched(self, request: Request) -> None:
-        self.append(MARKER_DISPATCHED, request.id)
+        self.append(MARKER_DISPATCHED, request.id,
+                    trace_id=request.trace)
 
     def completed(self, request: Request, outcome: dict) -> None:
-        self.append(MARKER_COMPLETED, request.id, outcome=outcome)
+        self.append(MARKER_COMPLETED, request.id, trace_id=request.trace,
+                    outcome=outcome)
 
     # ---- replay ----------------------------------------------------------
 
@@ -161,6 +172,10 @@ class RequestJournal:
                         submitted_unix=float(
                             raw.get("submitted_unix") or 0.0
                         ),
+                        # replay keeps the original trace id: the re-run
+                        # is the same request, and its spans/markers must
+                        # join against the pre-crash ones
+                        trace=str(raw.get("trace", "")),
                     )
                     if rid not in accepted:
                         accepted[rid] = req

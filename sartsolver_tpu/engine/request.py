@@ -19,6 +19,7 @@ import dataclasses
 import json
 import re
 import time
+import uuid
 from typing import Optional
 
 from sartsolver_tpu.config import SartInputError, parse_time_intervals
@@ -47,6 +48,15 @@ REQ_SHED_DEADLINE = "shed-deadline"  # deadline passed (queued or mid-solve)
 REQ_REJECTED = "rejected"            # never accepted (reason above)
 
 _ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+# trace ids are looser than request ids: clients propagate their own
+# (e.g. a W3C traceparent fragment), so any reasonable token is accepted
+_TRACE_RE = re.compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+
+def new_trace_id() -> str:
+    """A fresh request trace id (assigned at admission for payloads
+    that carry none; docs/OBSERVABILITY.md §10)."""
+    return uuid.uuid4().hex[:16]
 
 
 class RequestError(SartInputError):
@@ -63,12 +73,17 @@ class Request:
     time_range: str = ""            # parse_time_intervals grammar; "" = all
     deadline_s: Optional[float] = None  # wall-clock budget from acceptance
     submitted_unix: float = 0.0
+    # request trace id (docs/OBSERVABILITY.md §10): client-propagated
+    # via the payload's "trace" field, or assigned at parse time — every
+    # journal marker, response record, frame record and trace span the
+    # request touches carries it
+    trace: str = ""
 
     def to_dict(self) -> dict:
         return {
             "id": self.id, "tenant": self.tenant,
             "time_range": self.time_range, "deadline_s": self.deadline_s,
-            "submitted_unix": self.submitted_unix,
+            "submitted_unix": self.submitted_unix, "trace": self.trace,
         }
 
 
@@ -94,6 +109,7 @@ def parse_request(payload, *, default_deadline_s: Optional[float] = None
         )
     unknown = set(payload) - {
         "id", "tenant", "time_range", "deadline_s", "submitted_unix",
+        "trace",
     }
     if unknown:
         raise RequestError(
@@ -135,7 +151,15 @@ def parse_request(payload, *, default_deadline_s: Optional[float] = None
         raise RequestError(
             "Request field 'submitted_unix' must be a number."
         ) from err
+    trace_id = payload.get("trace")
+    if trace_id is None:
+        trace_id = new_trace_id()
+    elif not isinstance(trace_id, str) or not _TRACE_RE.match(trace_id):
+        raise RequestError(
+            "Request field 'trace' must be 1-128 characters of "
+            "[A-Za-z0-9._-]."
+        )
     return Request(
         id=req_id, tenant=tenant, time_range=time_range,
-        deadline_s=deadline_s, submitted_unix=submitted,
+        deadline_s=deadline_s, submitted_unix=submitted, trace=trace_id,
     )

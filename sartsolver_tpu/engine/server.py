@@ -34,6 +34,7 @@ import itertools
 import json
 import os
 import socket as socketmod
+import sys
 import threading
 import time
 from collections import deque
@@ -48,10 +49,12 @@ from sartsolver_tpu.engine.journal import RequestJournal
 from sartsolver_tpu.engine.request import Request, RequestError, parse_request
 from sartsolver_tpu.engine.session import ResidentSession, absolute_deadline
 from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience import shutdown, watchdog
 from sartsolver_tpu.resilience.failures import (
     DEADLINE_EXCEEDED,
     DIVERGED,
+    EXIT_INPUT_ERROR,
     EXIT_INTERRUPTED,
     EXIT_OK,
     FRAME_FAILED,
@@ -68,10 +71,12 @@ class _ActiveRequest:
     """One dispatched request's in-cycle bookkeeping."""
 
     __slots__ = ("req", "deadline", "expected", "got", "by_status",
-                 "writer", "t_dispatch", "deadline_missed", "output")
+                 "writer", "t_dispatch", "deadline_missed", "output",
+                 "t_accepted")
 
     def __init__(self, req: Request, expected: int,
-                 deadline: Optional[float], output: str):
+                 deadline: Optional[float], output: str,
+                 t_accepted: Optional[float] = None):
         self.req = req
         self.deadline = deadline
         self.expected = int(expected)
@@ -81,6 +86,10 @@ class _ActiveRequest:
         self.t_dispatch = time.perf_counter()
         self.deadline_missed = False
         self.output = output
+        # acceptance time.monotonic(): end-to-end latency (queue wait
+        # included) anchors here — the SLO clock the client experiences
+        self.t_accepted = (time.monotonic() if t_accepted is None
+                           else float(t_accepted))
 
     @property
     def done(self) -> bool:
@@ -103,6 +112,8 @@ class EngineServer:
         idle_exit: float = 0.0,
         max_cycle_requests: int = 8,
         telemetry=None,
+        http_port: Optional[int] = None,
+        slo_ms: Optional[float] = None,
     ):
         if lanes < 1:
             raise ValueError("lanes must be >= 1.")
@@ -128,8 +139,18 @@ class EngineServer:
         self.idle_exit = float(idle_exit)
         self.max_cycle_requests = max(1, int(max_cycle_requests))
         self.telemetry = telemetry
-        # accepted-not-yet-dispatched: (Request, accepted_monotonic)
-        self._queue: List[Tuple[Request, float]] = []
+        # --http_port: None/absent = no socket, no thread, nothing
+        # imported (the disabled-path identity contract); the server is
+        # constructed and started inside run()
+        self.http_port = http_port
+        self.http = None
+        # --slo_ms: per-request latency target; the ok/breach counter
+        # pair below is the error-budget burn accounting
+        self.slo_ms = None if slo_ms is None else float(slo_ms)
+        # accepted-not-yet-dispatched:
+        # (Request, accepted_monotonic, accepted_perf_counter) — the
+        # perf_counter twin anchors the retroactive queue.wait trace span
+        self._queue: List[Tuple[Request, float, float]] = []
         # one lock guards admission-state mutation + queue + journal +
         # response writes: the socket thread admits concurrently with
         # the serve loop, and EVERY AdmissionController mutation
@@ -138,6 +159,14 @@ class EngineServer:
         # bounded queue at "full" or silently disable backpressure
         self._lock = threading.Lock()
         self._active_ids: List[str] = []
+        # request id -> {"trace": ..., "span": ...}: every live (queued
+        # or in-flight) request's trace id and CURRENT lifecycle span,
+        # removed at completion. Mutations are GIL-atomic dict ops; the
+        # status provider reads it lock-free (signal context) — a torn
+        # view mis-states one request's span, never hangs a poke. This
+        # is what lets a crash bundle name the requests that were in
+        # flight when the process died, and where each one was.
+        self._requests: Dict[str, dict] = {}
         self._draining = False
         self._cycles = 0
         # bounded: a serve-forever daemon must not grow a list one
@@ -150,12 +179,17 @@ class EngineServer:
         registry = obs_metrics.get_registry()
         self._queue_wait_hist = registry.histogram("engine_queue_wait_s")
         self._solve_hist = registry.histogram("engine_request_solve_s")
+        self._latency_hist = registry.histogram(
+            "engine_request_latency_s"
+        )
         self._deadline_miss_ctr = registry.counter(
             "engine_deadline_miss_total"
         )
         self._requests_ctrs: Dict[str, object] = {}
         self._lanes_gauge = registry.gauge("engine_lanes")
         self._lanes_gauge.set(float(lanes))
+        if self.slo_ms is not None:
+            registry.gauge("engine_slo_target_ms").set(self.slo_ms)
 
     # ---- events / status -------------------------------------------------
 
@@ -174,6 +208,14 @@ class EngineServer:
             self._requests_ctrs[outcome] = ctr
         return ctr
 
+    def _set_span(self, req: Request, span: str) -> None:
+        """Advance a live request's current lifecycle span (the status/
+        crash-bundle attribution surface; GIL-atomic dict write)."""
+        self._requests[req.id] = {"trace": req.trace, "span": span}
+
+    def _clear_span(self, request_id: str) -> None:
+        self._requests.pop(request_id, None)
+
     def _status(self) -> dict:
         """Engine view for the heartbeat line / SIGUSR1 status snapshot
         (watchdog.set_engine_status_provider): attributes a wedged
@@ -184,12 +226,24 @@ class EngineServer:
         shed_total = 0
         for ctr in adm._shed_ctrs.values():
             shed_total += int(ctr.value)
+        from sartsolver_tpu.utils.locking import stale_read
+
+        # live request table: id -> {trace, span}. The dict is mutated
+        # by the serve loop (insert at admit, pop at finish); the
+        # bounded-retry copy degrades to {} rather than raising out of a
+        # heartbeat write or a signal-context poke.
+        requests = stale_read(
+            lambda: {rid: dict(info)
+                     for rid, info in self._requests.items()},
+            default={},
+        )
         return {
             "queue_depth": int(adm.queue_depth),
             "admitted": int(adm._admitted_ctr.value),
             "shed": shed_total,
             "quarantined_tenants": adm.quarantined_tenants(),
             "active_requests": list(self._active_ids),
+            "requests": requests,
             "lanes": int(self.lanes),
             "degraded": adm.degraded_reason,
             "draining": bool(self._draining),
@@ -243,15 +297,23 @@ class EngineServer:
         with self._lock:
             reason = self.admission.admit(req, draining=self._draining)
             if reason is None:
+                self._set_span(req, "queued")
                 self.journal.accepted(req)
-                self._queue.append((req, time.monotonic()))
+                self._queue.append((req, time.monotonic(),
+                                    time.perf_counter()))
                 rec = {"id": req.id, "verdict": "accepted",
                        "state": "pending", "tenant": req.tenant,
-                       "source": source}
+                       "trace": req.trace, "source": source}
             else:
                 rec = {"id": req.id, "verdict": "rejected",
                        "reason": reason, "tenant": req.tenant,
-                       "source": source}
+                       "trace": req.trace, "source": source}
+        obs_trace.request_instant(
+            req.trace, "admission",
+            verdict=("accepted" if reason is None else "rejected"),
+            tenant=req.tenant, source=source,
+            **({"reason": reason} if reason else {}),
+        )
         if reason == reqmod.REASON_DUPLICATE:
             # idempotency, not amnesia: a resubmitted id must never
             # clobber the original's response record. A completed
@@ -388,7 +450,9 @@ class EngineServer:
             self.admission._depth_gauge.set(
                 float(self.admission.queue_depth)
             )
-            self._queue.append((req, time.monotonic()))
+            self._set_span(req, "replayed")
+            self._queue.append((req, time.monotonic(),
+                                time.perf_counter()))
             out = os.path.join(self.outputs_dir, f"{req.id}.h5")
             try:
                 os.unlink(out)
@@ -404,11 +468,29 @@ class EngineServer:
 
     def _finish(self, ar: _ActiveRequest, outcome: str,
                 error: Optional[str] = None) -> None:
+        trace_id = ar.req.trace
         if ar.writer is not None:
-            ar.writer.flush()
-            self.session.grid.write_hdf5(ar.output, "voxel_map")
+            self._set_span(ar.req, "io.write")
+            with obs_trace.request_span(trace_id, "io.write",
+                                        frames=ar.got):
+                ar.writer.flush()
+                self.session.grid.write_hdf5(ar.output, "voxel_map")
         wall = time.perf_counter() - ar.t_dispatch
         self._solve_hist.observe(wall)
+        latency = time.monotonic() - ar.t_accepted
+        self._latency_hist.observe(latency)
+        # per-tenant twins resolve through the registry's own cached
+        # instrument lookup (GIL-atomic fast path, obs/metrics.py)
+        registry = obs_metrics.get_registry()
+        registry.histogram("engine_request_latency_s",
+                           tenant=ar.req.tenant).observe(latency)
+        if self.slo_ms is not None:
+            # the error-budget counter pair: burn rate is
+            # breach / (ok + breach), per tenant
+            name = ("engine_slo_breach_total"
+                    if latency * 1e3 > self.slo_ms
+                    else "engine_slo_ok_total")
+            registry.counter(name, tenant=ar.req.tenant).inc()
         if ar.deadline_missed:
             self._deadline_miss_ctr.inc()
         rec = {
@@ -418,51 +500,96 @@ class EngineServer:
             "output": (os.path.relpath(ar.output, self.engine_dir)
                        if ar.writer is not None else None),
             "solve_s": round(wall, 3),
+            "latency_s": round(latency, 3),
+            "trace": trace_id,
         }
         if error:
             rec["error"] = error
+        self._set_span(ar.req, "journal.completed")
         with self._lock:
             self.journal.completed(ar.req, rec)
             self.admission.note_outcome(ar.req, outcome)
         self._requests_ctr(outcome).inc()
         self._respond(ar.req.id, {
             "id": ar.req.id, "verdict": "accepted", "state": "done",
-            "outcome": rec,
+            "trace": trace_id, "outcome": rec,
         })
+        obs_trace.request_instant(trace_id, "request.done",
+                                  outcome=outcome, frames=ar.got)
+        self._write_request_trace(ar)
         if self.telemetry is not None:
             self.telemetry.record_event(
                 f"request {ar.req.id} ({ar.req.tenant}): {outcome} "
-                f"({ar.got} frame(s) in {wall:.3f}s)"
+                f"({ar.got} frame(s) in {wall:.3f}s) "
+                f"trace={trace_id}"
             )
+        self._clear_span(ar.req.id)
         if ar.req.id in self._active_ids:
             self._active_ids.remove(ar.req.id)
 
+    def _write_request_trace(self, ar: _ActiveRequest) -> None:
+        """With tracing active, publish the request's section of the
+        trace buffer as a standalone Perfetto-loadable file
+        (``<engine_dir>/traces/<id>.trace.json``) — one ``sartsolve
+        submit`` round trip yields one trace. With tracing disabled
+        (the default) this is a no-op: no directory, no file."""
+        payload = obs_trace.request_trace(ar.req.trace)
+        if payload is None:
+            return
+        traces_dir = os.path.join(self.engine_dir, "traces")
+        path = os.path.join(traces_dir, f"{ar.req.id}.trace.json")
+        tmp = f"{path}.{os.getpid()}.tmp"
+        try:
+            os.makedirs(traces_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError as err:
+            self._event(
+                f"trace write for {ar.req.id!r} failed: {err}"
+            )
+
     # ---- the solve cycle -------------------------------------------------
 
-    def _solve_cycle(self, batch: List[Tuple[Request, float]]) -> None:
+    def _solve_cycle(
+        self, batch: List[Tuple[Request, float, float]]
+    ) -> None:
         from sartsolver_tpu.sched import ContinuousBatcher
 
         now = time.monotonic()
         gens = []
         route: deque = deque()
         active: List[_ActiveRequest] = []
-        for req, t_acc in batch:
+        for req, t_acc, t_acc_perf in batch:
             with self._lock:
                 self.admission.note_dispatched(req)
-            self._queue_wait_hist.observe(now - t_acc)
+            wait = now - t_acc
+            self._queue_wait_hist.observe(wait)
+            obs_metrics.get_registry().histogram(
+                "engine_queue_wait_s", tenant=req.tenant
+            ).observe(wait)
+            # the queue-wait span is only known complete at dispatch:
+            # emitted retroactively over [acceptance, now]
+            obs_trace.request_complete(req.trace, "queue.wait",
+                                       t_acc_perf, time.perf_counter(),
+                                       tenant=req.tenant)
             deadline = absolute_deadline(req, t_acc)
             output = os.path.join(self.outputs_dir, f"{req.id}.h5")
             if deadline is not None and now > deadline:
                 # queue wait alone blew the budget: shed WITHOUT
                 # touching the solver (the load-shedding half of the
                 # deadline contract)
-                ar = _ActiveRequest(req, 0, deadline, output)
+                ar = _ActiveRequest(req, 0, deadline, output,
+                                    t_accepted=t_acc)
                 ar.deadline_missed = True
+                obs_trace.request_instant(req.trace, "deadline.shed",
+                                          where="queued")
                 with self._lock:
                     self.journal.dispatched(req)
                 self._finish(ar, reqmod.REQ_SHED_DEADLINE,
                              error="deadline passed while queued")
                 continue
+            self._set_span(req, "journal.dispatched")
             with self._lock:
                 self.journal.dispatched(req)
             # per-REQUEST warning scope: a resident process must surface
@@ -471,22 +598,28 @@ class EngineServer:
             from sartsolver_tpu.models.sart import reset_nonfinite_warning
 
             reset_nonfinite_warning()
+            self._set_span(req, "session.attach")
             try:
-                image = self.session.attach(req)
+                with obs_trace.request_span(req.trace, "session.attach",
+                                            time_range=req.time_range):
+                    image = self.session.attach(req)
             except (SartInputError,) + RECOVERABLE_FRAME_ERRORS as err:
-                ar = _ActiveRequest(req, 0, deadline, output)
+                ar = _ActiveRequest(req, 0, deadline, output,
+                                    t_accepted=t_acc)
                 self._finish(ar, reqmod.REQ_FAILED,
                              error=f"{type(err).__name__}: {err}")
                 continue
             ar = _ActiveRequest(req, self.session.n_frames(image),
-                                deadline, output)
+                                deadline, output, t_accepted=t_acc)
             self._active_ids.append(req.id)
             if ar.expected == 0:
                 self._finish(ar, reqmod.REQ_COMPLETED)
                 continue
+            self._set_span(req, "solve")
             active.append(ar)
             route.extend([ar] * ar.expected)
-            gens.append(self.session.frame_items(image, deadline))
+            gens.append(self.session.frame_items(image, deadline,
+                                                 trace_id=req.trace))
         if not active:
             return
 
@@ -517,7 +650,7 @@ class EngineServer:
             if self.telemetry is not None:
                 self.telemetry.record_frame(
                     ftime, status, iterations, convergence,
-                    per_frame_ms, "engine",
+                    per_frame_ms, "engine", trace=ar.req.trace,
                 )
             if ar.done:
                 self._finish_solved(ar)
@@ -527,9 +660,11 @@ class EngineServer:
             add_row(ar, failed_row(nvoxel), FRAME_FAILED, ftime,
                     cam_times, -1)
             if self.telemetry is not None:
+                # FAILED rows carry the trace id too: a tenant's "my
+                # request lost frames" triages from the artifact alone
                 self.telemetry.record_frame(
                     ftime, FRAME_FAILED, -1, None, None, "engine",
-                    error=type(err).__name__,
+                    error=type(err).__name__, trace=ar.req.trace,
                 )
             if ar.done:
                 self._finish_solved(ar)
@@ -579,9 +714,10 @@ class EngineServer:
                 if ar.req.id in truncated:
                     if ar.req.id in self._active_ids:
                         self._active_ids.remove(ar.req.id)
+                    self._clear_span(ar.req.id)
                     self._respond(ar.req.id, {
                         "id": ar.req.id, "verdict": "accepted",
-                        "state": "interrupted",
+                        "state": "interrupted", "trace": ar.req.trace,
                     })
             self._event(
                 f"stop request truncated the cycle; "
@@ -606,10 +742,20 @@ class EngineServer:
         set, until the queue has been empty that long (exit 0)."""
         self._replay()
         watchdog.set_engine_status_provider(self._status)
-        self._start_socket()
         idle_since = time.monotonic()
         exit_code = EXIT_OK
         try:
+            self._start_socket()
+            try:
+                self._start_http()
+            except OSError as err:
+                # EADDRINUSE/EACCES on the operator's chosen port is a
+                # config problem, not an engine fault: polite input-
+                # error exit (taxonomy parity with the flag validators),
+                # never a traceback + misleading crash bundle
+                print(f"sartsolve serve: cannot bind --http_port "
+                      f"{self.http_port}: {err}", file=sys.stderr)
+                return EXIT_INPUT_ERROR
             while True:
                 if shutdown.stop_requested() and not self._draining:
                     self._draining = True
@@ -642,5 +788,44 @@ class EngineServer:
                 time.sleep(self.poll_interval)
         finally:
             self._stop_socket()
+            self._stop_http()
             watchdog.set_engine_status_provider(None)
         return exit_code
+
+    # ---- live pull endpoint (--http_port) --------------------------------
+
+    def _health(self) -> Tuple[str, Optional[str]]:
+        """Admission state for /healthz: draining beats degraded beats
+        ok (lock-free field reads — scrape-path contract)."""
+        if self._draining:
+            return "draining", "stop requested; resubmit elsewhere"
+        reason = self.admission.degraded_reason
+        if reason is not None:
+            return "degraded", reason
+        return "ok", None
+
+    def _start_http(self) -> None:
+        if self.http_port is None:
+            return
+        from sartsolver_tpu.engine.httpd import EngineHTTPServer
+        from sartsolver_tpu.obs import flight as obs_flight
+
+        registry = obs_metrics.get_registry()
+        self.http = EngineHTTPServer(
+            self.http_port,
+            # blocking=False throughout: a scrape must never contend
+            # with the solve path (stale-read snapshot forms, PR 9)
+            metrics_snapshot=lambda: registry.snapshot(blocking=False),
+            health=self._health,
+            status=lambda: obs_flight.status_snapshot(blocking=False),
+        )
+        self.http.start()
+        self._event(
+            f"live endpoints on http://127.0.0.1:{self.http.port} "
+            "(/metrics /healthz /status)"
+        )
+
+    def _stop_http(self) -> None:
+        if self.http is not None:
+            self.http.stop()
+            self.http = None

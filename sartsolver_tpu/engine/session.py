@@ -214,13 +214,15 @@ class ResidentSession:
 
     def frame_items(
         self, image, deadline: Optional[float],
+        trace_id: Optional[str] = None,
     ) -> Iterator[Tuple]:
         """The request's scheduler-stream items: ``(frame, time,
-        camera_times, deadline)`` tuples (``deadline`` is the absolute
-        ``time.monotonic()`` budget the lane sweep sheds against, or
-        None). A failed frame read degrades to an ordered
-        :class:`FrameFailure` item — per-frame isolation, like the
-        CLI's prefetcher."""
+        camera_times, deadline, trace_id)`` tuples (``deadline`` is the
+        absolute ``time.monotonic()`` budget the lane sweep sheds
+        against, or None; ``trace_id`` routes the scheduler's per-stride
+        spans onto the request's trace track). A failed frame read
+        degrades to an ordered :class:`FrameFailure` item — per-frame
+        isolation, like the CLI's prefetcher."""
         for i in range(len(image)):
             try:
                 frame = image.frame(i)
@@ -234,7 +236,8 @@ class ResidentSession:
                     ftime, cam_times = float("nan"), []
                 yield FrameFailure(None, ftime, cam_times, err)
                 continue
-            yield (np.asarray(frame), ftime, cam_times, deadline)
+            yield (np.asarray(frame), ftime, cam_times, deadline,
+                   trace_id)
 
     def n_frames(self, image) -> int:
         return len(image)

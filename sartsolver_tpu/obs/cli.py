@@ -130,13 +130,16 @@ def summarize(records: List[dict]) -> dict:
             _metric_key(m): m["value"] for m in metric_recs
             if m["kind"] == "gauge"
         },
-        # moments histograms (count/sum/min/max); mean derived here so
-        # the diff below can gate on distribution drift (in particular
-        # iterations_to_converge — convergence behavior)
+        # moments histograms (count/sum/min/max + fixed-bucket quantile
+        # estimates when the artifact generation carries them); mean
+        # derived here so the diff below can gate on distribution drift
+        # (in particular iterations_to_converge — convergence behavior)
         "histograms": {
             _metric_key(m): {
                 "count": m["count"], "mean": m["sum"] / m["count"],
                 "min": m["min"], "max": m["max"],
+                **{q: m[q] for q in ("p50", "p95", "p99")
+                   if m.get(q) is not None},
             }
             for m in metric_recs
             if m["kind"] == "histogram" and m.get("count")
@@ -154,15 +157,37 @@ def summarize(records: List[dict]) -> dict:
         shed = sum(v for k, v in out["counters"].items()
                    if k.startswith("engine_shed_total"))
         solve = out["histograms"].get("engine_request_solve_s")
+        latency = out["histograms"].get("engine_request_latency_s")
         out["engine"] = {
             "queue_wait_mean_s": qw["mean"] if qw else None,
+            "queue_wait_p50_s": (qw or {}).get("p50"),
+            "queue_wait_p95_s": (qw or {}).get("p95"),
+            "queue_wait_p99_s": (qw or {}).get("p99"),
             "request_solve_mean_s": solve["mean"] if solve else None,
+            "latency_mean_s": latency["mean"] if latency else None,
+            "latency_p95_s": (latency or {}).get("p95"),
+            "latency_p99_s": (latency or {}).get("p99"),
             "admitted": admitted or 0.0,
             "shed": shed,
             "deadline_miss_rate": (
                 miss / admitted if admitted else None
             ),
         }
+        # SLO error-budget burn (docs/OBSERVABILITY.md §10): the
+        # per-tenant ok/breach counter pair summed into one burn rate;
+        # absent unless the serve run set --slo_ms
+        slo_ok = sum(v for k, v in out["counters"].items()
+                     if k.startswith("engine_slo_ok_total"))
+        slo_breach = sum(v for k, v in out["counters"].items()
+                         if k.startswith("engine_slo_breach_total"))
+        if slo_ok or slo_breach:
+            total = slo_ok + slo_breach
+            out["engine"]["slo"] = {
+                "target_ms": out["gauges"].get("engine_slo_target_ms"),
+                "requests": total,
+                "breaches": slo_breach,
+                "burn_rate": slo_breach / total,
+            }
     if bench:
         out["bench"] = {
             "metric": bench[0]["metric"], "value": bench[0]["value"],
@@ -252,8 +277,13 @@ def _print_summary(path: str, summary: dict) -> None:
         s = summary["iterations"]
         print(f"  iterations: mean {s['mean']:.1f}, max {s['max']:.0f}")
     for key, h in summary["histograms"].items():
-        print(f"  histogram {key}: count {h['count']:g}, "
-              f"mean {h['mean']:.2f}, min {h['min']:g}, max {h['max']:g}")
+        line = (f"  histogram {key}: count {h['count']:g}, "
+                f"mean {h['mean']:.2f}, min {h['min']:g}, "
+                f"max {h['max']:g}")
+        if h.get("p99") is not None:
+            line += (f", p50 {h['p50']:.4g}, p95 {h['p95']:.4g}, "
+                     f"p99 {h['p99']:.4g}")
+        print(line)
     for key, value in summary["counters"].items():
         print(f"  counter {key} = {value:g}")
     for key, value in summary["gauges"].items():
@@ -273,6 +303,21 @@ def _print_summary(path: str, summary: dict) -> None:
         r = summary["roofline"]
         print(f"  roofline: mxu_util {r['mxu_util']:g}, "
               f"hbm_util {r['hbm_util']:g} ({r['bound']}-bound)")
+    if "engine" in summary:
+        e = summary["engine"]
+        line = f"  engine: admitted {e['admitted']:g}, shed {e['shed']:g}"
+        if e.get("queue_wait_mean_s") is not None:
+            line += f", queue-wait mean {e['queue_wait_mean_s']:.4g}s"
+        if e.get("queue_wait_p99_s") is not None:
+            line += f" p99 {e['queue_wait_p99_s']:.4g}s"
+        if e.get("latency_p99_s") is not None:
+            line += f", latency p99 {e['latency_p99_s']:.4g}s"
+        print(line)
+        slo = e.get("slo")
+        if slo:
+            print(f"  engine SLO ({slo['target_ms']:g} ms): "
+                  f"{slo['breaches']:g}/{slo['requests']:g} breached "
+                  f"(burn rate {slo['burn_rate']:.3f})")
     if "variant" in summary:
         v = summary["variant"]
         print("  solver variant: " + ", ".join(
@@ -420,6 +465,26 @@ def diff(old: dict, new: dict) -> dict:
         miss_pts = 100.0 * (b - a)
         out["engine_deadline_miss"] = {"old": a, "new": b}
     out["engine_deadline_miss_pts"] = miss_pts
+    # p99 queue wait (SLO accounting, docs §10): the tail is what an
+    # SLO experiences — a mean gate can hide a regressed tail behind
+    # many fast requests. Cost direction (up = worse), same threshold.
+    p99_pct = None
+    a = (old.get("engine") or {}).get("queue_wait_p99_s")
+    b = (new.get("engine") or {}).get("queue_wait_p99_s")
+    if a and b and a > 0:
+        p99_pct = 100.0 * (b / a - 1.0)
+        out["engine_queue_wait_p99"] = {"old": a, "new": b}
+    out["engine_queue_wait_p99_pct"] = p99_pct
+    # SLO error-budget burn, compared in percentage points like the
+    # deadline-miss rate (a rate-of-rates blows up on a zero-burn
+    # healthy baseline)
+    burn_pts = None
+    a = ((old.get("engine") or {}).get("slo") or {}).get("burn_rate")
+    b = ((new.get("engine") or {}).get("slo") or {}).get("burn_rate")
+    if a is not None and b is not None:
+        burn_pts = 100.0 * (b - a)
+        out["engine_slo_burn"] = {"old": a, "new": b}
+    out["engine_slo_burn_pts"] = burn_pts
     # roofline utilization (bench detail.roofline, obs/roofline.py):
     # achieved-vs-peak MXU / HBM fractions are rates — a drop past the
     # threshold is a regression, independently of the raw headline
@@ -468,6 +533,19 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
                 notes.append(f"{side} engine admitted zero requests — "
                              "the deadline-miss gate skipped")
                 break
+        for side, summ in (("baseline", old), ("new", new)):
+            if not (summ["engine"].get("queue_wait_p99_s") or 0) > 0:
+                notes.append(
+                    f"{side} engine queue-wait p99 is zero/absent (pre-"
+                    "quantile artifact generation?) — the p99 gate "
+                    "skipped"
+                )
+                break
+        if ("slo" in old["engine"]) != ("slo" in new["engine"]):
+            side = "baseline" if "slo" in new["engine"] else "new"
+            notes.append(f"SLO accounting missing from the {side} "
+                         "artifact (--slo_ms unset?) — the error-budget "
+                         "burn comparison skipped")
     zero_checks = [
         ("bench", "value", "bench headline value"),
         ("straggler", "occ_frame_iter_s", "straggler occ frame-iter/s"),
@@ -612,6 +690,16 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                 print(f"  engine deadline-miss rate: {d['old']:g} -> "
                       f"{d['new']:g} "
                       f"({delta['engine_deadline_miss_pts']:+.1f} pts)")
+            if delta["engine_queue_wait_p99_pct"] is not None:
+                d = delta["engine_queue_wait_p99"]
+                print(f"  engine queue-wait p99 s: {d['old']:g} -> "
+                      f"{d['new']:g} "
+                      f"({delta['engine_queue_wait_p99_pct']:+.1f}%)")
+            if delta["engine_slo_burn_pts"] is not None:
+                d = delta["engine_slo_burn"]
+                print(f"  engine SLO burn rate: {d['old']:g} -> "
+                      f"{d['new']:g} "
+                      f"({delta['engine_slo_burn_pts']:+.1f} pts)")
         # a gate that did not run must say so — an artifact missing its
         # bench section, a zero baseline — never silently pass
         for note in delta.get("notes", ()):
@@ -709,6 +797,23 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{args.threshold:g}-point threshold.",
                       file=sys.stderr)
                 return 2
+            if (delta["engine_queue_wait_p99_pct"] is not None
+                    and delta["engine_queue_wait_p99_pct"]
+                    > args.threshold):
+                print(f"sartsolve metrics: engine queue-wait p99 "
+                      f"regression "
+                      f"{delta['engine_queue_wait_p99_pct']:+.1f}% "
+                      f"exceeds the {args.threshold:g}% threshold.",
+                      file=sys.stderr)
+                return 2
+            if (delta["engine_slo_burn_pts"] is not None
+                    and delta["engine_slo_burn_pts"] > args.threshold):
+                print(f"sartsolve metrics: engine SLO error-budget "
+                      f"burn rose "
+                      f"{delta['engine_slo_burn_pts']:+.1f} percentage "
+                      f"points, exceeding the {args.threshold:g}-point "
+                      "threshold.", file=sys.stderr)
+                return 2
         return 0
 
     summary = summarize(loaded[0])
@@ -734,7 +839,10 @@ def build_top_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("path", metavar="FILE",
                    help="Prometheus textfile, heartbeat file, or status "
-                        "snapshot JSON to watch.")
+                        "snapshot JSON to watch — or http://host:port "
+                        "of a `sartsolve serve --http_port` engine "
+                        "(rendered from its /status + /metrics "
+                        "endpoints).")
     p.add_argument("--interval", type=float, default=2.0, metavar="S",
                    help="Refresh period in seconds (default 2).")
     p.add_argument("--once", action="store_true",
@@ -752,6 +860,44 @@ def _age_str(path: str) -> str:
         return "?"
 
 
+def _fetch_url(url: str, timeout: float = 3.0) -> str:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace")
+
+
+def _render_endpoint(base: str) -> List[str]:
+    """One screen of a live engine's /status + /metrics endpoints.
+
+    /status supplies the header/engine/sched view (its embedded metric
+    list is skipped — /metrics is the canonical exposition and renders
+    below it). A run with only one endpoint healthy still renders; both
+    unreachable raises OSError, which preserves the ``--once`` exit-1
+    contract for dead engines."""
+    base = base.rstrip("/")
+    lines: List[str] = []
+    status_err: Optional[Exception] = None
+    try:
+        rec = json.loads(_fetch_url(base + "/status"))
+        age = round(time.time() - rec["unix"], 1) if "unix" in rec \
+            else "?"
+        lines += _render_status(base + "/status", rec,
+                                include_metrics=False,
+                                age=f"{age}s ago")
+    except (OSError, ValueError) as err:
+        status_err = err
+    try:
+        text = _fetch_url(base + "/metrics")
+        lines += _render_prom(base + "/metrics", text, age="live")
+    except (OSError, ValueError) as err:
+        if status_err is not None:
+            raise OSError(
+                f"engine endpoints unreachable ({status_err}; {err})"
+            ) from err
+    return lines
+
+
 def _render_heartbeat(path: str, text: str) -> List[str]:
     fields = dict(
         tok.split("=", 1) for tok in text.split() if "=" in tok
@@ -763,8 +909,9 @@ def _render_heartbeat(path: str, text: str) -> List[str]:
     return lines
 
 
-def _render_prom(path: str, text: str) -> List[str]:
-    lines = [f"prometheus {path} (updated {_age_str(path)})"]
+def _render_prom(path: str, text: str,
+                 age: Optional[str] = None) -> List[str]:
+    lines = [f"prometheus {path} (updated {age or _age_str(path)})"]
     for raw in text.splitlines():
         raw = raw.strip()
         if not raw or raw.startswith("#"):
@@ -774,8 +921,9 @@ def _render_prom(path: str, text: str) -> List[str]:
     return lines
 
 
-def _render_status(path: str, rec: dict) -> List[str]:
-    lines = [f"status {path} (snapshot {_age_str(path)})"]
+def _render_status(path: str, rec: dict, include_metrics: bool = True,
+                   age: Optional[str] = None) -> List[str]:
+    lines = [f"status {path} (snapshot {age or _age_str(path)})"]
     lb = rec.get("last_beacon") or {}
     lines.append(f"  frames_done {rec.get('frames_done')}   last beacon "
                  f"{lb.get('phase')} (serial {lb.get('serial')}, "
@@ -804,10 +952,20 @@ def _render_status(path: str, rec: dict) -> List[str]:
                if engine.get("degraded") else "")
             + ("  draining" if engine.get("draining") else "")
         )
-        lines.append(
-            "  engine requests in flight: "
-            + (",".join(str(r) for r in active) if active else "-")
-        )
+        requests = engine.get("requests") or {}
+        if requests:
+            # live request table: id, trace id, current lifecycle span
+            # (docs/OBSERVABILITY.md §10)
+            for rid, info in sorted(requests.items()):
+                lines.append(
+                    f"  engine request {rid}: span "
+                    f"{info.get('span')} trace {info.get('trace')}"
+                )
+        else:
+            lines.append(
+                "  engine requests in flight: "
+                + (",".join(str(r) for r in active) if active else "-")
+            )
         quarantined = engine.get("quarantined_tenants") or []
         tenants = engine.get("tenants") or {}
         if tenants:
@@ -818,19 +976,27 @@ def _render_status(path: str, rec: dict) -> List[str]:
                 + ")"
                 for name, st in tenants.items()
             ))
-    for m in rec.get("metrics") or []:
-        key = _metric_key(m)
-        if m.get("kind") == "histogram":
-            if m.get("count"):
-                lines.append(f"  {key:<44} count {m['count']:g} mean "
-                             f"{m['sum'] / m['count']:.2f}")
-        else:
-            lines.append(f"  {key:<44} {m.get('value', 0):g}")
+    if include_metrics:
+        for m in rec.get("metrics") or []:
+            key = _metric_key(m)
+            if m.get("kind") == "histogram":
+                if m.get("count"):
+                    lines.append(f"  {key:<44} count {m['count']:g} "
+                                 f"mean {m['sum'] / m['count']:.2f}")
+            else:
+                lines.append(f"  {key:<44} {m.get('value', 0):g}")
     return lines
 
 
 def render_top(path: str, max_lines: int = 40) -> str:
-    """One screen of ``path``, whatever kind of live file it is."""
+    """One screen of ``path``, whatever kind of live file — or live
+    engine endpoint (``http://host:port``) — it is."""
+    if path.startswith(("http://", "https://")):
+        lines = _render_endpoint(path)
+        if len(lines) > max_lines:
+            dropped = len(lines) - max_lines
+            lines = lines[:max_lines] + [f"  ... (+{dropped} more)"]
+        return "\n".join(lines)
     with open(path) as f:
         text = f.read()
     stripped = text.lstrip()

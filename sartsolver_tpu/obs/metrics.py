@@ -31,9 +31,60 @@ status dump, a hang is not.
 
 from __future__ import annotations
 
+import math
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from sartsolver_tpu.utils.locking import named_lock, stale_read
+
+# Fixed log-spaced bucket layout shared by EVERY histogram (four buckets
+# per octave over 2^-17 .. 2^17 — ~7.6e-6 to ~1.3e5, which covers
+# microsecond waits through day-long totals at ±~9% resolution when the
+# estimate reports the geometric bucket midpoint). The layout is a
+# module constant, never per-instrument, so bucket counts merge EXACTLY
+# across hosts and artifact generations — the property the moments-only
+# histogram already had and quantile estimates must keep
+# (docs/OBSERVABILITY.md §3). Bucket 0 is the underflow bucket (values
+# at or below 2^-17, zero included); the last bucket is the overflow.
+BUCKETS_PER_OCTAVE = 4
+_BUCKET_MIN_EXP = -17
+_BUCKET_MAX_EXP = 17
+N_BUCKETS = (_BUCKET_MAX_EXP - _BUCKET_MIN_EXP) * BUCKETS_PER_OCTAVE + 2
+
+# The quantiles every histogram estimates (snapshot keys / prom suffixes
+# / `sartsolve metrics` summary fields).
+QUANTILES = ((0.5, "p50"), (0.95, "p95"), (0.99, "p99"))
+
+
+def bucket_index(value: float) -> int:
+    """The fixed-layout bucket holding ``value``."""
+    lo = 2.0 ** _BUCKET_MIN_EXP
+    if not value > lo:  # zero/negative/NaN land in the underflow bucket
+        return 0
+    if math.isinf(value):  # floor(log2(inf)) would raise OverflowError
+        return N_BUCKETS - 1
+    idx = 1 + int(math.floor(
+        (math.log2(value) - _BUCKET_MIN_EXP) * BUCKETS_PER_OCTAVE
+    ))
+    return min(max(idx, 1), N_BUCKETS - 1)
+
+
+def bucket_upper(index: int) -> float:
+    """Upper bound of bucket ``index`` (inf for the overflow bucket)."""
+    if index >= N_BUCKETS - 1:
+        return math.inf
+    return 2.0 ** (_BUCKET_MIN_EXP + index / BUCKETS_PER_OCTAVE)
+
+
+def bucket_mid(index: int) -> float:
+    """Geometric midpoint of bucket ``index`` — the reported quantile
+    estimate (halves the systematic overestimate of the upper bound;
+    the overflow bucket has no midpoint and reports its lower bound)."""
+    if index >= N_BUCKETS - 1:
+        return bucket_upper(N_BUCKETS - 2)
+    if index <= 0:
+        return bucket_upper(0)
+    return 2.0 ** (_BUCKET_MIN_EXP
+                   + (index - 0.5) / BUCKETS_PER_OCTAVE)
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -127,11 +178,15 @@ class Gauge(_Instrument):
 
 
 class Histogram(_Instrument):
-    """Distribution summary: count / sum / min / max.
+    """Distribution summary: count / sum / min / max + fixed buckets.
 
-    Moments only (no buckets): enough for the phase summary, the artifact
-    and a Prometheus summary-style export, and moments merge exactly
-    across hosts — bucket layouts would have to agree fleet-wide.
+    Moments merge exactly across hosts, and so do the bucket counts —
+    the bucket layout is the module-level constant above, never
+    per-instrument, so fleet-wide agreement is structural. Quantiles
+    (p50/p95/p99) are *estimates* derived from the buckets at snapshot
+    time: the reported value is the holding bucket's geometric midpoint
+    clamped into the observed [min, max] range (±~9% at four buckets
+    per octave) — good enough for an SLO gate, exact at the extremes.
     """
 
     kind = "histogram"
@@ -142,19 +197,70 @@ class Histogram(_Instrument):
         self.sum = 0.0  # guarded by: self._lock
         self.min: Optional[float] = None  # guarded by: self._lock
         self.max: Optional[float] = None  # guarded by: self._lock
+        # sparse fixed-layout bucket counts: index -> count
+        self.buckets: Dict[int, int] = {}  # guarded by: self._lock
 
     def observe(self, value: float) -> None:
         value = float(value)
+        idx = bucket_index(value)
         with self._lock:
             self.count += 1
             self.sum += value
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
+            self.buckets[idx] = self.buckets.get(idx, 0) + 1
+
+    def _buckets_copy(self) -> Dict[int, int]:
+        # safe under the lock AND on the lock-free stale fallback
+        # (signal context / the /metrics scrape): copying a dict that a
+        # concurrent observe() is inserting into raises RuntimeError,
+        # which must degrade to a bounded-retry stale read, never
+        # propagate out of a status poke (utils/locking.stale_read —
+        # the one stale-fallback convention)
+        return stale_read(lambda: dict(self.buckets), default={})
+
+    def _quantile_locked(self, q: float, buckets: Dict[int, int]
+                         ) -> Optional[float]:
+        # target mass is the BUCKETED count, not self.count: a merge
+        # from a pre-bucket artifact generation raises count without
+        # bucket mass, and scaling the target to it would push every
+        # estimate to the max — estimate from the bucketed subsample
+        total = sum(buckets.values())
+        if not total:
+            return None
+        target = q * total
+        cum = 0
+        value = self.max
+        for idx in sorted(buckets):
+            cum += buckets[idx]
+            if cum >= target:
+                if idx >= N_BUCKETS - 1:
+                    value = self.max  # overflow: only the max is known
+                elif idx <= 0:
+                    value = self.min  # underflow: only the min is known
+                else:
+                    value = bucket_mid(idx)
+                break
+        if self.min is not None and value is not None:
+            value = max(value, self.min)
+        if self.max is not None and value is not None:
+            value = min(value, self.max)
+        return value
 
     def _snapshot_locked(self) -> dict:
-        return {"kind": self.kind, "name": self.name, "labels": self.labels,
-                "count": self.count, "sum": self.sum,
-                "min": self.min, "max": self.max}
+        # also runs WITHOUT the lock as the stale fallback of
+        # _Instrument.snapshot(blocking=False): the bucket dict is the
+        # one multi-element structure here, so it is copied through the
+        # stale-read convention rather than iterated live
+        buckets = self._buckets_copy()
+        snap = {"kind": self.kind, "name": self.name,
+                "labels": self.labels, "count": self.count,
+                "sum": self.sum, "min": self.min, "max": self.max,
+                "buckets": {str(k): v
+                            for k, v in sorted(buckets.items())}}
+        for q, key in QUANTILES:
+            snap[key] = self._quantile_locked(q, buckets)
+        return snap
 
     def merge(self, snap: dict) -> None:
         with self._lock:
@@ -167,6 +273,11 @@ class Histogram(_Instrument):
                 mine = getattr(self, attr)
                 setattr(self, attr,
                         theirs if mine is None else pick(mine, theirs))
+            # fixed layout -> bucket counts sum exactly; snapshots from
+            # a pre-bucket artifact generation simply contribute none
+            for key, n in (snap.get("buckets") or {}).items():
+                idx = int(key)
+                self.buckets[idx] = self.buckets.get(idx, 0) + int(n)
 
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}

@@ -167,7 +167,12 @@ class RunTelemetry:
         solve_ms: Optional[float],
         group: str,
         error: Optional[str] = None,
+        **extra_fields,
     ) -> None:
+        """``extra_fields`` ride into the frame record verbatim (the
+        schema is open over extras) — the serving engine attaches each
+        frame's request ``trace`` id this way, so FAILED rows in the
+        artifact attribute to a request without a join table."""
         name = status_name(status)
         if self.enabled:
             # the typed per-frame records only ever feed the sinks; with
@@ -176,6 +181,8 @@ class RunTelemetry:
             # cap exists to avoid (the registry aggregates below stay
             # always-on — --timing and the summary read them)
             extra = {"error": error} if error else {}
+            extra.update({k: v for k, v in extra_fields.items()
+                          if v is not None})
             # solver-variant provenance per frame (set_run_info): a frame
             # record never leaves its artifact, but downstream tooling
             # slices/merges artifacts — `sartsolve metrics --diff` must be

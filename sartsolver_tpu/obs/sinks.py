@@ -83,6 +83,16 @@ _HELP = {
         "Collectives in the compiled sweep, by site.",
     "fault_trips_total": "Injected faults tripped (SART_FAULT).",
     "phase_seconds": "Wall-clock per pipeline phase (--timing view).",
+    "engine_queue_wait_s": "Request wait from acceptance to dispatch.",
+    "engine_request_solve_s": "Request wall time in the solver.",
+    "engine_request_latency_s":
+        "Request latency from acceptance to completion.",
+    "engine_slo_ok_total":
+        "Requests finishing within the --slo_ms target.",
+    "engine_slo_breach_total":
+        "Requests finishing past the --slo_ms target (error budget "
+        "burn).",
+    "engine_slo_target_ms": "The serve process's --slo_ms target.",
 }
 
 # Histogram sub-series: what each exported moment is.
@@ -91,6 +101,9 @@ _HIST_SUFFIX = {
     "_sum": "sum of samples",
     "_min": "smallest sample",
     "_max": "largest sample",
+    "_p50": "estimated median, fixed-bucket",
+    "_p95": "estimated 95th percentile, fixed-bucket",
+    "_p99": "estimated 99th percentile, fixed-bucket",
 }
 
 
@@ -155,6 +168,13 @@ def render_prometheus(snapshot: Iterable[dict]) -> str:
                                   ("_sum", "counter"),
                                   ("_min", "gauge"), ("_max", "gauge")):
                 emit(base + suffix, mtype, labels, snap[suffix[1:]],
+                     _help_text(snap["name"], suffix))
+            # fixed-bucket quantile estimates (obs/metrics.py); absent
+            # from snapshots of a pre-bucket artifact generation, and
+            # `emit` drops None values, so old snapshots render as before
+            for suffix in ("_p50", "_p95", "_p99"):
+                emit(base + suffix, "gauge", labels,
+                     snap.get(suffix[1:]),
                      _help_text(snap["name"], suffix))
     lines: List[str] = [
         line for family in families.values() for line in family

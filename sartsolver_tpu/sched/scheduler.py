@@ -67,6 +67,7 @@ import numpy as np
 
 from sartsolver_tpu.config import SDC_DETECTED
 from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience import watchdog
 from sartsolver_tpu.resilience.degrade import (
     dispatch_guarded,
@@ -112,9 +113,10 @@ class _Slot:
     """One occupied lane's host-side bookkeeping."""
 
     __slots__ = ("seq", "frame", "ftime", "cam_times", "it_prev",
-                 "sdc_retries", "deadline")
+                 "sdc_retries", "deadline", "trace")
 
-    def __init__(self, seq, frame, ftime, cam_times, deadline=None):
+    def __init__(self, seq, frame, ftime, cam_times, deadline=None,
+                 trace=None):
         self.seq = seq
         self.frame = frame  # kept for OOM requeue (one [npixel] fp64 row)
         self.ftime = ftime
@@ -124,6 +126,10 @@ class _Slot:
         # docs/SERVING.md), or None — the one-shot CLI's frames carry
         # none and the deadline sweep never touches them
         self.deadline = deadline
+        # request trace id (serving engine, docs/OBSERVABILITY.md §10):
+        # per-stride solve spans land on this request's trace track;
+        # None on CLI frames, where the trace hooks are inert
+        self.trace = trace
         # SDC escalation (docs/RESILIENCE.md §8): how many times this
         # frame was re-queued after an ABFT trip — recompute-once, then
         # the lane fails through the ordered FAILED-row path
@@ -287,6 +293,9 @@ class ContinuousBatcher:
         self._sdc_retry = deque()  # slots awaiting their SDC recompute
         seq = 0
         t_last = time.perf_counter()
+        # request-scoped tracing (serving engine): resolved once per run
+        # — None (the CLI default) keeps the stride loop span-free
+        tracebuf = obs_trace.active_buffer()
 
         def intake():
             """Fill free lanes from the stream; FrameFailure items take a
@@ -321,13 +330,16 @@ class ContinuousBatcher:
                     seq += 1
                     continue
                 # items are (frame, time, camera_times) from the CLI's
-                # prefetcher, or the serving engine's 4th-element form
-                # carrying an absolute monotonic deadline
+                # prefetcher, or the serving engine's extended form with
+                # a 4th element (absolute monotonic deadline) and a 5th
+                # (request trace id)
                 frame, ftime, cam_times = item[0], item[1], item[2]
                 deadline = item[3] if len(item) > 3 else None
+                trace_id = item[4] if len(item) > 4 else None
                 lane = free.popleft()
                 occupied[lane] = _Slot(seq, np.asarray(frame), ftime,
-                                       cam_times, deadline=deadline)
+                                       cam_times, deadline=deadline,
+                                       trace=trace_id)
                 refills.append((lane, occupied[lane].frame))
                 seq += 1
             return refills
@@ -346,6 +358,7 @@ class ContinuousBatcher:
             if not occupied and not refills:
                 self._emit_ready()  # trailing FrameFailure rows
                 break
+            t_stride0 = time.perf_counter()
             try:
                 # the availability wrappers the classic loop gets from
                 # cli.py's dispatch_guarded call: dispatch-phase beacon +
@@ -401,9 +414,11 @@ class ContinuousBatcher:
             # every lane is done, so measure what actually ran
             steps = 0
             useful = 0
+            deltas = {}
             for lane, slot in occupied.items():
                 delta = int(itv[lane]) - slot.it_prev
                 slot.it_prev = int(itv[lane])
+                deltas[lane] = delta
                 steps = max(steps, delta)
                 useful += delta
             stats.loop_steps += steps
@@ -411,6 +426,23 @@ class ContinuousBatcher:
             stats.useful_iters += useful
             if steps:
                 self._occ_hist.observe(useful / (steps * B))
+            if tracebuf is not None:
+                # per-request per-stride solve spans (docs §10): one
+                # complete event per traced lane on its request's track,
+                # covering this dispatch+fetch, with the lane index, the
+                # iterations the lane actually advanced this stride, and
+                # the stride's occupancy
+                t_stride1 = time.perf_counter()
+                occ = (useful / (steps * B)) if steps else 0.0
+                for lane, slot in occupied.items():
+                    if slot.trace:
+                        tracebuf.add_request_complete(
+                            slot.trace, "sched.stride", t_stride0,
+                            t_stride1,
+                            {"lane": lane, "iters": deltas[lane],
+                             "stride": stats.strides,
+                             "occupancy": round(occ, 3)},
+                        )
             # retire: convergence order on device, frame order out
             now = time.perf_counter()
             retired_now = [
@@ -450,6 +482,12 @@ class ContinuousBatcher:
                 fetcher = lane_state.lane_solution_fetcher(lane)
                 stats.solved += 1
                 self._retired_ctr.inc()
+                if tracebuf is not None and slot.trace:
+                    tracebuf.add_request_instant(
+                        slot.trace, "lane.retire",
+                        {"lane": lane, "status": int(status[lane]),
+                         "iterations": int(iters[lane])},
+                    )
                 per_frame_ms = ((now - t_last) * 1e3
                                 / max(len(retired_now), 1))
                 self._emit_buf[slot.seq] = (
@@ -484,6 +522,11 @@ class ContinuousBatcher:
                 fetcher = lane_state.lane_solution_fetcher(lane)
                 stats.deadline_shed += 1
                 self._deadline_ctr.inc()
+                if tracebuf is not None and slot.trace:
+                    tracebuf.add_request_instant(
+                        slot.trace, "deadline.shed",
+                        {"lane": lane, "iterations": int(itv[lane])},
+                    )
                 self._emit_buf[slot.seq] = (
                     "result",
                     (slot.ftime, slot.cam_times, DEADLINE_EXCEEDED,
@@ -522,8 +565,11 @@ class ContinuousBatcher:
     @staticmethod
     def _requeue_item(slot):
         """An in-flight slot back in stream-item form; the engine's
-        deadline (4th element) survives the requeue so the fallback run
-        can still shed it."""
+        deadline (4th element) and trace id (5th) survive the requeue so
+        the fallback run can still shed and attribute it."""
+        if slot.trace is not None:
+            return (slot.frame, slot.ftime, slot.cam_times, slot.deadline,
+                    slot.trace)
         if slot.deadline is not None:
             return (slot.frame, slot.ftime, slot.cam_times, slot.deadline)
         return (slot.frame, slot.ftime, slot.cam_times)

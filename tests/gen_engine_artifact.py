@@ -50,6 +50,10 @@ def run(world_dir: str, artifact: str) -> int:
     return serve_main([
         "--engine_dir", eng, "--use_cpu", "-m", "60", "-c", "1e-8",
         "--lanes", "2", "--idle_exit", "0.5", "--poll_interval", "0.05",
+        # generous SLO target (like the deadlines): a healthy smoke run
+        # burns zero budget, so the --diff burn gate watches a stable
+        # zero baseline; the queue-wait p99 gate rides the same artifact
+        "--slo_ms", "300000",
         "--metrics_out", artifact,
         paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
         paths["img_a"], paths["img_b"],

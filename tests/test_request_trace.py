@@ -1,0 +1,637 @@
+"""Request-lifecycle observatory matrix (docs/OBSERVABILITY.md §10;
+`make trace`).
+
+- **Span completeness** — a real in-process serve run with tracing
+  active yields, for every request, one Perfetto-loadable per-request
+  section holding the full lifecycle: admission -> queue.wait ->
+  journal markers -> session.attach -> sched.stride (with lane index,
+  iterations-this-stride and occupancy) -> lane.retire -> io.write ->
+  request.done; the trace id joins journal markers, response records
+  and frame records.
+- **Scrape parity** — the `--http_port` /metrics endpoint is byte-
+  equivalent to the Prometheus textfile sink rendered from the same
+  registry snapshot; /healthz and /status serve the admission state and
+  the live status snapshot from the non-blocking forms.
+- **Disabled identity** — without `--http_port`/tracing a serve run
+  creates no endpoint, no traces directory and no new threads.
+- **SLO accounting** — fixed-bucket quantile estimates (p50/p95/p99)
+  with exact cross-host merge, the error-budget counter pair, and the
+  `sartsolve metrics --diff` p99 queue-wait / SLO-burn gates
+  (zero-baseline-safe with loud skip notes).
+- **Crash attribution** — the crash bundle's engine section names the
+  in-flight trace ids and their last span; after a SIGKILL the journal
+  markers carry the trace ids of whatever was in flight.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.engine import admission as adm_mod
+from sartsolver_tpu.engine.request import parse_request
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.obs import sinks as obs_sinks
+from sartsolver_tpu.obs import trace as obs_trace
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+SOLVE_FLAGS = ["--use_cpu", "-m", "40", "-c", "1e-12"]
+
+
+# ---------------------------------------------------------------------------
+# trace ids
+# ---------------------------------------------------------------------------
+
+def test_trace_id_passthrough_and_assignment():
+    # client-propagated id rides the payload verbatim
+    req = parse_request('{"id": "a", "trace": "client.span-1"}')
+    assert req.trace == "client.span-1"
+    # absent -> assigned at parse time, stable through to_dict round trip
+    req = parse_request('{"id": "b"}')
+    assert req.trace and len(req.trace) == 16
+    assert parse_request(json.dumps(req.to_dict())).trace == req.trace
+    # malformed ids are a client error, not an engine abort
+    from sartsolver_tpu.engine.request import RequestError
+
+    with pytest.raises(RequestError):
+        parse_request('{"id": "c", "trace": "no spaces"}')
+    with pytest.raises(RequestError):
+        parse_request('{"id": "c", "trace": ""}')
+
+
+# ---------------------------------------------------------------------------
+# quantile estimates (obs/metrics.py fixed buckets)
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantiles_accuracy_and_merge():
+    reg = obs_metrics.MetricsRegistry()
+    h = reg.histogram("w")
+    rng = random.Random(7)
+    vals = [rng.uniform(0.001, 2.0) for _ in range(2000)]
+    for v in vals:
+        h.observe(v)
+    ordered = sorted(vals)
+    snap = h.snapshot()
+    for q, key in obs_metrics.QUANTILES:
+        true = ordered[int(q * len(ordered)) - 1]
+        assert abs(snap[key] / true - 1) < 0.15, (key, snap[key], true)
+    # extremes are exact: estimates clamp into the observed range
+    one = reg.histogram("one")
+    one.observe(0.123)
+    s = one.snapshot()
+    assert s["p50"] == s["p99"] == 0.123
+    # cross-host merge is exact on the fixed layout: merging the same
+    # snapshot twice doubles every bucket and keeps the estimates
+    reg2 = obs_metrics.MetricsRegistry()
+    reg2.merge_snapshot(reg.snapshot())
+    reg2.merge_snapshot(reg.snapshot())
+    h2 = reg2.histogram("w")
+    assert h2.count == 2 * len(vals)
+    assert sum(h2.buckets.values()) == 2 * len(vals)
+    for q, key in obs_metrics.QUANTILES:
+        assert h2.snapshot()[key] == pytest.approx(snap[key])
+    # a pre-bucket snapshot (older artifact generation) merges its
+    # moments and simply contributes no buckets
+    reg2.merge_snapshot([{"kind": "histogram", "name": "w", "labels": {},
+                          "count": 5, "sum": 1.0, "min": 0.1,
+                          "max": 0.5}])
+    assert reg2.histogram("w").count == 2 * len(vals) + 5
+    # zero, overflow and inf land in the edge buckets without error
+    edge = reg.histogram("edge")
+    edge.observe(0.0)
+    edge.observe(1e9)
+    assert edge.snapshot()["p99"] == 1e9  # clamped to max
+    edge.observe(float("inf"))  # previously only moments absorbed inf
+    assert edge.snapshot()["count"] == 3
+    # a merge from a bucket-less generation must not skew the estimate
+    # toward max: quantiles come from the bucketed subsample
+    mixed = obs_metrics.MetricsRegistry().histogram("mix")
+    for _ in range(10):
+        mixed.observe(0.1)
+    mixed.merge({"kind": "histogram", "name": "mix", "labels": {},
+                 "count": 1000, "sum": 10.0, "min": 0.001, "max": 50.0})
+    assert mixed.snapshot()["p50"] == pytest.approx(0.1, rel=0.15)
+
+
+def test_trace_buffer_track_cap():
+    """A saturated buffer stops allocating request tracks (and their
+    metadata rows): a resident server's track table is bounded by the
+    same SART_TRACE_MAX_EVENTS cap as the events."""
+    buf = obs_trace.TraceBuffer(max_events=4)
+    buf.add_request_instant("t1", "a")  # metadata + instant = 2 events
+    buf.add_request_instant("t1", "b")  # 3
+    buf.add_request_instant("t2", "a")  # 4 (track t2's metadata) + drop
+    for i in range(20):
+        buf.add_request_instant(f"late-{i}", "x")  # all dropped
+    assert len(buf._tracks) <= 4
+    chrome = buf.to_chrome()
+    assert len(chrome["traceEvents"]) == 4
+    assert chrome["otherData"]["dropped_events"] >= 20
+    assert buf.request_events("late-5") is None
+
+
+def test_prometheus_renders_quantile_series():
+    reg = obs_metrics.MetricsRegistry()
+    reg.histogram("engine_queue_wait_s").observe(0.25)
+    text = obs_sinks.render_prometheus(reg.snapshot())
+    for suffix in ("_p50", "_p95", "_p99"):
+        assert f"sart_engine_queue_wait_s{suffix}" in text
+        assert f"# HELP sart_engine_queue_wait_s{suffix} " in text
+    # a quantile-less snapshot (older generation) renders without the
+    # series — no None samples, no crash
+    legacy = [{"kind": "histogram", "name": "engine_queue_wait_s",
+               "labels": {}, "count": 1, "sum": 0.25, "min": 0.25,
+               "max": 0.25}]
+    text = obs_sinks.render_prometheus(legacy)
+    assert "_p99" not in text
+
+
+# ---------------------------------------------------------------------------
+# in-process serve run with tracing active: span completeness
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    td = tmp_path_factory.mktemp("trace_world")
+    paths, *_ = fx.write_world(str(td), n_frames=4)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def session(world):
+    from sartsolver_tpu.cli import _validate
+    from sartsolver_tpu.engine.cli import build_serve_parser
+    from sartsolver_tpu.engine.session import ResidentSession
+
+    args = build_serve_parser().parse_args([
+        "--engine_dir", "/nonexistent-unused", *SOLVE_FLAGS,
+        world["rtm_a1"], world["rtm_a2"], world["rtm_b"],
+        world["img_a"], world["img_b"],
+    ])
+    _validate(args)
+    return ResidentSession.build(args)
+
+
+def _run_server(session, eng_dir, requests, **kw):
+    from sartsolver_tpu.engine.server import EngineServer
+
+    os.makedirs(os.path.join(eng_dir, "ingest"), exist_ok=True)
+    for i, payload in enumerate(requests):
+        with open(os.path.join(eng_dir, "ingest",
+                               f"{i:03d}-{payload['id']}.json"),
+                  "w") as f:
+            json.dump(payload, f)
+    admission = kw.pop("admission", None)
+    if admission is None:
+        admission = adm_mod.AdmissionController(max_queue=16)
+    server = EngineServer(
+        session, engine_dir=eng_dir, lanes=kw.pop("lanes", 2),
+        admission=admission, poll_interval=0.05,
+        idle_exit=kw.pop("idle_exit", 0.4), **kw,
+    )
+    rc = server.run()
+    return server, rc
+
+
+def test_serve_run_span_completeness(session, tmp_path):
+    """One traced serve round trip: the request's track holds the full
+    lifecycle and lands as a standalone Perfetto-loadable file; the
+    trace id joins journal markers and the response record."""
+    obs_metrics.reset_registry()
+    buf = obs_trace.install(obs_trace.TraceBuffer())
+    eng = str(tmp_path / "eng")
+    try:
+        server, rc = _run_server(session, eng, [
+            {"id": "traced", "tenant": "a", "trace": "trace-0001"},
+        ])
+    finally:
+        obs_trace.uninstall()
+    assert rc == 0
+
+    # response + journal carry the trace id
+    with open(os.path.join(eng, "responses", "traced.json")) as f:
+        resp = json.load(f)
+    assert resp["trace"] == "trace-0001"
+    assert resp["outcome"]["trace"] == "trace-0001"
+    markers = {}
+    with open(os.path.join(eng, "journal.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            markers[rec["marker"]] = rec
+    for marker in ("accepted", "dispatched", "completed"):
+        assert markers[marker]["trace"] == "trace-0001", marker
+
+    # the per-request section is complete and self-contained
+    payload = buf.request_events("trace-0001")
+    names = [e["name"] for e in payload["traceEvents"]]
+    for expected in ("thread_name", "admission", "queue.wait",
+                     "journal.accepted", "journal.dispatched",
+                     "journal.completed", "session.attach",
+                     "sched.stride", "lane.retire", "io.write",
+                     "request.done"):
+        assert expected in names, (expected, names)
+    # every event sits on the request's one track, tagged with the id
+    tids = {e["tid"] for e in payload["traceEvents"]}
+    assert len(tids) == 1
+    strides = [e for e in payload["traceEvents"]
+               if e["name"] == "sched.stride"]
+    for ev in strides:
+        assert {"lane", "iters", "stride", "occupancy"} <= set(ev["args"])
+        assert ev["args"]["trace"] == "trace-0001"
+    assert sum(e["args"]["iters"] for e in strides) > 0
+    retire = [e for e in payload["traceEvents"]
+              if e["name"] == "lane.retire"]
+    # SUCCESS or MAX_ITERATIONS depending on the tiny world's seed —
+    # what the pin cares about is the per-lane retirement attribution
+    assert retire and retire[0]["args"]["status"] in (0, -1)
+    assert retire[0]["args"]["iterations"] > 0
+
+    # ... and was published next to the outputs, loadable on its own
+    path = os.path.join(eng, "traces", "traced.trace.json")
+    with open(path) as f:
+        published = json.load(f)
+    assert published["otherData"]["trace"] == "trace-0001"
+    assert [e["name"] for e in published["traceEvents"]] == names
+
+
+def test_trace_rides_metrics_artifact(session, tmp_path):
+    """Frame records in the run artifact carry the request trace id
+    (the engine threads it through record_frame; FAILED rows take the
+    same path), so a sliced artifact still attributes every frame to
+    its request."""
+    from sartsolver_tpu.obs.run import RunTelemetry
+
+    obs_metrics.reset_registry()
+    telem = RunTelemetry(jsonl_path=str(tmp_path / "run.jsonl"))
+    eng = str(tmp_path / "eng")
+    server, rc = _run_server(session, eng, [
+        {"id": "ok1", "tenant": "a", "trace": "tr-ok"},
+    ], telemetry=telem)
+    assert rc == 0
+    telem.finalize(None)
+    frames = []
+    with open(str(tmp_path / "run.jsonl")) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "frame":
+                frames.append(rec)
+    assert frames and all(fr["trace"] == "tr-ok" for fr in frames)
+
+
+# ---------------------------------------------------------------------------
+# live endpoints: scrape parity, health states, status, top over http
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=5.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read()
+
+
+def test_scrape_vs_textfile_byte_parity(tmp_path):
+    """/metrics is rendered from the same snapshot by the same renderer
+    as the Prometheus textfile sink — byte-equivalent, family for
+    family (ISSUE acceptance)."""
+    from sartsolver_tpu.engine.httpd import EngineHTTPServer
+
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("frames_total", status="success").inc(3)
+    reg.gauge("engine_lanes").set(2)
+    reg.histogram("engine_queue_wait_s").observe(0.05)
+    reg.histogram("engine_queue_wait_s", tenant="a").observe(0.05)
+    frozen = reg.snapshot()
+
+    srv = EngineHTTPServer(
+        0, metrics_snapshot=lambda: frozen,
+        health=lambda: ("ok", None),
+        status=lambda: {"type": "status"},
+    )
+    srv.start()
+    try:
+        code, scraped = _get(f"http://127.0.0.1:{srv.port}/metrics")
+        assert code == 200
+        prom_path = str(tmp_path / "metrics.prom")
+        obs_sinks.PromSink(prom_path).write(frozen)
+        with open(prom_path, "rb") as f:
+            textfile = f.read()
+        assert scraped == textfile
+        # 404 for anything else
+        with pytest.raises(urllib.error.HTTPError):
+            _get(f"http://127.0.0.1:{srv.port}/nope")
+    finally:
+        srv.stop()
+
+
+def test_endpoints_on_live_engine(session, tmp_path, monkeypatch):
+    """A real serve loop with --http_port: /healthz tracks the
+    admission state (ok -> draining 503), /status carries the engine
+    section, /metrics scrapes, and `sartsolve top http://...` renders
+    live (with the --once exit-1 contract once the engine is gone)."""
+    from sartsolver_tpu.engine.server import EngineServer
+    from sartsolver_tpu.obs import flight as obs_flight
+    from sartsolver_tpu.obs.cli import render_top, top_main
+    from sartsolver_tpu.resilience import shutdown
+
+    obs_metrics.reset_registry()
+    obs_metrics.get_registry().histogram(
+        "engine_queue_wait_s").observe(0.01)
+    eng = str(tmp_path / "eng")
+    os.makedirs(os.path.join(eng, "ingest"), exist_ok=True)
+    server = EngineServer(
+        session, engine_dir=eng, lanes=2,
+        admission=adm_mod.AdmissionController(max_queue=4),
+        poll_interval=0.05, idle_exit=0.0, http_port=0,
+    )
+    stop = {"flag": False}
+    monkeypatch.setattr(shutdown, "stop_requested",
+                        lambda: stop["flag"])
+    t = threading.Thread(target=server.run, daemon=True)
+    t.start()
+    try:
+        deadline = time.monotonic() + 30
+        while server.http is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert server.http is not None
+        base = f"http://127.0.0.1:{server.http.port}"
+        code, body = _get(base + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        code, body = _get(base + "/status")
+        assert code == 200
+        rec = json.loads(body)
+        assert rec["type"] == "status" and "engine" in rec
+        code, body = _get(base + "/metrics")
+        assert code == 200
+        assert b"sart_engine_queue_wait_s_p99" in body
+        # top renders the live endpoint (status header + prom families)
+        screen = render_top(base)
+        assert "engine" in screen and "sart_engine_queue_wait_s" in screen
+        assert top_main([base, "--once"]) == 0
+        stop["flag"] = True
+    finally:
+        stop["flag"] = True
+        t.join(timeout=60)
+    assert not t.is_alive()
+    assert server.http is None  # endpoint torn down with the loop
+    # after the stop the admission state is draining...
+    assert server._health()[0] == "draining"
+    # ...and the /healthz mapping for that state is 503 (pinned on a
+    # standalone endpoint — the live loop exits the same iteration it
+    # flips the flag, so the window is not reliably observable)
+    from sartsolver_tpu.engine.httpd import EngineHTTPServer
+
+    srv = EngineHTTPServer(
+        0, metrics_snapshot=lambda: [], health=server._health,
+        status=lambda: {},
+    )
+    srv.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["status"] == "draining"
+    finally:
+        srv.stop()
+    # unreachable endpoint: the --once probe must report failure
+    assert top_main([f"http://127.0.0.1:1/", "--once"]) == 1
+
+
+def test_http_port_bind_failure_is_input_error(session, tmp_path):
+    """An unbindable --http_port (EADDRINUSE) is a config problem: the
+    serve loop exits with the polite input-error code, not a traceback
+    plus a misleading crash bundle."""
+    import socket
+
+    from sartsolver_tpu.engine.server import EngineServer
+
+    obs_metrics.reset_registry()
+    holder = socket.socket()
+    holder.bind(("127.0.0.1", 0))
+    holder.listen(1)
+    try:
+        server = EngineServer(
+            session, engine_dir=str(tmp_path / "eng"), lanes=2,
+            admission=adm_mod.AdmissionController(max_queue=4),
+            idle_exit=0.2, http_port=holder.getsockname()[1],
+        )
+        assert server.run() == 1
+        assert server.http is None
+    finally:
+        holder.close()
+
+
+def test_disabled_path_identity(session, tmp_path):
+    """Without --http_port/tracing: no traces dir, no endpoint object,
+    no extra threads after the run (ISSUE acceptance)."""
+    obs_metrics.reset_registry()
+    before = threading.active_count()
+    eng = str(tmp_path / "eng")
+    server, rc = _run_server(session, eng, [
+        {"id": "plain", "tenant": "a"},
+    ])
+    assert rc == 0
+    assert server.http is None
+    assert not os.path.exists(os.path.join(eng, "traces"))
+    assert threading.active_count() == before
+    # the lifecycle surfaces stay: trace ids in journal + response even
+    # with the trace BUFFER off (ids are host bookkeeping, spans are
+    # the opt-in part)
+    with open(os.path.join(eng, "responses", "plain.json")) as f:
+        assert json.load(f)["trace"]
+
+
+# ---------------------------------------------------------------------------
+# SLO accounting: counter pair + summarize + --diff gates
+# ---------------------------------------------------------------------------
+
+def test_slo_counter_pair_on_live_run(session, tmp_path):
+    """--slo_ms accounting on a real run: a generous target burns no
+    budget; a 0.001 ms target breaches on every request."""
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    _run_server(session, eng, [{"id": "s1", "tenant": "a"}],
+                slo_ms=10 * 60 * 1000.0)
+    snap = {(s["name"], tuple(sorted(s["labels"].items()))): s
+            for s in obs_metrics.get_registry().snapshot()}
+    assert snap[("engine_slo_ok_total", (("tenant", "a"),))]["value"] == 1
+    assert ("engine_slo_breach_total", (("tenant", "a"),)) not in snap
+
+    obs_metrics.reset_registry()
+    eng2 = str(tmp_path / "eng2")
+    _run_server(session, eng2, [{"id": "s2", "tenant": "b"}],
+                slo_ms=0.001)
+    snap = {(s["name"], tuple(sorted(s["labels"].items()))): s
+            for s in obs_metrics.get_registry().snapshot()}
+    key = ("engine_slo_breach_total", (("tenant", "b"),))
+    assert snap[key]["value"] == 1
+
+
+def _slo_artifact(path, *, p99, breaches=0, oks=10, with_slo=True,
+                  with_quantiles=True):
+    from sartsolver_tpu.obs import schema
+
+    # mean pinned at 0.05 whatever the p99 does: the p99 gate must trip
+    # on a regressed TAIL the mean gate cannot see
+    hist = {"type": "metric", "kind": "histogram",
+            "name": "engine_queue_wait_s", "labels": {},
+            "count": 100, "sum": 100 * 0.05,
+            "min": 0.01, "max": p99}
+    if with_quantiles:
+        hist.update({"p50": 0.05, "p95": 0.08, "p99": p99,
+                     "buckets": {str(obs_metrics.bucket_index(p99)): 100}})
+    records = [
+        schema.make_meta_record(created_unix=1.0),
+        hist,
+        {"type": "metric", "kind": "counter",
+         "name": "engine_admitted_total", "labels": {}, "value": 10},
+        {"type": "metric", "kind": "counter",
+         "name": "engine_deadline_miss_total", "labels": {}, "value": 0},
+    ]
+    if with_slo:
+        records += [
+            {"type": "metric", "kind": "counter",
+             "name": "engine_slo_ok_total", "labels": {"tenant": "a"},
+             "value": oks},
+            {"type": "metric", "kind": "counter",
+             "name": "engine_slo_breach_total",
+             "labels": {"tenant": "a"}, "value": breaches},
+            {"type": "metric", "kind": "gauge",
+             "name": "engine_slo_target_ms", "labels": {},
+             "value": 100.0},
+        ]
+    records.append(schema.make_summary_record(0, {}, wall_s=1.0))
+    with open(path, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_metrics_slo_summary_and_p99_gate(tmp_path, capsys):
+    from sartsolver_tpu.obs.cli import _load, metrics_main, summarize
+
+    old = str(tmp_path / "old.jsonl")
+    new = str(tmp_path / "new.jsonl")
+    _slo_artifact(old, p99=0.1, breaches=0)
+    summary = summarize(_load(old)[0])
+    eng = summary["engine"]
+    assert eng["queue_wait_p99_s"] == pytest.approx(0.1)
+    assert eng["slo"]["burn_rate"] == 0.0
+    assert eng["slo"]["target_ms"] == 100.0
+
+    # p99 within threshold passes; past it trips exit 2 with the named
+    # gate even when the MEAN stays put
+    _slo_artifact(new, p99=0.12, breaches=0)
+    assert metrics_main(["--diff", old, new, "--threshold", "60"]) == 0
+    capsys.readouterr()
+    _slo_artifact(new, p99=0.5, breaches=0)
+    assert metrics_main(["--diff", old, new, "--threshold", "60"]) == 2
+    assert "queue-wait p99" in capsys.readouterr().err
+
+    # SLO burn rising past the point threshold trips its gate
+    _slo_artifact(new, p99=0.1, breaches=9, oks=1)
+    assert metrics_main(["--diff", old, new, "--threshold", "60"]) == 2
+    assert "error-budget burn" in capsys.readouterr().err
+
+    # zero-baseline / pre-quantile artifacts: loud skip note, exit 0
+    legacy = str(tmp_path / "legacy.jsonl")
+    _slo_artifact(legacy, p99=0.1, with_slo=False, with_quantiles=False)
+    assert metrics_main(["--diff", legacy, new, "--threshold",
+                         "1000"]) == 0
+    err = capsys.readouterr().err
+    assert "p99" in err and "skipped" in err
+    assert "SLO accounting missing" in err
+
+
+# ---------------------------------------------------------------------------
+# crash attribution: bundle engine section + SIGKILL journal triage
+# ---------------------------------------------------------------------------
+
+def test_crash_bundle_names_inflight_traces(session, tmp_path):
+    """The crash bundle's engine section carries the live request
+    table — id, trace id, last span — through the non-blocking
+    status-snapshot path the watchdog crash hook uses (the stage-3
+    os._exit leg writes exactly this record)."""
+    from sartsolver_tpu.engine.server import EngineServer
+    from sartsolver_tpu.obs import flight as obs_flight
+    from sartsolver_tpu.resilience import watchdog
+
+    obs_metrics.reset_registry()
+    server = EngineServer(
+        session, engine_dir=str(tmp_path / "eng"), lanes=2,
+        admission=adm_mod.AdmissionController(max_queue=4),
+    )
+    req = parse_request('{"id": "wedged", "trace": "tr-wedged"}')
+    server._set_span(req, "solve")
+    server._active_ids.append("wedged")
+    watchdog.set_engine_status_provider(server._status)
+    try:
+        bundle_path = str(tmp_path / "crash.json")
+        assert obs_flight.write_crash_bundle(bundle_path,
+                                             "watchdog abort (drill)")
+        with open(bundle_path) as f:
+            bundle = json.load(f)
+        table = bundle["status"]["engine"]["requests"]
+        assert table["wedged"] == {"trace": "tr-wedged", "span": "solve"}
+        assert "wedged" in bundle["status"]["engine"]["active_requests"]
+    finally:
+        watchdog.set_engine_status_provider(None)
+
+
+def test_sigkill_journal_names_inflight_trace(tmp_path):
+    """SIGKILL a real serve inside the dispatched journal window;
+    triage reads the journal: the in-flight request's accepted and
+    dispatched markers carry its trace id, the completed marker is
+    absent — "which requests were in flight when it died"."""
+    td = tmp_path / "world"
+    td.mkdir()
+    paths, *_ = fx.write_world(str(td), n_frames=3)
+    eng = str(tmp_path / "eng")
+    os.makedirs(os.path.join(eng, "ingest"))
+    with open(os.path.join(eng, "ingest", "0-k.json"), "w") as f:
+        json.dump({"id": "kill1", "trace": "tr-kill1"}, f)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["SART_TEST_JOURNAL_DELAY"] = "1.5"
+    env.pop("SART_FAULT", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "sartsolver_tpu.cli", "serve",
+         "--engine_dir", eng, *SOLVE_FLAGS, "--lanes", "2",
+         "--poll_interval", "0.05",
+         paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+         paths["img_a"], paths["img_b"]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        deadline = time.monotonic() + 300
+        for line in proc.stdout:
+            if "SART_JOURNAL_POINT dispatched" in line:
+                proc.kill()
+                break
+            assert time.monotonic() < deadline, "no dispatched window"
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    markers = []
+    with open(os.path.join(eng, "journal.jsonl")) as f:
+        for line in f:
+            try:
+                markers.append(json.loads(line))
+            except ValueError:
+                pass  # torn tail: the kill window's own contract
+    by_marker = {m["marker"]: m for m in markers}
+    assert by_marker["accepted"]["trace"] == "tr-kill1"
+    assert by_marker["dispatched"]["trace"] == "tr-kill1"
+    assert "completed" not in by_marker
