@@ -39,7 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
                "Prometheus textfile / status snapshot; `sartsolve serve` "
                "/ `sartsolve submit` — resident serving engine with "
                "admission control, deadlines and a crash-recoverable "
-               "request journal (docs/SERVING.md). A running solve "
+               "request journal (docs/SERVING.md; `serve --supervised` "
+               "adds self-healing restarts); `sartsolve chaos` — "
+               "randomized fault/kill campaign proving the supervised "
+               "engine's exactly-once and byte-identity invariants. "
+               "A running solve "
                "answers SIGUSR1 with a status snapshot on stderr and "
                "<output>.status.json, and flushes a flight bundle "
                "(<output>.crash.json) on abnormal exits. "
@@ -408,6 +412,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.engine.cli import submit_main
 
         return submit_main(argv[1:])
+    if argv and argv[0] == "chaos":
+        # chaos campaign harness (docs/SERVING.md §9): seeded fault
+        # schedules + SIGKILLs against a real supervised serve, judged
+        # on the exactly-once / byte-identity / restart-budget /
+        # state-continuity invariants
+        from sartsolver_tpu.resilience.chaos import chaos_main
+
+        return chaos_main(argv[1:])
     try:
         args = build_parser().parse_args(argv)
     except SystemExit as err:

@@ -87,8 +87,10 @@ class AdmissionController:
         # at reduced pressure instead of hard-failing everything
         self.degraded_admit_below = 0.5
         # ids ever accepted or completed this engine lifetime (duplicate
-        # rejection = the idempotency half of exactly-once)
-        self._seen_ids: set = set()
+        # rejection = the idempotency half of exactly-once). Insertion-
+        # ordered so the checkpoint can export the newest N (below)
+        # instead of re-serializing an ever-growing set per save.
+        self._seen_ids: Dict[str, None] = {}
         registry = obs_metrics.get_registry()
         self._admitted_ctr = registry.counter("engine_admitted_total")
         self._shed_ctrs = {
@@ -118,7 +120,7 @@ class AdmissionController:
     def note_seen(self, request_id: str) -> None:
         """Record an id as taken (journal replay seeds completed and
         pending ids here so restarts keep rejecting duplicates)."""
-        self._seen_ids.add(str(request_id))
+        self._seen_ids[str(request_id)] = None
 
     def shed(self, reason: str) -> None:
         """Count one shed verdict (the server calls this for rejections
@@ -173,7 +175,7 @@ class AdmissionController:
         if self.max_per_tenant and tenant.queued >= self.max_per_tenant:
             self.shed(reqmod.REASON_TENANT_QUOTA)
             return reqmod.REASON_TENANT_QUOTA
-        self._seen_ids.add(request.id)
+        self._seen_ids[request.id] = None
         tenant.queued += 1
         self.queue_depth += 1
         self._admitted_ctr.inc()
@@ -213,6 +215,76 @@ class AdmissionController:
         elif outcome == reqmod.REQ_COMPLETED:
             tenant.failures = 0
         # deadline sheds leave the streak untouched (module docstring)
+        self._quarantined_gauge.set(
+            float(len(self.quarantined_tenants()))
+        )
+
+    # ---- durable state (engine/state.py; docs/SERVING.md §9) -------------
+
+    def quarantine_left_s(self, tenant: str) -> float:
+        """Remaining quarantine cooldown for ``tenant`` (0 when clear) —
+        the `retry_after_s` hint for tenant-quarantined rejections."""
+        state = self._tenants.get(tenant)
+        if state is None:
+            return 0.0
+        return max(0.0, state.quarantined_until - self._clock())
+
+    def export_state(self) -> dict:
+        """Checkpoint payload: tenant streaks/cooldowns, the dedup
+        watermark, degraded reason. Quarantine deadlines are exported as
+        *wall-clock* epochs so the downtime between a crash and the
+        restart counts against the cooldown (the monotonic clock does
+        not survive the process).
+
+        The watermark is bounded to the NEWEST ``SART_STATE_SEEN_CAP``
+        ids (default 100000): the checkpoint writes at every outcome
+        boundary, and re-serializing an unbounded lifetime id set would
+        make each save — and total checkpoint I/O — grow with traffic.
+        Post-compaction dedup is therefore guaranteed for the most
+        recent cap-many ids; older ones stay covered by the journal
+        until it compacts (docs/SERVING.md §9)."""
+        import os as _os
+
+        try:
+            cap = max(int(_os.environ.get("SART_STATE_SEEN_CAP",
+                                          "100000")), 1)
+        except ValueError:
+            cap = 100000
+        now_mono = self._clock()
+        now_unix = time.time()
+        tenants = {}
+        for name, st in self._tenants.items():
+            left = st.quarantined_until - now_mono
+            rec = {"failures": int(st.failures),
+                   "quarantined_unix": (round(now_unix + left, 3)
+                                        if left > 0 else 0.0)}
+            if rec["failures"] or rec["quarantined_unix"]:
+                tenants[name] = rec
+        return {
+            "tenants": tenants,
+            "seen_ids": list(self._seen_ids)[-cap:],
+            "degraded_reason": self.degraded_reason,
+        }
+
+    def restore_state(self, state: dict) -> None:
+        """Fold a checkpoint back in (restart path): a tenant
+        quarantined when the process died stays quarantined for
+        whatever cooldown its wall-clock deadline still holds."""
+        now_mono = self._clock()
+        now_unix = time.time()
+        for name, rec in (state.get("tenants") or {}).items():
+            st = self._tenant(str(name))
+            st.failures = max(st.failures, int(rec.get("failures", 0)))
+            q_unix = float(rec.get("quarantined_unix", 0.0) or 0.0)
+            left = q_unix - now_unix
+            if left > 0:
+                st.quarantined_until = max(st.quarantined_until,
+                                           now_mono + left)
+        for rid in state.get("seen_ids") or ():
+            self._seen_ids[str(rid)] = None
+        reason = state.get("degraded_reason")
+        if reason and self.degraded_reason is None:
+            self.set_degraded(str(reason))
         self._quarantined_gauge.set(
             float(len(self.quarantined_tenants()))
         )

@@ -106,15 +106,111 @@ def build_serve_parser() -> argparse.ArgumentParser:
                           "included); tracked as the engine_slo_ok/"
                           "breach counter pair per tenant (error-budget "
                           "burn). Default: no SLO accounting.")
+    eng.add_argument("--journal_rotate_bytes", type=int,
+                     default=64 * 2 ** 20, metavar="N",
+                     help="Journal rotation: compact completed-request "
+                          "records at startup and whenever the journal "
+                          "passes N bytes (the dedup watermark moves "
+                          "into the state checkpoint first). 0 disables "
+                          "rotation AND startup compaction. Default "
+                          "64 MiB.")
+    eng.add_argument("--response_ttl", type=float, default=7 * 86400.0,
+                     metavar="S",
+                     help="Retention sweep: delete response files older "
+                          "than S seconds (0 = keep forever). Default "
+                          "604800 (7 days).")
+    eng.add_argument("--trace_ttl", type=float, default=86400.0,
+                     metavar="S",
+                     help="Retention sweep: delete per-request trace "
+                          "files older than S seconds (0 = keep "
+                          "forever). Default 86400 (1 day).")
+    sup = p.add_argument_group(
+        "supervision (docs/SERVING.md §9, docs/RESILIENCE.md §10)"
+    )
+    sup.add_argument("--supervised", action="store_true",
+                     help="Run self-healing: a jax-free supervisor "
+                          "process forks the serve worker and restarts "
+                          "it across every abnormal exit (bounded "
+                          "exponential backoff + crash-loop circuit "
+                          "breaker -> lame-duck 503s). Deliberate exits "
+                          "(0 idle, 4 drained, 1 config error) are "
+                          "final.")
+    sup.add_argument("--restart_backoff", type=float, default=1.0,
+                     metavar="S",
+                     help="Base respawn delay after a crash; doubles "
+                          "per consecutive crash. Default 1.")
+    sup.add_argument("--restart_backoff_max", type=float, default=30.0,
+                     metavar="S",
+                     help="Respawn delay ceiling. Default 30.")
+    sup.add_argument("--crash_loop_window", type=float, default=60.0,
+                     metavar="S",
+                     help="Crash-loop breaker sliding window. Default "
+                          "60.")
+    sup.add_argument("--crash_loop_threshold", type=int, default=5,
+                     metavar="N",
+                     help="Crashes inside the window that open the "
+                          "breaker (lame-duck mode: /healthz 503 + "
+                          "machine-readable crash-loop rejections until "
+                          "the window clears). Default 5.")
+    sup.add_argument("--max_restarts", type=int, default=0, metavar="N",
+                     help="Total restart budget; exhausted -> the "
+                          "supervisor gives up with exit 3. 0 = "
+                          "unlimited (default).")
     return p
 
 
 def serve_main(argv: Optional[List[str]] = None) -> int:
+    raw_argv = list(argv) if argv is not None else list(sys.argv[1:])
+    if raw_argv[:1] == ["serve"]:  # direct serve_main(None) invocation
+        raw_argv = raw_argv[1:]
     parser = build_serve_parser()
     try:
-        args = parser.parse_args(argv)
+        args = parser.parse_args(raw_argv)
     except SystemExit as err:
         raise SystemExit(1 if err.code else 0) from None
+
+    if (args.restart_backoff < 0 or args.restart_backoff_max < 0
+            or args.crash_loop_window <= 0):
+        print("Arguments restart_backoff/restart_backoff_max must be "
+              ">= 0 and crash_loop_window > 0.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if args.crash_loop_threshold < 1 or args.max_restarts < 0:
+        print("Argument crash_loop_threshold must be >= 1 and "
+              "max_restarts >= 0.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if (args.journal_rotate_bytes < 0 or args.response_ttl < 0
+            or args.trace_ttl < 0):
+        print("Arguments journal_rotate_bytes/response_ttl/trace_ttl "
+              "must be >= 0.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+
+    if args.supervised:
+        # the supervisor is deliberately jax-free: it must stay alive
+        # through exactly the failures that can wedge a jax process
+        from sartsolver_tpu.resilience.supervisor import supervisor_main
+
+        # argparse accepts unambiguous prefixes ("--super" parses as
+        # --supervised): strip every token that resolved to the flag, or
+        # the worker would parse as supervised too and spawn supervisors
+        # recursively. "--su" is the shortest unambiguous prefix here.
+        worker_argv = [
+            a for a in raw_argv
+            if not (len(a) >= 4 and "--supervised".startswith(a))
+        ]
+        return supervisor_main(args, worker_argv)
+
+    # Deterministic crash hook for the restart-storm drill (tests/
+    # test_selfheal.py): while the marker file exists the WORKER dies
+    # abnormally right after flag parsing — fast enough to trip the
+    # supervisor's crash-loop breaker on schedule. Sits after the
+    # --supervised dispatch so the supervisor itself never fires it.
+    # Zero work unset.
+    crash_marker = os.environ.get("SART_TEST_SERVE_CRASH")
+    if crash_marker and os.path.exists(crash_marker):
+        print("SART_TEST_SERVE_CRASH firing (exit 3)", file=sys.stderr,
+              flush=True)
+        os._exit(3)
+
     from sartsolver_tpu.cli import _validate
 
     _validate(args)
@@ -204,6 +300,9 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             telemetry=telem,
             http_port=args.http_port,
             slo_ms=args.slo_ms,
+            journal_rotate_bytes=args.journal_rotate_bytes,
+            response_ttl_s=args.response_ttl,
+            trace_ttl_s=args.trace_ttl,
         )
         code = server.run()
         if code == EXIT_INTERRUPTED:
@@ -279,17 +378,33 @@ def build_submit_parser() -> argparse.ArgumentParser:
     p.add_argument("--wait", type=float, default=0.0, metavar="S",
                    help="Wait up to S seconds for the outcome response "
                         "(needs --engine_dir; 0 = do not wait).")
+    p.add_argument("--retry", type=int, default=0, metavar="N",
+                   help="On a retryable rejection (queue-full, "
+                        "tenant-quota, degraded, draining, "
+                        "tenant-quarantined, crash-loop) resubmit up "
+                        "to N times with "
+                        "bounded backoff, honoring the engine's "
+                        "retry_after_s hint (resilience/retry.py "
+                        "policy bounds the total via "
+                        "SART_RETRY_DEADLINE). Needs a verdict: "
+                        "--socket, or --engine_dir with --wait. "
+                        "Default 0 (no retry).")
     return p
 
 
-def _outcome_exit(rec: dict) -> int:
+def _outcome_exit(rec: dict, echo: bool = True) -> int:
+    """Exit code for a verdict/outcome record; ``echo=False`` defers
+    the stdout JSON to the caller (the --retry loop prints only the
+    FINAL record, not every rejected attempt)."""
     if rec.get("verdict") == "rejected":
         reason = rec.get("reason")
-        print(json.dumps(rec))
+        if echo:
+            print(json.dumps(rec))
         return (EXIT_INPUT_ERROR if reason == REASON_MALFORMED
                 else EXIT_INFRASTRUCTURE)
     outcome = rec.get("outcome") or {}
-    print(json.dumps(rec))
+    if echo:
+        print(json.dumps(rec))
     state = rec.get("state")
     if state == "interrupted":
         return EXIT_INTERRUPTED
@@ -351,13 +466,67 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
         print(err, file=sys.stderr)
         return EXIT_INPUT_ERROR
 
+    if args.retry < 0:
+        print("sartsolve submit: --retry must be >= 0.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if args.retry and args.engine_dir is not None and args.wait <= 0:
+        print("sartsolve submit: --retry needs a verdict to judge — "
+              "use --socket, or --engine_dir with --wait.",
+              file=sys.stderr)
+        return EXIT_INPUT_ERROR
+
+    if args.retry:
+        from sartsolver_tpu.engine.request import RETRYABLE_REASONS
+        from sartsolver_tpu.resilience.faults import site_seed
+        from sartsolver_tpu.resilience.retry import RetryPolicy
+
+        import numpy as np
+
+        # backpressure etiquette (docs/SERVING.md §3): a lame-duck or
+        # saturated engine tells clients how long to back off; the
+        # shared retry policy bounds the total (SART_RETRY_DEADLINE)
+        # and supplies the jittered floor when no hint arrives
+        policy = RetryPolicy.from_env()
+        rng = np.random.default_rng(
+            [site_seed("submit.retry"), os.getpid()]
+        )
+        start = time.monotonic()
+        for attempt in range(args.retry + 1):
+            rec, code = _submit_attempt(args, req, payload_text)
+            reason = (rec or {}).get("reason")
+            retryable = (rec is not None
+                         and rec.get("verdict") == "rejected"
+                         and reason in RETRYABLE_REASONS)
+            if (not retryable or attempt >= args.retry
+                    or time.monotonic() - start >= policy.deadline):
+                if rec is not None:
+                    print(json.dumps(rec))
+                return code
+            hint = float(rec.get("retry_after_s") or 0.0)
+            delay = max(hint, policy.backoff(attempt + 1, rng))
+            print(f"sartsolve submit: rejected ({reason}); retry "
+                  f"{attempt + 1}/{args.retry} in {delay:.1f}s",
+                  file=sys.stderr)
+            time.sleep(delay)
+        return EXIT_INFRASTRUCTURE  # pragma: no cover - loop returns
+
+    rec, code = _submit_attempt(args, req, payload_text)
+    if rec is not None:
+        print(json.dumps(rec))
+    return code
+
+
+def _submit_attempt(args, req, payload_text):
+    """One submission round trip. Returns ``(record, exit_code)`` —
+    record is the verdict/outcome JSON to print (None when the failure
+    already printed its own stderr message)."""
     if args.socket:
         import socket as socketmod
 
         if not hasattr(socketmod, "AF_UNIX"):
             print("sartsolve submit: AF_UNIX sockets unavailable on "
                   "this platform; use --engine_dir.", file=sys.stderr)
-            return EXIT_INFRASTRUCTURE
+            return None, EXIT_INFRASTRUCTURE
         try:
             sock = socketmod.socket(socketmod.AF_UNIX,
                                     socketmod.SOCK_STREAM)
@@ -375,14 +544,14 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
         except OSError as err:
             print(f"sartsolve submit: socket submit failed: {err}",
                   file=sys.stderr)
-            return EXIT_INFRASTRUCTURE
+            return None, EXIT_INFRASTRUCTURE
         try:
             rec = json.loads(b"".join(chunks).decode())
         except ValueError:
             print("sartsolve submit: unreadable engine reply.",
                   file=sys.stderr)
-            return EXIT_INFRASTRUCTURE
-        return _outcome_exit(rec)
+            return None, EXIT_INFRASTRUCTURE
+        return rec, _outcome_exit(rec, echo=False)
 
     ingest = os.path.join(args.engine_dir, "ingest")
     responses = os.path.join(args.engine_dir, "responses")
@@ -390,7 +559,7 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
         print(f"sartsolve submit: no engine ingest dir at {ingest} "
               "(is `sartsolve serve` running with this --engine_dir?).",
               file=sys.stderr)
-        return EXIT_INFRASTRUCTURE
+        return None, EXIT_INFRASTRUCTURE
     t_submit = time.time()
     tmp = os.path.join(ingest, f".{req.id}.{os.getpid()}.tmp")
     final = os.path.join(ingest, f"{req.id}.json")
@@ -400,13 +569,12 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
         os.replace(tmp, final)
     except OSError as err:
         print(f"sartsolve submit: submit failed: {err}", file=sys.stderr)
-        return EXIT_INFRASTRUCTURE
+        return None, EXIT_INFRASTRUCTURE
     if args.wait <= 0:
         rec = {"id": req.id, "state": "submitted"}
         if args.trace is not None:
             rec["trace"] = args.trace
-        print(json.dumps(rec))
-        return EXIT_OK
+        return rec, EXIT_OK
     resp_path = os.path.join(responses, f"{req.id}.json")
     deadline = time.monotonic() + args.wait
     while time.monotonic() < deadline:
@@ -421,8 +589,8 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
         if rec and rec.get("unix", 0) >= t_submit - 0.05:
             if (rec.get("verdict") == "rejected"
                     or rec.get("state") in ("done", "interrupted")):
-                return _outcome_exit(rec)
+                return rec, _outcome_exit(rec, echo=False)
         time.sleep(0.1)
     print(f"sartsolve submit: no outcome for {req.id!r} within "
           f"{args.wait:g}s.", file=sys.stderr)
-    return EXIT_INFRASTRUCTURE
+    return None, EXIT_INFRASTRUCTURE

@@ -8,9 +8,14 @@ one daemon thread exposing three read-only surfaces:
   textfile sink (:func:`sartsolver_tpu.obs.sinks.render_prometheus`), so
   a scrape is family-for-family byte-equivalent to the textfile written
   from the same snapshot — pinned by tests/test_request_trace.py.
-- ``/healthz`` — the admission state as one word: ``ok`` (200),
-  ``degraded`` (200 — still serving, shedding load), ``draining`` (503 —
-  stop requested, resubmit elsewhere).
+- ``/healthz`` — LIVENESS: the serve worker answering at all is
+  ``live`` (200). The supervisor's lame-duck stand-in answers
+  ``crash-loop`` (503) on the same path — there the worker is genuinely
+  not alive (docs/SERVING.md §9).
+- ``/readyz`` — READINESS: ``ready`` (200) or ``not-ready`` (503) with
+  a byte-stable machine-readable ``reason`` (``draining`` /
+  ``degraded`` / ``crash-loop``) — the signal an external load balancer
+  or supervisor gates traffic on.
 - ``/status`` — the SIGUSR1 status snapshot JSON
   (:func:`sartsolver_tpu.obs.flight.status_snapshot`) with the engine
   section's active request ids, trace ids and current spans.
@@ -35,11 +40,17 @@ class EngineHTTPServer:
     """The engine's scrape endpoint: bind, serve in a daemon thread.
 
     ``metrics_snapshot`` returns a registry snapshot list (non-blocking
-    form), ``health`` returns ``(state, detail)`` with state one of
-    ok/degraded/draining, ``status`` returns the status-snapshot record.
-    ``port=0`` binds an ephemeral port (tests); :attr:`port` reports the
-    bound one.
+    form); ``health`` returns ``(state, detail)`` — 200 for the live
+    states (``live``/``ok``/``degraded``), 503 otherwise; ``ready``
+    returns ``(reason, detail)`` with reason None = ready (200), else
+    the byte-stable not-ready reason (503); ``status`` returns the
+    status-snapshot record. ``port=0`` binds an ephemeral port (tests);
+    :attr:`port` reports the bound one.
     """
+
+    # health states answered 200; anything else (draining on a
+    # legacy health callable, crash-loop from the supervisor) is 503
+    LIVE_STATES = ("live", "ok", "degraded")
 
     def __init__(
         self,
@@ -48,10 +59,14 @@ class EngineHTTPServer:
         metrics_snapshot: Callable[[], list],
         health: Callable[[], Tuple[str, Optional[str]]],
         status: Callable[[], dict],
+        ready: Optional[
+            Callable[[], Tuple[Optional[str], Optional[str]]]
+        ] = None,
         host: str = "127.0.0.1",
     ):
         self._metrics_snapshot = metrics_snapshot
         self._health = health
+        self._ready = ready
         self._status = status
         outer = self
 
@@ -89,7 +104,20 @@ class EngineHTTPServer:
                         rec = {"status": state}
                         if detail:
                             rec["detail"] = detail
-                        code = 503 if state == "draining" else 200
+                        code = (200 if state in outer.LIVE_STATES
+                                else 503)
+                        self._send(code,
+                                   (json.dumps(rec) + "\n").encode(),
+                                   "application/json")
+                    elif path == "/readyz" and outer._ready is not None:
+                        reason, detail = outer._ready()
+                        if reason is None:
+                            rec, code = {"status": "ready"}, 200
+                        else:
+                            rec, code = {"status": "not-ready",
+                                         "reason": reason}, 503
+                            if detail:
+                                rec["detail"] = detail
                         self._send(code,
                                    (json.dumps(rec) + "\n").encode(),
                                    "application/json")

@@ -181,6 +181,50 @@ class RequestJournal:
                         accepted[rid] = req
                         order.append(rid)
                 elif marker == MARKER_COMPLETED:
-                    completed[rid] = rec.get("outcome") or {}
+                    outcome = dict(rec.get("outcome") or {})
+                    if outcome:
+                        # the marker's wall-clock rides along so replay
+                        # consumers can age-gate (e.g. the server's
+                        # response republish vs its retention TTL)
+                        outcome.setdefault("journal_unix",
+                                           rec.get("unix"))
+                    completed[rid] = outcome
         pending = [accepted[rid] for rid in order if rid not in completed]
         return completed, pending
+
+    # ---- rotation --------------------------------------------------------
+
+    def size(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    def compact(self) -> int:
+        """Rewrite the journal keeping only the *pending* story: one
+        fresh ``accepted`` marker per accepted-but-not-completed request
+        (acceptance order preserved). Completed records are dropped —
+        which is only safe once their ids are durable in the engine
+        state checkpoint's dedup watermark (engine/state.py), so the
+        server always checkpoints BEFORE compacting. Atomic rename, so
+        a kill mid-compaction leaves the previous journal intact.
+        Returns the bytes reclaimed (0 when nothing to do)."""
+        before = self.size()
+        if before == 0:
+            return 0
+        completed, pending = self.replay()
+        lines = []
+        for req in pending:
+            rec = {"marker": MARKER_ACCEPTED, "id": req.id,
+                   "unix": round(time.time(), 3)}
+            if req.trace:
+                rec["trace"] = req.trace
+            rec["request"] = req.to_dict()
+            lines.append(json.dumps(rec) + "\n")
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.writelines(lines)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        return max(0, before - self.size())

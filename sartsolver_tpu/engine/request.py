@@ -33,11 +33,21 @@ REASON_TENANT_QUARANTINED = "tenant-quarantined"  # failure quarantine active
 REASON_DRAINING = "draining"                # engine is stopping (SIGTERM)
 REASON_DEGRADED = "degraded"                # load-shed mode (e.g. after OOM)
 REASON_DUPLICATE = "duplicate-id"           # id already accepted or completed
+REASON_CRASH_LOOP = "crash-loop"            # supervisor breaker open (lame duck)
 
 SHED_REASONS = (
     REASON_MALFORMED, REASON_QUEUE_FULL, REASON_TENANT_QUOTA,
     REASON_TENANT_QUARANTINED, REASON_DRAINING, REASON_DEGRADED,
-    REASON_DUPLICATE,
+    REASON_DUPLICATE, REASON_CRASH_LOOP,
+)
+
+# Rejections a client should retry after backing off (`sartsolve submit
+# --retry`): transient pool pressure, not a problem with the request.
+# The matching responses carry a `retry_after_s` hint derived from the
+# queue depth / quarantine cooldown / circuit-breaker window.
+RETRYABLE_REASONS = (
+    REASON_QUEUE_FULL, REASON_TENANT_QUOTA, REASON_DEGRADED,
+    REASON_DRAINING, REASON_TENANT_QUARANTINED, REASON_CRASH_LOOP,
 )
 
 # ---- terminal request outcomes (journal / response records) ---------------

@@ -114,6 +114,9 @@ class EngineServer:
         telemetry=None,
         http_port: Optional[int] = None,
         slo_ms: Optional[float] = None,
+        journal_rotate_bytes: int = 64 * 2 ** 20,
+        response_ttl_s: float = 7 * 86400.0,
+        trace_ttl_s: float = 86400.0,
     ):
         if lanes < 1:
             raise ValueError("lanes must be >= 1.")
@@ -127,6 +130,17 @@ class EngineServer:
             os.makedirs(d, exist_ok=True)
         self.journal = RequestJournal(os.path.join(engine_dir,
                                                    "journal.jsonl"))
+        # durable soft state (docs/SERVING.md §9): tenant quarantine,
+        # lane ladder, SLO counters, dedup watermark — restored in run()
+        from sartsolver_tpu.engine.state import StateStore
+
+        self.state = StateStore(os.path.join(engine_dir, "state.jsonl"))
+        # retention knobs (satellite: unbounded append-only files are a
+        # slow-motion outage): 0 disables the matching sweep/rotation
+        self.journal_rotate_bytes = max(0, int(journal_rotate_bytes))
+        self.response_ttl_s = max(0.0, float(response_ttl_s))
+        self.trace_ttl_s = max(0.0, float(trace_ttl_s))
+        self._last_sweep = 0.0
         self.admission = admission if admission is not None \
             else AdmissionController(on_event=self._event)
         if self.admission._on_event is None:
@@ -266,6 +280,18 @@ class EngineServer:
         path = os.path.join(self.responses_dir, f"{key}.json")
         tmp = f"{path}.{os.getpid()}.tmp"
         payload = {"unix": round(time.time(), 3), **payload}
+        delay = os.environ.get("SART_TEST_RESPONSE_DELAY")
+        if delay:
+            # chaos-harness crash window (mirrors SART_TEST_JOURNAL_DELAY):
+            # a SIGKILL in here dies with the response not yet published —
+            # replay must republish it from the journaled outcome. The
+            # state rides the marker so the harness can target the
+            # completion-response write specifically (the window where
+            # the completed marker is durable but the response is not)
+            sys.stderr.write(f"SART_RESPONSE_POINT {key} "
+                             f"state={payload.get('state', 'none')}\n")
+            sys.stderr.flush()
+            time.sleep(float(delay))
         try:
             with open(tmp, "w") as f:
                 json.dump(payload, f)
@@ -308,6 +334,14 @@ class EngineServer:
                 rec = {"id": req.id, "verdict": "rejected",
                        "reason": reason, "tenant": req.tenant,
                        "trace": req.trace, "source": source}
+                # backpressure hint: clients of a loaded/draining engine
+                # should back off, not hammer (`submit --retry` honors it)
+                if reason == reqmod.REASON_TENANT_QUARANTINED:
+                    hint = self.admission.quarantine_left_s(req.tenant)
+                else:
+                    hint = self._retry_after(reason)
+                if hint:
+                    rec["retry_after_s"] = round(float(hint), 1)
         obs_trace.request_instant(
             req.trace, "admission",
             verdict=("accepted" if reason is None else "rejected"),
@@ -432,12 +466,191 @@ class EngineServer:
             except OSError:
                 pass
 
+    # ---- durable soft state (engine/state.py; docs/SERVING.md §9) --------
+
+    def _state_payload(self) -> dict:
+        from sartsolver_tpu.engine.state import capture_metrics
+
+        return {
+            "lanes": int(self.lanes),
+            "admission": self.admission.export_state(),
+            "metrics": capture_metrics(obs_metrics.get_registry()),
+        }
+
+    def _save_state(self) -> bool:
+        """Checkpoint the soft state (called at every mutation boundary:
+        request outcome, lane halving, drain). Permanent failure is loud
+        but not fatal — the journal is the correctness backbone, the
+        checkpoint only makes the *next* crash cheaper. Returns whether
+        the checkpoint landed (journal compaction must not drop
+        completed ids whose watermark is durable nowhere)."""
+        from sartsolver_tpu.resilience.retry import RetriesExhausted
+
+        try:
+            # payload capture under the engine lock: the socket thread
+            # admits concurrently, and export_state iterates the tenant
+            # table the admit path inserts into
+            with self._lock:
+                payload = self._state_payload()
+            self.state.save(payload)
+            self.state.maybe_compact()
+            return True
+        except RetriesExhausted as err:
+            obs_metrics.get_registry().counter(
+                "engine_checkpoint_failures_total"
+            ).inc()
+            self._event(f"state checkpoint failed (soft state will be "
+                        f"stale after a crash): {err}")
+            return False
+
+    def _restore_state(self) -> None:
+        """Restore the previous incarnation's soft state (before journal
+        replay): quarantined tenants stay quarantined, the degraded lane
+        ladder stays engaged, SLO burn and queue-wait history continue
+        through the registry merge."""
+        from sartsolver_tpu.engine.state import restore_metrics
+
+        self.state.compact()  # drop superseded/torn records at startup
+        payload = self.state.load()
+        if payload is None:
+            return
+        self.admission.restore_state(payload.get("admission") or {})
+        ckpt_lanes = int(payload.get("lanes") or 0)
+        if 1 <= ckpt_lanes < self.lanes:
+            # the OOM ladder is sticky across restarts: restarting into
+            # the full lane count would re-OOM on the same pressure
+            self.lanes = ckpt_lanes
+            self._lanes_gauge.set(float(self.lanes))
+        n = restore_metrics(obs_metrics.get_registry(),
+                            payload.get("metrics"))
+        quarantined = self.admission.quarantined_tenants()
+        self._event(
+            f"state restored from checkpoint (serial "
+            f"{self.state.serial}): {len(quarantined)} quarantined "
+            f"tenant(s){' ' + str(quarantined) if quarantined else ''}, "
+            f"lanes={self.lanes}, {n} metric series merged"
+        )
+
+    # ---- disk retention --------------------------------------------------
+
+    def _rotate_journal(self, *, startup: bool = False) -> None:
+        """Completed-id compaction: on startup always (with rotation
+        enabled), at runtime once the file passes the size knob. The
+        checkpoint is saved FIRST so the dedup watermark covers every
+        completed id the compaction is about to drop."""
+        if not self.journal_rotate_bytes:
+            return
+        if not startup and self.journal.size() <= self.journal_rotate_bytes:
+            return
+        if not self._save_state():
+            # the watermark did NOT land: compacting now would drop
+            # completed ids that are durable nowhere, and a restart
+            # could re-solve a resubmitted one — keep the fat journal
+            self._event("journal compaction skipped: the state "
+                        "checkpoint (dedup watermark) did not land")
+            return
+        with self._lock:
+            reclaimed = self.journal.compact()
+        if reclaimed:
+            obs_metrics.get_registry().counter(
+                "engine_journal_compactions_total"
+            ).inc()
+            self._event(
+                f"journal compacted: {reclaimed} byte(s) of completed "
+                "records reclaimed (dedup watermark in the state "
+                "checkpoint)"
+            )
+
+    def _sweep_retention(self) -> None:
+        """TTL sweep for responses/ and traces/ — a resident engine must
+        bound its own disk. Runs at most every 30 s; mtime-based, so a
+        freshly (re)published response always survives its TTL."""
+        now = time.monotonic()
+        if now - self._last_sweep < 30.0:
+            return
+        self._last_sweep = now
+        for ttl, directory, label in (
+            (self.response_ttl_s, self.responses_dir, "responses"),
+            (self.trace_ttl_s, os.path.join(self.engine_dir, "traces"),
+             "traces"),
+        ):
+            if not ttl:
+                continue
+            try:
+                names = os.listdir(directory)
+            except OSError:
+                continue
+            cutoff = time.time() - ttl
+            removed = 0
+            for name in names:
+                path = os.path.join(directory, name)
+                try:
+                    if os.path.getmtime(path) < cutoff:
+                        os.unlink(path)
+                        removed += 1
+                except OSError:
+                    continue
+            if removed:
+                obs_metrics.get_registry().counter(
+                    "engine_retention_deleted_total", dir=label
+                ).inc(removed)
+                self._event(
+                    f"retention sweep: {removed} expired file(s) "
+                    f"removed from {label}/"
+                )
+
+    # ---- backpressure hints ----------------------------------------------
+
+    def _retry_after(self, reason: str) -> Optional[float]:
+        """The `retry_after_s` hint for a shed/reject response: how long
+        a well-behaved client should back off before resubmitting
+        (docs/SERVING.md §3). Derived from live pressure — queue depth
+        times the observed mean request solve time for capacity sheds,
+        the remaining cooldown for quarantine, a stable constant for a
+        drain (the restart window)."""
+        if reason in (reqmod.REASON_QUEUE_FULL, reqmod.REASON_DEGRADED,
+                      reqmod.REASON_TENANT_QUOTA):
+            est = 1.0
+            if self._solve_hist.count:
+                est = max(self._solve_hist.sum / self._solve_hist.count,
+                          0.1)
+            depth = max(1, int(self.admission.queue_depth))
+            return round(min(max(depth * est, 1.0), 600.0), 1)
+        if reason == reqmod.REASON_DRAINING:
+            return 2.0
+        return None
+
     # ---- replay ----------------------------------------------------------
 
     def _replay(self) -> None:
         completed, pending = self.journal.replay()
-        for rid in completed:
+        for rid, outcome in completed.items():
             self.admission.note_seen(rid)
+            # a missing response is only the mid-response-write crash
+            # window when the completion is YOUNGER than the retention
+            # TTL — older ones were swept on purpose and must not come
+            # back with a fresh mtime (and another full TTL) on restart
+            # a record without the stamp (legacy journal) counts fresh —
+            # better one resurrected response than a lost one
+            done_unix = float(outcome.get("journal_unix")
+                              or time.time()) if outcome else 0.0
+            fresh = (not self.response_ttl_s
+                     or time.time() - done_unix < self.response_ttl_s)
+            prev = self._read_response(rid) if outcome and fresh \
+                else None
+            # stale = missing OR still showing the acceptance verdict:
+            # the kill landed after the completed marker fsync'd but
+            # before the done response replaced the pending one
+            stale = (outcome and fresh
+                     and (prev is None or prev.get("state") != "done"))
+            if stale:
+                # republish from the journaled outcome so the submitter
+                # is never left polling a done request
+                self._respond(rid, {
+                    "id": rid, "verdict": "accepted", "state": "done",
+                    "trace": outcome.get("trace"), "outcome": outcome,
+                    "republished": True,
+                })
         if not completed and not pending:
             return
         for req in pending:
@@ -510,6 +723,12 @@ class EngineServer:
             self.journal.completed(ar.req, rec)
             self.admission.note_outcome(ar.req, outcome)
         self._requests_ctr(outcome).inc()
+        # checkpoint BEFORE the response write: the completed marker is
+        # already durable, and a kill inside the response window must
+        # not lose the outcome/SLO counters — restart republishes the
+        # response WITHOUT re-running or re-counting, so whatever is not
+        # checkpointed here is gone (chaos invariant 4)
+        self._save_state()
         self._respond(ar.req.id, {
             "id": ar.req.id, "verdict": "accepted", "state": "done",
             "trace": trace_id, "outcome": rec,
@@ -701,6 +920,9 @@ class EngineServer:
                 self.admission.set_degraded(
                     f"device OOM; lanes halved to {self.lanes}"
                 )
+            # the ladder level is checkpointed: a crash mid-degradation
+            # restarts at the halved lane count, not back into the OOM
+            self._save_state()
             items = iter(itertools.chain(stats.leftover, items))
         # requests truncated by a mid-cycle stop request: leave them
         # journaled dispatched-but-not-completed — the restart replays
@@ -740,22 +962,42 @@ class EngineServer:
     def run(self) -> int:
         """Serve until SIGTERM/SIGINT (exit 4) or, with ``idle_exit``
         set, until the queue has been empty that long (exit 0)."""
+        # restore BEFORE replay: replay must see the restored dedup
+        # watermark, and replayed work must run under the restored
+        # quarantine/ladder state
+        self._restore_state()
         self._replay()
+        self._rotate_journal(startup=True)
         watchdog.set_engine_status_provider(self._status)
         idle_since = time.monotonic()
         exit_code = EXIT_OK
         try:
             self._start_socket()
-            try:
-                self._start_http()
-            except OSError as err:
-                # EADDRINUSE/EACCES on the operator's chosen port is a
-                # config problem, not an engine fault: polite input-
-                # error exit (taxonomy parity with the flag validators),
-                # never a traceback + misleading crash bundle
-                print(f"sartsolve serve: cannot bind --http_port "
-                      f"{self.http_port}: {err}", file=sys.stderr)
-                return EXIT_INPUT_ERROR
+            # bind with a short retry budget: after a crash the dead
+            # worker's port can linger (TIME_WAIT / late close), and a
+            # supervised respawn hitting that race must not read as a
+            # permanent config error — the supervisor treats exit 1 as
+            # final by design. A genuinely bad port still exits 1 once
+            # the budget (SART_HTTP_BIND_RETRY_S, default 5 s) runs out.
+            bind_budget = float(
+                os.environ.get("SART_HTTP_BIND_RETRY_S", "5") or 0
+            )
+            bind_deadline = time.monotonic() + bind_budget
+            while True:
+                try:
+                    self._start_http()
+                    break
+                except OSError as err:
+                    if time.monotonic() >= bind_deadline:
+                        # polite input-error exit (taxonomy parity with
+                        # the flag validators), never a traceback + a
+                        # misleading crash bundle
+                        print(f"sartsolve serve: cannot bind "
+                              f"--http_port {self.http_port}: {err}",
+                              file=sys.stderr)
+                        return EXIT_INPUT_ERROR
+                    time.sleep(min(0.5, max(
+                        bind_deadline - time.monotonic(), 0.05)))
             while True:
                 if shutdown.stop_requested() and not self._draining:
                     self._draining = True
@@ -768,13 +1010,23 @@ class EngineServer:
                 if self._draining:
                     exit_code = EXIT_INTERRUPTED
                     break
-                self._scan_ingest()
+                if self._scan_ingest():
+                    # admissions mutate checkpointed state too (dedup
+                    # watermark, admitted/shed counters): one save per
+                    # ingest batch keeps the accounting continuous
+                    # across a crash before the first outcome
+                    self._save_state()
+                # self-throttled to every 30 s — and deliberately ahead
+                # of the busy branch: a continuously loaded engine is
+                # exactly the one whose responses/traces grow fastest
+                self._sweep_retention()
                 with self._lock:
                     batch = self._queue[: self.max_cycle_requests]
                     del self._queue[: len(batch)]
                 if batch:
                     self._cycles += 1
                     self._solve_cycle(batch)
+                    self._rotate_journal()
                     idle_since = time.monotonic()
                     continue
                 if (self.idle_exit > 0
@@ -790,19 +1042,34 @@ class EngineServer:
             self._stop_socket()
             self._stop_http()
             watchdog.set_engine_status_provider(None)
+            # final checkpoint: the drain/idle exit is a state boundary
+            # too (queued-but-undispatched work stays journaled; its
+            # tenants' state must survive into the next serve)
+            self._save_state()
         return exit_code
 
     # ---- live pull endpoint (--http_port) --------------------------------
 
     def _health(self) -> Tuple[str, Optional[str]]:
-        """Admission state for /healthz: draining beats degraded beats
-        ok (lock-free field reads — scrape-path contract)."""
+        """/healthz is pure LIVENESS (docs/SERVING.md §9): the worker
+        process answering at all means live — draining and degraded are
+        readiness states, not liveness states. The supervisor's
+        lame-duck endpoint answers ``crash-loop``/503 here instead,
+        because there the serve worker is genuinely not alive."""
+        return "live", None
+
+    def _ready(self) -> Tuple[Optional[str], Optional[str]]:
+        """/readyz READINESS: (None, None) = ready to admit; else a
+        byte-stable machine-readable reason + human detail (lock-free
+        field reads — scrape-path contract). External supervisors and
+        the built-in one read the same vocabulary: ``draining``,
+        ``degraded`` (here), ``crash-loop`` (the supervisor's)."""
         if self._draining:
-            return "draining", "stop requested; resubmit elsewhere"
+            return reqmod.REASON_DRAINING, "stop requested; resubmit elsewhere"
         reason = self.admission.degraded_reason
         if reason is not None:
-            return "degraded", reason
-        return "ok", None
+            return reqmod.REASON_DEGRADED, reason
+        return None, None
 
     def _start_http(self) -> None:
         if self.http_port is None:
@@ -817,12 +1084,13 @@ class EngineServer:
             # with the solve path (stale-read snapshot forms, PR 9)
             metrics_snapshot=lambda: registry.snapshot(blocking=False),
             health=self._health,
+            ready=self._ready,
             status=lambda: obs_flight.status_snapshot(blocking=False),
         )
         self.http.start()
         self._event(
             f"live endpoints on http://127.0.0.1:{self.http.port} "
-            "(/metrics /healthz /status)"
+            "(/metrics /healthz /readyz /status)"
         )
 
     def _stop_http(self) -> None:

@@ -220,12 +220,20 @@ class ResidentSession:
         camera_times, deadline, trace_id)`` tuples (``deadline`` is the
         absolute ``time.monotonic()`` budget the lane sweep sheds
         against, or None; ``trace_id`` routes the scheduler's per-stride
-        spans onto the request's trace track). A failed frame read
-        degrades to an ordered :class:`FrameFailure` item — per-frame
-        isolation, like the CLI's prefetcher."""
+        spans onto the request's trace track). Frame reads retry under
+        the shared policy first (the CLI prefetcher's contract — a
+        transient NFS blip costs one backoff, not the frame); a
+        *permanent* failure degrades to an ordered
+        :class:`FrameFailure` item — per-frame isolation, like the
+        CLI's prefetcher."""
+        from sartsolver_tpu.resilience.retry import retry_call
+
         for i in range(len(image)):
             try:
-                frame = image.frame(i)
+                frame = retry_call(
+                    lambda i=i: image.frame(i),
+                    site=faults.SITE_FRAME_READ, retry_on=(OSError,),
+                )
                 ftime = image.frame_time(i)
                 cam_times = image.camera_frame_time(i)
             except Exception as err:  # noqa: BLE001 - isolate frame reads

@@ -1,0 +1,510 @@
+"""Supervised serve: keep the resident engine alive across crashes.
+
+``sartsolve serve --supervised`` (docs/SERVING.md §9) turns the serve
+process from a single point of failure into a self-healing pair: this
+module is the *supervisor* — a small, jax-free parent process that
+spawns the real serve worker and keeps it alive across every abnormal
+exit (watchdog ``os._exit(3)``, SDC quarantine, OOM kill, segfault,
+``kill -9``). The escalation ladder sits one level above the watchdog's
+(docs/RESILIENCE.md §10):
+
+- **Restart with bounded exponential backoff** — each consecutive crash
+  doubles the respawn delay (``--restart_backoff`` base, capped at
+  ``--restart_backoff_max``); a worker that stayed alive longer than
+  the crash-loop window resets the streak. Durable engine state
+  (engine/state.py) + journal replay make the restart cheap and
+  exactly-once.
+- **Crash-loop circuit breaker** — ``--crash_loop_threshold`` crashes
+  inside a sliding ``--crash_loop_window`` open the breaker:
+  **lame-duck mode**. The supervisor stops burning restarts, serves
+  ``/healthz`` = 503 (``crash-loop``) on the worker's ``--http_port``,
+  and *journals-but-refuses* admissions: every request landing in the
+  ingest dir gets a machine-readable ``crash-loop`` rejection response
+  (with a ``retry_after_s`` hint — the remaining breaker window) and a
+  record in ``supervisor.jsonl``, until the window clears and the
+  breaker half-opens into one more restart.
+- **Deliberate exits are final** — worker exit 0 (idle), 4 (drained
+  after SIGTERM) and 1 (flag/config error: restarting would loop
+  pointlessly) end the supervisor with the same code.
+
+SIGTERM/SIGINT at the supervisor forwards ONE SIGTERM to the worker for
+a graceful drain (journal + state checkpoint land; exit 4); a second
+signal SIGKILLs the worker and dies by the signal.
+
+Observability: every restart increments
+``engine_restarts_total{reason=...}`` and lame-duck flips the
+``engine_crash_loop`` gauge — both live in the supervisor's registry,
+exposed as a Prometheus textfile at ``<engine_dir>/supervisor.prom``
+and on the lame-duck ``/metrics`` endpoint. Restart events and mirrored
+worker crash-bundle reasons land in the flight ring and the durable
+``<engine_dir>/supervisor.jsonl``; entering lame duck writes a
+supervisor crash bundle (``supervisor.crash.json``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from collections import deque
+from typing import List, Optional
+
+from sartsolver_tpu.obs import flight as obs_flight
+from sartsolver_tpu.obs import metrics as obs_metrics
+
+
+def classify_exit(returncode: int) -> str:
+    """A worker exit's machine-readable restart reason (the
+    ``engine_restarts_total`` label and event vocabulary): ``signal:
+    SIGKILL``-style for signal deaths, ``infrastructure`` for the
+    documented exit 3, ``exit:N`` otherwise."""
+    if returncode < 0:
+        try:
+            return f"signal:{signal.Signals(-returncode).name}"
+        except ValueError:
+            return f"signal:{-returncode}"
+    if returncode == 3:
+        return "infrastructure"
+    return f"exit:{returncode}"
+
+
+class CrashLoopBreaker:
+    """Sliding-window crash counter: ``threshold`` crashes inside
+    ``window_s`` seconds opens the breaker (lame duck) until the oldest
+    crash ages out of the window."""
+
+    def __init__(self, threshold: int, window_s: float):
+        self.threshold = max(1, int(threshold))
+        self.window_s = float(window_s)
+        self.crashes: deque = deque()
+
+    def record(self, now: float) -> None:
+        self.crashes.append(float(now))
+        self._expire(now)
+
+    def _expire(self, now: float) -> None:
+        while self.crashes and now - self.crashes[0] > self.window_s:
+            self.crashes.popleft()
+
+    def open(self, now: float) -> bool:
+        self._expire(now)
+        return len(self.crashes) >= self.threshold
+
+    def remaining_s(self, now: float) -> float:
+        """Seconds until the breaker would close (0 when closed)."""
+        self._expire(now)
+        if len(self.crashes) < self.threshold:
+            return 0.0
+        # closes when the crash that keeps the count at threshold ages out
+        oldest_needed = self.crashes[len(self.crashes) - self.threshold]
+        return max(0.0, oldest_needed + self.window_s - now)
+
+
+def restart_backoff(streak: int, base: float, cap: float) -> float:
+    """Respawn delay before consecutive-crash number ``streak`` (1-based):
+    exponential from ``base``, capped at ``cap``."""
+    if streak <= 0:
+        return 0.0
+    return min(float(base) * (2.0 ** (streak - 1)), float(cap))
+
+
+class Supervisor:
+    """One supervised serve worker's parent process."""
+
+    def __init__(
+        self,
+        worker_argv: List[str],
+        *,
+        engine_dir: str,
+        backoff_base: float = 1.0,
+        backoff_max: float = 30.0,
+        crash_loop_window: float = 60.0,
+        crash_loop_threshold: int = 5,
+        max_restarts: int = 0,
+        http_port: Optional[int] = None,
+        poll_interval: float = 0.2,
+    ):
+        self.worker_argv = list(worker_argv)
+        self.engine_dir = engine_dir
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.max_restarts = max(0, int(max_restarts))
+        self.http_port = http_port
+        self.poll_interval = float(poll_interval)
+        self.breaker = CrashLoopBreaker(crash_loop_threshold,
+                                        crash_loop_window)
+        self.restarts = 0
+        self.streak = 0  # consecutive fast crashes (backoff exponent)
+        self.lame_ducks = 0
+        self.lame_rejected = 0
+        self._proc: Optional[subprocess.Popen] = None
+        self._stop = False
+        self._signame: Optional[str] = None
+        self._forwarded = False
+        # the intake/verdict dirs exist from the first instant: a client
+        # must be able to submit (and read a rejection) even while the
+        # worker is still coming up — or crash-looping before it ever
+        # managed to create them
+        for sub in ("", "ingest", "responses"):
+            os.makedirs(os.path.join(engine_dir, sub), exist_ok=True)
+        self.events_path = os.path.join(engine_dir, "supervisor.jsonl")
+        self.prom_path = os.path.join(engine_dir, "supervisor.prom")
+        self.bundle_path = os.path.join(engine_dir,
+                                        "supervisor.crash.json")
+        registry = obs_metrics.get_registry()
+        self._crash_loop_gauge = registry.gauge("engine_crash_loop")
+        self._crash_loop_gauge.set(0.0)
+
+    # ---- events / metrics ------------------------------------------------
+
+    def _event(self, kind: str, **data) -> None:
+        """One supervisor event, fanned out to every surface: stderr
+        (the operator's live view), the flight ring (crash-bundle
+        tail), the durable supervisor.jsonl, and the Prometheus
+        textfile (best-effort — a full disk must not kill the
+        supervisor, it is the thing that survives)."""
+        rec = {"unix": round(time.time(), 3), "kind": str(kind)}
+        rec.update(data)
+        detail = " ".join(f"{k}={v}" for k, v in data.items())
+        print(f"sartsolve supervisor: {kind}"
+              + (f" {detail}" if detail else ""), file=sys.stderr,
+              flush=True)
+        obs_flight.record_event(f"supervisor.{kind}", **data)
+        try:
+            with open(self.events_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass
+        self._write_prom()
+
+    def _write_prom(self) -> None:
+        from sartsolver_tpu.obs.sinks import PromSink
+
+        try:
+            PromSink(self.prom_path).write(
+                obs_metrics.get_registry().snapshot(blocking=False)
+            )
+        except OSError:
+            pass
+
+    def _restart_ctr(self, reason: str):
+        return obs_metrics.get_registry().counter(
+            "engine_restarts_total", reason=reason
+        )
+
+    # ---- signals ---------------------------------------------------------
+
+    def _handler(self, signum, _frame) -> None:
+        name = signal.Signals(signum).name
+        if self._stop:
+            # second signal: SIGKILL the worker, die by the signal
+            proc = self._proc
+            if proc is not None and proc.poll() is None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        self._stop = True
+        self._signame = name
+        sys.stderr.write(
+            f"sartsolve supervisor: received {name} — forwarding "
+            "SIGTERM to the worker for one graceful drain. Send again "
+            "to abort immediately.\n"
+        )
+        sys.stderr.flush()
+
+    def _install_signals(self) -> None:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, self._handler)
+
+    # ---- worker lifecycle ------------------------------------------------
+
+    def _spawn(self) -> subprocess.Popen:
+        cmd = [sys.executable, "-m", "sartsolver_tpu.cli", "serve",
+               *self.worker_argv]
+        proc = subprocess.Popen(cmd)  # stdout/stderr inherited
+        self._proc = proc
+        self._forwarded = False
+        self._event("worker-spawn", pid=proc.pid,
+                    spawn=self.restarts + 1)
+        return proc
+
+    def _wait(self, proc: subprocess.Popen) -> int:
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            if self._stop and not self._forwarded:
+                self._forwarded = True
+                try:
+                    proc.send_signal(signal.SIGTERM)
+                    self._event("sigterm-forwarded", pid=proc.pid,
+                                signal=self._signame)
+                except OSError:
+                    pass
+            time.sleep(self.poll_interval)
+
+    def _sleep(self, seconds: float) -> None:
+        """Interruptible backoff sleep (a stop request cuts it short)."""
+        deadline = time.monotonic() + seconds
+        while not self._stop and time.monotonic() < deadline:
+            time.sleep(min(self.poll_interval,
+                           max(deadline - time.monotonic(), 0.0)))
+
+    def _mirror_crash_bundle(self, spawned_unix: float) -> None:
+        """Fold the dead worker's crash bundle (when it managed to write
+        one) into the supervisor's event stream, so triage starts from
+        supervisor.jsonl whatever killed the worker."""
+        path = os.path.join(self.engine_dir, "engine.crash.json")
+        try:
+            if os.path.getmtime(path) < spawned_unix - 1.0:
+                return  # a previous incarnation's bundle
+            with open(path) as f:
+                bundle = json.load(f)
+        except (OSError, ValueError):
+            return
+        self._event("worker-crash-bundle",
+                    reason=str(bundle.get("reason", "?")), path=path)
+
+    # ---- lame duck -------------------------------------------------------
+
+    def _lame_duck_status(self) -> dict:
+        now = time.monotonic()
+        return obs_flight.status_snapshot(
+            blocking=False,
+            supervisor={
+                "lame_duck": True,
+                "restarts": self.restarts,
+                "breaker_remaining_s": round(
+                    self.breaker.remaining_s(now), 1),
+                "rejected": self.lame_rejected,
+            },
+        )
+
+    def _reject_ingest(self, remaining_s: float) -> int:
+        """The journal-but-refuse half of lame duck: every request file
+        is answered with a byte-stable ``crash-loop`` rejection (plus
+        the retry hint) and recorded — never silently dropped, never
+        queued into a pool that cannot serve it."""
+        from sartsolver_tpu.engine.request import REASON_CRASH_LOOP
+
+        ingest = os.path.join(self.engine_dir, "ingest")
+        responses = os.path.join(self.engine_dir, "responses")
+        try:
+            names = sorted(os.listdir(ingest))
+        except OSError:
+            return 0
+        n = 0
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(ingest, name)
+            rid = os.path.splitext(name)[0]
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+                if isinstance(payload, dict) and payload.get("id"):
+                    rid = str(payload["id"])
+            except (OSError, ValueError):
+                pass  # reject under the file stem; id unknowable
+            # never clobber a completed id's recorded outcome: a
+            # resubmission during lame duck is a duplicate, and the
+            # engine's contract is that the original response survives —
+            # the submitter resolves from it, no rejection needed
+            try:
+                with open(os.path.join(responses, f"{rid}.json")) as f:
+                    prev = json.load(f)
+            except (OSError, ValueError):
+                prev = None
+            if prev and prev.get("state") == "done":
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                self._event("lame-duck-duplicate", id=rid)
+                continue
+            rec = {"unix": round(time.time(), 3), "id": rid,
+                   "verdict": "rejected", "reason": REASON_CRASH_LOOP,
+                   "retry_after_s": round(max(remaining_s, 1.0), 1)}
+            try:
+                os.makedirs(responses, exist_ok=True)
+                tmp = os.path.join(responses,
+                                   f"{rid}.json.{os.getpid()}.tmp")
+                with open(tmp, "w") as f:
+                    json.dump(rec, f)
+                    f.write("\n")
+                os.replace(tmp, os.path.join(responses, f"{rid}.json"))
+            except OSError:
+                continue  # leave the request file for the next pass
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            self.lame_rejected += 1
+            obs_metrics.get_registry().counter(
+                "engine_shed_total", reason=REASON_CRASH_LOOP
+            ).inc()
+            self._event("lame-duck-reject", id=rid,
+                        retry_after_s=rec["retry_after_s"])
+            n += 1
+        return n
+
+    def _lame_duck(self, last_reason: str) -> None:
+        """Breaker open: hold restarts, answer 503s and crash-loop
+        rejections until the sliding window clears."""
+        self.lame_ducks += 1
+        self._crash_loop_gauge.set(1.0)
+        now = time.monotonic()
+        remaining = self.breaker.remaining_s(now)
+        self._event("lame-duck-enter",
+                    crashes=len(self.breaker.crashes),
+                    window_s=self.breaker.window_s,
+                    remaining_s=round(remaining, 1),
+                    last_reason=last_reason)
+        obs_flight.write_crash_bundle(
+            self.bundle_path,
+            f"crash-loop: {len(self.breaker.crashes)} crashes in "
+            f"{self.breaker.window_s:g}s (last: {last_reason}); "
+            f"lame duck for {remaining:.1f}s",
+        )
+        http = None
+        if self.http_port is not None:
+            from sartsolver_tpu.engine.httpd import EngineHTTPServer
+            from sartsolver_tpu.engine.request import REASON_CRASH_LOOP
+
+            registry = obs_metrics.get_registry()
+
+            def detail() -> str:
+                left = self.breaker.remaining_s(time.monotonic())
+                return (f"crash-loop breaker open; retry in "
+                        f"{left:.1f}s")
+
+            try:
+                http = EngineHTTPServer(
+                    self.http_port,
+                    metrics_snapshot=lambda: registry.snapshot(
+                        blocking=False),
+                    health=lambda: (REASON_CRASH_LOOP, detail()),
+                    ready=lambda: (REASON_CRASH_LOOP, detail()),
+                    status=self._lame_duck_status,
+                )
+                http.start()
+                self._event("lame-duck-endpoint", port=http.port)
+            except OSError as err:
+                # the dead worker's socket may linger in TIME_WAIT;
+                # lame duck still rejects via the responses dir
+                self._event("lame-duck-endpoint-failed", error=str(err))
+                http = None
+        try:
+            while not self._stop:
+                now = time.monotonic()
+                remaining = self.breaker.remaining_s(now)
+                if remaining <= 0:
+                    break
+                self._reject_ingest(remaining)
+                time.sleep(min(self.poll_interval, remaining))
+        finally:
+            if http is not None:
+                http.stop()
+        self._crash_loop_gauge.set(0.0)
+        self.breaker.crashes.clear()
+        self.streak = 0
+        self._event("lame-duck-exit", rejected=self.lame_rejected)
+
+    # ---- main loop -------------------------------------------------------
+
+    def run(self) -> int:
+        self._install_signals()
+        obs_flight.install()
+        self._event("start",
+                    backoff=self.backoff_base,
+                    backoff_max=self.backoff_max,
+                    window_s=self.breaker.window_s,
+                    threshold=self.breaker.threshold,
+                    max_restarts=self.max_restarts or "unlimited")
+        try:
+            while True:
+                spawned_unix = time.time()
+                t_spawn = time.monotonic()
+                proc = self._spawn()
+                rc = self._wait(proc)
+                lifetime = time.monotonic() - t_spawn
+                reason = classify_exit(rc)
+                if rc in (0, 4):
+                    # clean idle exit / graceful drain (ours or an
+                    # operator's direct SIGTERM at the worker): done
+                    self._event("worker-done", code=rc,
+                                lifetime_s=round(lifetime, 1))
+                    return rc
+                if rc == 1:
+                    # flag/config error: a restart would re-fail
+                    # identically forever — surface it instead
+                    self._event("worker-config-error", code=rc)
+                    return 1
+                if self._stop:
+                    # we asked for a drain and the worker died anyway
+                    # (second signal, or it crashed mid-drain): stop
+                    self._event("worker-died-draining", code=rc,
+                                reason=reason)
+                    return 4 if rc < 0 else rc
+                self._mirror_crash_bundle(spawned_unix)
+                self.restarts += 1
+                self._restart_ctr(reason).inc()
+                now = time.monotonic()
+                self.breaker.record(now)
+                # a worker that survived the whole window was healthy:
+                # the next crash starts a fresh backoff ladder
+                self.streak = (1 if lifetime > self.breaker.window_s
+                               else self.streak + 1)
+                self._event("worker-crash", code=rc, reason=reason,
+                            lifetime_s=round(lifetime, 1),
+                            restarts=self.restarts,
+                            window_crashes=len(self.breaker.crashes))
+                if self.max_restarts and self.restarts >= self.max_restarts:
+                    self._event("restart-budget-exhausted",
+                                restarts=self.restarts)
+                    return 3
+                if self.breaker.open(now):
+                    self._lame_duck(reason)
+                    if self._stop:
+                        return 4
+                    continue  # half-open: one fresh spawn
+                delay = restart_backoff(self.streak, self.backoff_base,
+                                        self.backoff_max)
+                if delay > 0:
+                    self._event("backoff", delay_s=round(delay, 2),
+                                streak=self.streak)
+                    self._sleep(delay)
+                if self._stop:
+                    return 4
+        finally:
+            obs_flight.uninstall()
+            self._write_prom()
+
+
+def supervisor_main(args, worker_argv: List[str]) -> int:
+    """`sartsolve serve --supervised` entry (engine/cli.py): ``args`` is
+    the parsed serve namespace (supervision knobs), ``worker_argv`` the
+    original argv with ``--supervised`` stripped — the exact command the
+    worker runs under."""
+    sup = Supervisor(
+        worker_argv,
+        engine_dir=args.engine_dir,
+        backoff_base=args.restart_backoff,
+        backoff_max=args.restart_backoff_max,
+        crash_loop_window=args.crash_loop_window,
+        crash_loop_threshold=args.crash_loop_threshold,
+        max_restarts=args.max_restarts,
+        http_port=args.http_port,
+    )
+    return sup.run()
+
+
+__all__ = ["Supervisor", "CrashLoopBreaker", "classify_exit",
+           "restart_backoff", "supervisor_main"]

@@ -9,7 +9,16 @@ so ``sartsolve metrics --diff --threshold`` can gate queue-wait and
 deadline-miss rates run-over-run (docs/SERVING.md §6). Exits with the
 serve exit code (0 expected).
 
-Usage: gen_engine_artifact.py WORLD_DIR ARTIFACT.jsonl
+With the ``supervised`` mode argument the pass runs the REAL
+``sartsolve serve --supervised`` in a subprocess and SIGKILLs the
+worker once inside a journal commit window: the supervisor restarts it,
+the state checkpoint merges the first incarnation's engine metrics into
+the second, and the final artifact therefore carries CUMULATIVE
+queue-wait/deadline/SLO series across the induced crash — `make
+bench-smoke` gates the same four engine metrics on it run-over-run
+(docs/SERVING.md §9).
+
+Usage: gen_engine_artifact.py WORLD_DIR ARTIFACT.jsonl [supervised]
 """
 
 import json
@@ -30,7 +39,7 @@ import fixtures as fx  # noqa: E402
 from sartsolver_tpu.engine.cli import serve_main  # noqa: E402
 
 
-def run(world_dir: str, artifact: str) -> int:
+def run(world_dir: str, artifact: str, mode: str = "") -> int:
     paths, *_ = fx.write_world(world_dir, n_frames=6)
     eng = os.path.join(world_dir, "engine")
     ingest = os.path.join(eng, "ingest")
@@ -47,7 +56,7 @@ def run(world_dir: str, artifact: str) -> int:
         with open(os.path.join(ingest, f"{i}-{payload['id']}.json"),
                   "w") as f:
             json.dump(payload, f)
-    return serve_main([
+    serve_argv = [
         "--engine_dir", eng, "--use_cpu", "-m", "60", "-c", "1e-8",
         "--lanes", "2", "--idle_exit", "0.5", "--poll_interval", "0.05",
         # generous SLO target (like the deadlines): a healthy smoke run
@@ -57,8 +66,58 @@ def run(world_dir: str, artifact: str) -> int:
         "--metrics_out", artifact,
         paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
         paths["img_a"], paths["img_b"],
-    ])
+    ]
+    if mode != "supervised":
+        return serve_main(serve_argv)
+    return _run_supervised(serve_argv)
+
+
+def _run_supervised(serve_argv) -> int:
+    """Supervised pass with one induced crash: SIGKILL the worker in the
+    first 'dispatched' journal window, let the supervisor restart it,
+    and return once the second incarnation drains and exits 0 — the
+    artifact it finalizes carries both incarnations' engine metrics
+    (merged through the state checkpoint)."""
+    import re
+    import signal
+    import subprocess
+    import threading
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["SART_TEST_JOURNAL_DELAY"] = "0.4"
+    cmd = [sys.executable, "-m", "sartsolver_tpu.cli", "serve",
+           "--supervised", "--restart_backoff", "0.05", *serve_argv]
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    guard = threading.Timer(280, proc.kill)
+    guard.start()
+    worker_pid = None
+    killed = False
+    try:
+        for line in proc.stdout:
+            sys.stderr.write(line)
+            m = re.search(r"worker-spawn pid=(\d+)", line)
+            if m:
+                worker_pid = int(m.group(1))
+            if (not killed and worker_pid is not None
+                    and "SART_JOURNAL_POINT dispatched" in line):
+                os.kill(worker_pid, signal.SIGKILL)
+                killed = True
+        rc = proc.wait(timeout=280)
+    finally:
+        guard.cancel()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    if not killed:
+        print("gen_engine_artifact: supervised pass never reached the "
+              "kill window", file=sys.stderr)
+        return 1
+    return rc
 
 
 if __name__ == "__main__":
-    sys.exit(run(sys.argv[1], sys.argv[2]))
+    sys.exit(run(sys.argv[1], sys.argv[2],
+                 sys.argv[3] if len(sys.argv) > 3 else ""))

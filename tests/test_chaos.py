@@ -1,0 +1,155 @@
+"""Chaos campaign harness matrix (docs/SERVING.md §9; `make chaos`).
+
+Units: schedule determinism (same seed -> same faults + kill plan),
+window-marker line parsing, CLI usage errors, and the judge's teeth (a
+doctored journal with a double-solved request must violate the
+exactly-once invariant — the gate is not vacuous).
+
+End-to-end: `sartsolve chaos` on the bounded CI seed set against the
+synthetic world — randomized transient faults + SIGKILLs inside the
+journal/checkpoint/response commit windows of a REAL supervised serve,
+asserting every accepted request reaches exactly one outcome, outputs
+stay byte-identical to an undisturbed run, restarts stay within the
+kill budget, and counter/SLO continuity holds across incarnations.
+"""
+
+import json
+import os
+
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.resilience import chaos as chaos_mod
+from sartsolver_tpu.resilience.chaos import (
+    FAULT_POOL,
+    CampaignError,
+    ChaosCampaign,
+    FaultSchedule,
+    chaos_main,
+    line_window,
+)
+
+# the bounded CI seed set (make chaos); SART_CHAOS_SEEDS widens it
+CI_SEEDS = os.environ.get("SART_CHAOS_SEEDS", "3,5")
+
+
+# ---------------------------------------------------------------------------
+# units
+# ---------------------------------------------------------------------------
+
+def test_schedule_deterministic_per_seed():
+    for seed in range(8):
+        a, b = FaultSchedule(seed), FaultSchedule(seed)
+        assert a.describe() == b.describe()
+        assert a.fault_spec() == b.fault_spec()
+        assert a.window_env() == b.window_env()
+    # different seeds explore different schedules
+    assert len({FaultSchedule(s).fault_spec() for s in range(16)}) > 1
+
+
+def test_schedule_draws_from_safe_pool_only():
+    from sartsolver_tpu.resilience.faults import parse_fault_spec
+
+    sites = {site for site, _kind in FAULT_POOL}
+    for seed in range(16):
+        sched = FaultSchedule(seed)
+        armed = parse_fault_spec(sched.fault_spec())  # valid spec
+        assert set(armed) <= sites
+        for window, occurrence in sched.kills:
+            assert window in chaos_mod.KILL_WINDOWS
+            assert 1 <= occurrence <= 3
+
+
+def test_line_window_parsing():
+    assert line_window("SART_JOURNAL_POINT accepted\n") == "accepted"
+    assert line_window("SART_JOURNAL_POINT pre-flush\n") == "pre-flush"
+    assert line_window("SART_CKPT_POINT pre-append\n") == "ckpt"
+    # only COMPLETION responses are the 'response' kill window —
+    # acceptance responses are written first and would shadow it
+    assert line_window("SART_RESPONSE_POINT r1 state=done\n") \
+        == "response"
+    assert line_window("SART_RESPONSE_POINT r1 state=pending\n") is None
+    assert line_window("SART_RESPONSE_POINT r1 state=none\n") is None
+    assert line_window("engine: session resident\n") is None
+
+
+def test_chaos_cli_usage_errors(capsys):
+    assert chaos_main(["--engine_dir", "/tmp/x"]) == 1  # no serve args
+    assert "after --" in capsys.readouterr().err
+    assert chaos_main(["--engine_dir", "/tmp/x", "--seeds", "nope",
+                       "--", "f.h5"]) == 1
+    assert chaos_main(["--engine_dir", "/tmp/x", "--requests", "0",
+                       "--", "f.h5"]) == 1
+
+
+def test_judge_catches_double_solve(tmp_path):
+    """The exactly-once gate has teeth: a journal showing two completed
+    markers for one id violates the invariant loudly."""
+    campaign = ChaosCampaign(
+        root=str(tmp_path), serve_args=["x.h5"],
+        requests=[{"id": "a", "tenant": "t0"}],
+        slo_ms=None, timeout=10.0,
+    )
+    campaign.reference = {"a": {"datasets": {}, "status": "completed"}}
+    seed_dir = str(tmp_path / "seed0")
+    os.makedirs(seed_dir)
+    with open(os.path.join(seed_dir, "journal.jsonl"), "w") as f:
+        f.write(json.dumps({"marker": "accepted", "id": "a",
+                            "unix": 1.0, "request": {"id": "a"}}) + "\n")
+        for _ in range(2):  # double solve
+            f.write(json.dumps({"marker": "completed", "id": "a",
+                                "unix": 2.0, "outcome": {}}) + "\n")
+    with pytest.raises(CampaignError, match="double-solved"):
+        campaign._judge(seed_dir, FaultSchedule(0), kills_fired=1,
+                        text="")
+
+
+def test_judge_catches_lost_request(tmp_path):
+    campaign = ChaosCampaign(
+        root=str(tmp_path), serve_args=["x.h5"],
+        requests=[{"id": "a", "tenant": "t0"}],
+        slo_ms=None, timeout=10.0,
+    )
+    campaign.reference = {"a": {"datasets": {}, "status": "completed"}}
+    seed_dir = str(tmp_path / "seed0")
+    os.makedirs(seed_dir)
+    with open(os.path.join(seed_dir, "journal.jsonl"), "w") as f:
+        f.write(json.dumps({"marker": "accepted", "id": "a",
+                            "unix": 1.0, "request": {"id": "a"}}) + "\n")
+    with pytest.raises(CampaignError, match="journal shows"):
+        campaign._judge(seed_dir, FaultSchedule(0), kills_fired=0,
+                        text="")
+
+
+# ---------------------------------------------------------------------------
+# the campaign (ISSUE acceptance: full CI seed set)
+# ---------------------------------------------------------------------------
+
+def test_chaos_campaign_ci_seed_set(tmp_path, capsys):
+    """Randomized fault schedules + SIGKILLs against the real supervised
+    engine: the ISSUE's acceptance invariants, on the bounded seed set
+    `make chaos` runs."""
+    world = str(tmp_path / "world")
+    os.makedirs(world)
+    paths, *_ = fx.write_world(world, n_frames=4)
+    report_path = str(tmp_path / "report.json")
+    rc = chaos_main([
+        "--engine_dir", str(tmp_path / "camp"),
+        "--seeds", CI_SEEDS, "--requests", "4",
+        "--slo_ms", "300000", "--timeout", "280",
+        "--report", report_path, "--",
+        "--use_cpu", "-m", "40", "-c", "1e-12", "--lanes", "2",
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    report = json.load(open(report_path))
+    assert report["verdict"] == "ok"
+    assert len(report["passes"]) == len(CI_SEEDS.split(","))
+    for verdict in report["passes"]:
+        assert verdict["verdict"] == "ok"
+        assert verdict["kills_fired"] >= 1  # every seed really killed
+        assert verdict["restarts"] <= verdict["kills_fired"]
+        assert verdict["requests_total"] == {"completed": 4.0}

@@ -10,7 +10,7 @@
   and frame records.
 - **Scrape parity** — the `--http_port` /metrics endpoint is byte-
   equivalent to the Prometheus textfile sink rendered from the same
-  registry snapshot; /healthz and /status serve the admission state and
+  registry snapshot; /healthz (liveness) / /readyz (readiness) and /status serve the admission state and
   the live status snapshot from the non-blocking forms.
 - **Disabled identity** — without `--http_port`/tracing a serve run
   creates no endpoint, no traces directory and no new threads.
@@ -333,10 +333,12 @@ def test_scrape_vs_textfile_byte_parity(tmp_path):
 
 
 def test_endpoints_on_live_engine(session, tmp_path, monkeypatch):
-    """A real serve loop with --http_port: /healthz tracks the
-    admission state (ok -> draining 503), /status carries the engine
-    section, /metrics scrapes, and `sartsolve top http://...` renders
-    live (with the --once exit-1 contract once the engine is gone)."""
+    """A real serve loop with --http_port: /healthz is pure liveness
+    (live 200 while the worker answers), /readyz tracks the admission
+    state (ready -> draining 503, docs/SERVING.md §9), /status carries
+    the engine section, /metrics scrapes, and `sartsolve top
+    http://...` renders live (with the --once exit-1 contract once the
+    engine is gone)."""
     from sartsolver_tpu.engine.server import EngineServer
     from sartsolver_tpu.obs import flight as obs_flight
     from sartsolver_tpu.obs.cli import render_top, top_main
@@ -364,7 +366,9 @@ def test_endpoints_on_live_engine(session, tmp_path, monkeypatch):
         assert server.http is not None
         base = f"http://127.0.0.1:{server.http.port}"
         code, body = _get(base + "/healthz")
-        assert code == 200 and json.loads(body)["status"] == "ok"
+        assert code == 200 and json.loads(body)["status"] == "live"
+        code, body = _get(base + "/readyz")
+        assert code == 200 and json.loads(body)["status"] == "ready"
         code, body = _get(base + "/status")
         assert code == 200
         rec = json.loads(body)
@@ -382,37 +386,46 @@ def test_endpoints_on_live_engine(session, tmp_path, monkeypatch):
         t.join(timeout=60)
     assert not t.is_alive()
     assert server.http is None  # endpoint torn down with the loop
-    # after the stop the admission state is draining...
-    assert server._health()[0] == "draining"
-    # ...and the /healthz mapping for that state is 503 (pinned on a
-    # standalone endpoint — the live loop exits the same iteration it
-    # flips the flag, so the window is not reliably observable)
+    # after the stop the readiness state is draining...
+    assert server._ready()[0] == "draining"
+    # ...and the /readyz mapping for that state is 503 with the
+    # byte-stable reason, while /healthz stays live — the process IS
+    # alive (pinned on a standalone endpoint — the live loop exits the
+    # same iteration it flips the flag, so the window is not reliably
+    # observable)
     from sartsolver_tpu.engine.httpd import EngineHTTPServer
 
     srv = EngineHTTPServer(
         0, metrics_snapshot=lambda: [], health=server._health,
-        status=lambda: {},
+        ready=server._ready, status=lambda: {},
     )
     srv.start()
     try:
+        code, body = _get(f"http://127.0.0.1:{srv.port}/healthz")
+        assert code == 200 and json.loads(body)["status"] == "live"
         with pytest.raises(urllib.error.HTTPError) as exc:
-            _get(f"http://127.0.0.1:{srv.port}/healthz")
+            _get(f"http://127.0.0.1:{srv.port}/readyz")
         assert exc.value.code == 503
-        assert json.loads(exc.value.read())["status"] == "draining"
+        rec = json.loads(exc.value.read())
+        assert rec["status"] == "not-ready" and rec["reason"] == "draining"
     finally:
         srv.stop()
     # unreachable endpoint: the --once probe must report failure
     assert top_main([f"http://127.0.0.1:1/", "--once"]) == 1
 
 
-def test_http_port_bind_failure_is_input_error(session, tmp_path):
+def test_http_port_bind_failure_is_input_error(session, tmp_path,
+                                               monkeypatch):
     """An unbindable --http_port (EADDRINUSE) is a config problem: the
     serve loop exits with the polite input-error code, not a traceback
-    plus a misleading crash bundle."""
+    plus a misleading crash bundle. (The short bind-retry budget exists
+    for supervised respawns racing a dead worker's lingering port —
+    shrunk here so the permanently-held port fails fast.)"""
     import socket
 
     from sartsolver_tpu.engine.server import EngineServer
 
+    monkeypatch.setenv("SART_HTTP_BIND_RETRY_S", "0.2")
     obs_metrics.reset_registry()
     holder = socket.socket()
     holder.bind(("127.0.0.1", 0))

@@ -1,0 +1,807 @@
+"""Self-healing service matrix (docs/SERVING.md §9; `make chaos`).
+
+Units: checkpoint store round trip + torn-tail/corruption property
+(random state -> write -> truncate -> restore equals the last
+consistent state), crash-loop breaker schedule, backoff/exit
+classification, admission state export/restore across the wall clock,
+journal completed-id compaction, retry_after hints, the
+/healthz-liveness vs /readyz-readiness split, and the retention sweep.
+
+End-to-end: quarantine + SLO burn survive an engine restart through the
+state checkpoint (in-process, fresh registry per incarnation); the
+restart-storm drill drives a REAL `sartsolve serve --supervised` whose
+worker crash-loops on schedule — the breaker opens (lame duck: healthz
+503 + machine-readable crash-loop rejections with retry hints), clears
+when the window passes, and the next worker serves; `submit --retry`
+honors the hint against a real lame-duck engine.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.engine import admission as adm_mod
+from sartsolver_tpu.engine import request as req_mod
+from sartsolver_tpu.engine import state as state_mod
+from sartsolver_tpu.engine.journal import RequestJournal
+from sartsolver_tpu.engine.request import parse_request
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.supervisor import (
+    CrashLoopBreaker,
+    classify_exit,
+    restart_backoff,
+)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+SOLVE_FLAGS = ["--use_cpu", "-m", "40", "-c", "1e-12"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoint store
+# ---------------------------------------------------------------------------
+
+def _random_state(rng):
+    tenants = {
+        f"t{i}": {"failures": int(rng.integers(0, 5)),
+                  "quarantined_unix": float(rng.uniform(0, 2e9))}
+        for i in range(int(rng.integers(0, 4)))
+    }
+    return {
+        "lanes": int(rng.integers(1, 9)),
+        "admission": {
+            "tenants": tenants,
+            "seen_ids": [f"id{int(j)}"
+                         for j in rng.integers(0, 1000, size=5)],
+            "degraded_reason": (None if rng.random() < 0.5
+                                else "device OOM"),
+        },
+        "metrics": [
+            {"kind": "counter", "name": "engine_slo_ok_total",
+             "labels": {"tenant": "a"},
+             "value": float(rng.integers(0, 100))},
+        ],
+    }
+
+
+def test_checkpoint_torn_tail_property(tmp_path):
+    """Random state -> write -> truncate the tail at EVERY byte offset
+    inside the last record -> restore equals the last state whose
+    record survived intact (ISSUE satellite). No offset may ever
+    restore garbage or raise."""
+    rng = np.random.default_rng(42)
+    path = str(tmp_path / "state.jsonl")
+    store = state_mod.StateStore(path)
+    states = [_random_state(rng) for _ in range(3)]
+    offsets = [0]
+    for st in states:
+        store.save(st)
+        offsets.append(os.path.getsize(path))
+    blob = open(path, "rb").read()
+    # stride through every truncation point of the final record (and a
+    # few inside earlier ones) — restore must equal the last record
+    # that remains complete
+    for cut in list(range(offsets[2], offsets[3] + 1, 7)) + [
+            offsets[1] + 3, offsets[2] - 1]:
+        with open(path, "wb") as f:
+            f.write(blob[:cut])
+        got = state_mod.StateStore(path).load()
+        # a record is durable once its JSON bytes are all down — the
+        # trailing newline is framing, not payload
+        intact = [i for i in range(3) if offsets[i + 1] - 1 <= cut]
+        want = states[intact[-1]] if intact else None
+        assert got == want, f"cut at {cut}"
+    # a flipped byte mid-file invalidates only that record
+    with open(path, "wb") as f:
+        f.write(blob)
+    flip = offsets[2] + (offsets[3] - offsets[2]) // 2
+    corrupted = bytearray(blob)
+    # flip inside the last record's state payload digits
+    corrupted[flip] = ord("9") if corrupted[flip] != ord("9") else ord("8")
+    with open(path, "wb") as f:
+        f.write(bytes(corrupted))
+    got = state_mod.StateStore(path).load()
+    assert got in (states[1], states[2])  # never garbage, never None
+
+
+def test_checkpoint_compaction_and_serial(tmp_path):
+    store = state_mod.StateStore(str(tmp_path / "s.jsonl"))
+    for i in range(10):
+        store.save({"i": i})
+    size_before = store.size()
+    store.compact()
+    assert store.size() < size_before
+    assert len(open(store.path).readlines()) == 1
+    fresh = state_mod.StateStore(store.path)
+    assert fresh.load() == {"i": 9}
+    assert fresh.serial == 10  # serial survives compaction
+    fresh.save({"i": 10})
+    assert state_mod.StateStore(store.path).load() == {"i": 10}
+
+
+def test_checkpoint_fault_site_retries(tmp_path, monkeypatch):
+    monkeypatch.setenv("SART_RETRY_BASE_DELAY", "0.01")
+    store = state_mod.StateStore(str(tmp_path / "s.jsonl"))
+    with faults.injected(faults.SITE_STATE_CHECKPOINT, "io", 1.0,
+                         count=2):
+        store.save({"ok": True})
+    assert store.load() == {"ok": True}
+
+
+def test_metrics_capture_restore_merge():
+    obs_metrics.reset_registry()
+    reg = obs_metrics.get_registry()
+    reg.counter("engine_slo_ok_total", tenant="a").inc(3)
+    reg.histogram("engine_queue_wait_s").observe(0.5)
+    reg.gauge("engine_queue_depth").set(7)  # gauges NOT carried
+    reg.counter("frames_total").inc()  # non-engine families NOT carried
+    snap = state_mod.capture_metrics(reg)
+    names = {s["name"] for s in snap}
+    assert names == {"engine_slo_ok_total", "engine_queue_wait_s"}
+    fresh = obs_metrics.reset_registry()
+    fresh.counter("engine_slo_ok_total", tenant="a").inc(2)
+    state_mod.restore_metrics(fresh, snap)
+    assert fresh.counter("engine_slo_ok_total", tenant="a").value == 5
+    assert fresh.histogram("engine_queue_wait_s").count == 1
+
+
+# ---------------------------------------------------------------------------
+# breaker / backoff / exit classification
+# ---------------------------------------------------------------------------
+
+def test_crash_loop_breaker_opens_and_clears_on_schedule():
+    b = CrashLoopBreaker(threshold=3, window_s=10.0)
+    b.record(0.0)
+    b.record(2.0)
+    assert not b.open(2.0)
+    b.record(4.0)
+    assert b.open(4.0)
+    # clears exactly when the crash holding the count at threshold ages
+    # out of the window (the first one here)
+    assert b.remaining_s(4.0) == pytest.approx(6.0)
+    assert b.open(9.9)
+    assert not b.open(10.1)
+    assert b.remaining_s(10.1) == 0.0
+
+
+def test_restart_backoff_bounded():
+    assert restart_backoff(1, 1.0, 30.0) == 1.0
+    assert restart_backoff(4, 1.0, 30.0) == 8.0
+    assert restart_backoff(20, 1.0, 30.0) == 30.0  # capped
+    assert restart_backoff(0, 1.0, 30.0) == 0.0
+
+
+def test_classify_exit_vocabulary():
+    assert classify_exit(-signal.SIGKILL) == "signal:SIGKILL"
+    assert classify_exit(-signal.SIGSEGV) == "signal:SIGSEGV"
+    assert classify_exit(3) == "infrastructure"
+    assert classify_exit(7) == "exit:7"
+
+
+# ---------------------------------------------------------------------------
+# admission state export/restore
+# ---------------------------------------------------------------------------
+
+def test_admission_state_roundtrip_quarantine_wall_clock():
+    """A quarantined tenant exported at T stays quarantined in a fresh
+    controller for the REMAINING cooldown — downtime between crash and
+    restart counts against it (wall-clock deadlines)."""
+    obs_metrics.reset_registry()
+    clock = {"t": 100.0}
+    adm = adm_mod.AdmissionController(
+        max_queue=8, quarantine_after=1, quarantine_cooldown=50.0,
+        clock=lambda: clock["t"],
+    )
+    r = parse_request({"id": "q1", "tenant": "noisy"})
+    assert adm.admit(r) is None
+    adm.note_dispatched(r)
+    adm.note_outcome(r, req_mod.REQ_FAILED)
+    assert adm.quarantined_tenants() == ["noisy"]
+    assert adm.quarantine_left_s("noisy") == pytest.approx(50.0)
+    state = adm.export_state()
+    assert "q1" in state["seen_ids"]
+    # fresh controller (fresh monotonic origin), restored
+    clock2 = {"t": 7.0}
+    adm2 = adm_mod.AdmissionController(
+        max_queue=8, quarantine_after=1,
+        clock=lambda: clock2["t"],
+    )
+    adm2.restore_state(state)
+    assert adm2.quarantined_tenants() == ["noisy"]
+    assert adm2.admit(parse_request({"id": "q2", "tenant": "noisy"})) \
+        == req_mod.REASON_TENANT_QUARANTINED
+    # the dedup watermark survived too
+    assert adm2.admit(parse_request({"id": "q1", "tenant": "calm"})) \
+        == req_mod.REASON_DUPLICATE
+    # cooldown expiry readmits (the restored deadline, not a fresh one)
+    clock2["t"] = 7.0 + 51.0
+    assert adm2.admit(parse_request({"id": "q3", "tenant": "noisy"})) \
+        is None
+
+
+def test_admission_state_streak_survives():
+    obs_metrics.reset_registry()
+    adm = adm_mod.AdmissionController(max_queue=8, quarantine_after=3)
+    for i in range(2):
+        r = parse_request({"id": f"f{i}", "tenant": "shaky"})
+        adm.admit(r)
+        adm.note_dispatched(r)
+        adm.note_outcome(r, req_mod.REQ_FAILED)
+    adm2 = adm_mod.AdmissionController(max_queue=8, quarantine_after=3)
+    adm2.restore_state(adm.export_state())
+    # one more failure in the NEW incarnation completes the streak
+    r = parse_request({"id": "f2", "tenant": "shaky"})
+    adm2.admit(r)
+    adm2.note_dispatched(r)
+    adm2.note_outcome(r, req_mod.REQ_FAILED)
+    assert adm2.quarantined_tenants() == ["shaky"]
+
+
+# ---------------------------------------------------------------------------
+# journal compaction
+# ---------------------------------------------------------------------------
+
+def test_journal_compaction_drops_completed_keeps_pending(tmp_path):
+    j = RequestJournal(str(tmp_path / "j.jsonl"))
+    done = parse_request({"id": "done", "tenant": "a"})
+    run1 = parse_request({"id": "run1", "tenant": "b", "deadline_s": 9})
+    run2 = parse_request({"id": "run2", "tenant": "b", "trace": "tr-2"})
+    j.accepted(done)
+    j.dispatched(done)
+    j.completed(done, {"status": "completed"})
+    j.accepted(run1)
+    j.dispatched(run1)
+    j.accepted(run2)
+    before = j.size()
+    reclaimed = j.compact()
+    assert reclaimed > 0 and j.size() < before
+    completed, pending = j.replay()
+    assert not completed
+    assert [r.id for r in pending] == ["run1", "run2"]
+    assert pending[0].deadline_s == 9  # payload survives compaction
+    assert pending[1].trace == "tr-2"  # trace id survives compaction
+    assert j.compact() >= 0  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# in-process engine drills (shared resident session)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    td = tmp_path_factory.mktemp("selfheal_world")
+    paths, *_ = fx.write_world(str(td), n_frames=4)
+    return paths
+
+
+@pytest.fixture(scope="module")
+def session(world):
+    from sartsolver_tpu.cli import _validate
+    from sartsolver_tpu.engine.cli import build_serve_parser
+    from sartsolver_tpu.engine.session import ResidentSession
+
+    args = build_serve_parser().parse_args([
+        "--engine_dir", "/nonexistent-unused", *SOLVE_FLAGS,
+        world["rtm_a1"], world["rtm_a2"], world["rtm_b"],
+        world["img_a"], world["img_b"],
+    ])
+    _validate(args)
+    return ResidentSession.build(args)
+
+
+def _run_server(session, eng_dir, requests, *, idle_exit=0.4, **kw):
+    from sartsolver_tpu.engine.server import EngineServer
+
+    os.makedirs(os.path.join(eng_dir, "ingest"), exist_ok=True)
+    for i, payload in enumerate(requests):
+        with open(os.path.join(eng_dir, "ingest",
+                               f"{i:03d}-{payload['id']}.json"),
+                  "w") as f:
+            json.dump(payload, f)
+    admission = kw.pop("admission", None)
+    if admission is None:
+        admission = adm_mod.AdmissionController(
+            max_queue=kw.pop("max_queue", 16),
+            quarantine_after=kw.pop("quarantine_after", 3),
+            quarantine_cooldown=kw.pop("quarantine_cooldown", 60.0),
+        )
+    server = EngineServer(
+        session, engine_dir=eng_dir, lanes=kw.pop("lanes", 2),
+        admission=admission, poll_interval=0.05, idle_exit=idle_exit,
+        **kw,
+    )
+    rc = server.run()
+    return server, rc
+
+
+def _response(eng_dir, rid):
+    with open(os.path.join(eng_dir, "responses", f"{rid}.json")) as f:
+        return json.load(f)
+
+
+def test_quarantine_and_slo_survive_restart(session, tmp_path):
+    """The ISSUE acceptance e2e: a quarantined tenant stays quarantined
+    across a crash (fresh process state restored from the checkpoint),
+    and SLO burn / request counters are continuous — each incarnation
+    resets the registry like a real restart does."""
+    eng = str(tmp_path / "eng")
+    # incarnation 1: the noisy tenant fails its way into quarantine
+    obs_metrics.reset_registry()
+    with faults.injected(faults.SITE_SESSION_ATTACH, "error", 1.0,
+                         count=1):
+        server1, rc = _run_server(
+            session, eng, [{"id": "n1", "tenant": "noisy"}],
+            quarantine_after=1, slo_ms=300000.0,
+        )
+    assert rc == 0
+    assert _response(eng, "n1")["outcome"]["status"] == "failed"
+    assert server1.admission.quarantined_tenants() == ["noisy"]
+
+    # incarnation 2: fresh registry + fresh admission controller, same
+    # engine dir — the checkpoint must restore the quarantine
+    obs_metrics.reset_registry()
+    server2, rc = _run_server(
+        session, eng, [{"id": "n2", "tenant": "noisy"},
+                       {"id": "c1", "tenant": "calm"}],
+        quarantine_after=1, slo_ms=300000.0,
+    )
+    assert rc == 0
+    n2 = _response(eng, "n2")
+    assert n2["reason"] == req_mod.REASON_TENANT_QUARANTINED
+    assert n2["retry_after_s"] > 0  # remaining cooldown rides the reply
+    assert _response(eng, "c1")["outcome"]["status"] == "completed"
+    # counter continuity: the requests_total family accounts BOTH
+    # incarnations (failed n1 + completed c1), and SLO burn continues
+    reg = obs_metrics.get_registry()
+    assert reg.counter("engine_requests_total", outcome="failed").value \
+        == 1
+    assert reg.counter("engine_requests_total",
+                       outcome="completed").value == 1
+    slo = (reg.counter("engine_slo_ok_total", tenant="noisy").value
+           + reg.counter("engine_slo_ok_total", tenant="calm").value
+           + reg.counter("engine_slo_breach_total",
+                         tenant="noisy").value
+           + reg.counter("engine_slo_breach_total", tenant="calm").value)
+    assert slo == 2  # n1 + c1, across the restart
+
+
+def test_oom_lane_ladder_survives_restart(session, tmp_path):
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    with faults.injected(faults.SITE_SOLVE, "oom", 1.0, count=1):
+        server1, rc = _run_server(session, eng,
+                                  [{"id": "o1", "tenant": "a"}], lanes=2)
+    assert rc == 0 and server1.lanes == 1
+    obs_metrics.reset_registry()
+    server2, rc = _run_server(session, eng,
+                              [{"id": "o2", "tenant": "a"}], lanes=2)
+    assert rc == 0
+    assert server2.lanes == 1  # sticky across the restart
+    assert server2.admission.degraded_reason is not None
+
+
+def test_queue_full_rejection_carries_retry_after(session, tmp_path):
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    _run_server(session, eng, [
+        {"id": "r1", "tenant": "a"},
+        {"id": "r2", "tenant": "a"},
+        {"id": "r3", "tenant": "a"},
+    ], max_queue=1, max_cycle_requests=1)
+    shed = [
+        _response(eng, rid) for rid in ("r1", "r2", "r3")
+        if _response(eng, rid).get("reason") == req_mod.REASON_QUEUE_FULL
+    ]
+    assert shed and all(r["retry_after_s"] >= 1.0 for r in shed)
+
+
+def test_journal_startup_compaction_and_response_ttl(session, tmp_path):
+    """Round 1 completes a request; round 2 starts up with rotation
+    enabled -> the completed records compact away while the dedup
+    watermark (checkpoint) still rejects the duplicate; an aged
+    response file is swept by the TTL."""
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    _run_server(session, eng, [{"id": "keep", "tenant": "a"}])
+    j = RequestJournal(os.path.join(eng, "journal.jsonl"))
+    completed, _ = j.replay()
+    assert set(completed) == {"keep"}
+    # age the response file beyond the TTL
+    resp = os.path.join(eng, "responses", "keep.json")
+    old = time.time() - 3600
+    os.utime(resp, (old, old))
+
+    obs_metrics.reset_registry()
+    server2, rc = _run_server(
+        session, eng, [{"id": "keep", "tenant": "a"},
+                       {"id": "new", "tenant": "a"}],
+        response_ttl_s=60.0, idle_exit=0.4,
+    )
+    # startup compaction dropped the completed story...
+    completed, pending = j.replay()
+    assert set(completed) == {"new"} and not pending
+    # ...but the checkpointed watermark still treats the resubmission
+    # as the duplicate it is: recorded outcome answered, never re-run
+    keep = _response(eng, "keep")
+    assert keep.get("duplicate") is True
+    assert keep["outcome"]["status"] == "completed"
+    assert _response(eng, "new")["outcome"]["status"] == "completed"
+    # force one sweep past the throttle and check the aged file went
+    server2._last_sweep = 0.0
+    os.utime(resp, (old, old))
+    server2._sweep_retention()
+    assert not os.path.exists(resp)
+    assert os.path.exists(os.path.join(eng, "responses", "new.json"))
+
+
+def test_compaction_skipped_when_checkpoint_fails(session, tmp_path,
+                                                  monkeypatch):
+    """Journal compaction drops completed ids ONLY once their dedup
+    watermark is durable in the checkpoint — a failing checkpoint must
+    keep the fat journal (or a restart could re-solve a resubmitted
+    completed request)."""
+    from sartsolver_tpu.engine.server import EngineServer
+
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    _run_server(session, eng, [{"id": "c1", "tenant": "a"}])
+    j = RequestJournal(os.path.join(eng, "journal.jsonl"))
+    completed, _ = j.replay()
+    assert set(completed) == {"c1"}
+    monkeypatch.setenv("SART_RETRY_ATTEMPTS", "1")
+    obs_metrics.reset_registry()
+    server = EngineServer(
+        session, engine_dir=eng, lanes=2,
+        admission=adm_mod.AdmissionController(max_queue=4),
+    )
+    with faults.injected(faults.SITE_STATE_CHECKPOINT, "io", 1.0):
+        server._rotate_journal(startup=True)
+    completed, _ = j.replay()
+    assert set(completed) == {"c1"}  # completed story preserved
+    reg = obs_metrics.get_registry()
+    assert reg.counter("engine_checkpoint_failures_total").value >= 1
+    # with the checkpoint healthy again, the same call compacts
+    server._rotate_journal(startup=True)
+    completed, _ = j.replay()
+    assert not completed
+
+
+def test_replay_skips_expired_response_republish(session, tmp_path):
+    """A response the TTL sweep deleted must not come back (with a
+    fresh mtime and another full TTL) just because its completed
+    record still sits in the journal at restart."""
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    _run_server(session, eng, [{"id": "aged", "tenant": "a"}])
+    os.unlink(os.path.join(eng, "responses", "aged.json"))
+    # age the completed marker two hours into the past
+    jp = os.path.join(eng, "journal.jsonl")
+    lines = []
+    for line in open(jp):
+        rec = json.loads(line)
+        if rec.get("marker") == "completed":
+            rec["unix"] = time.time() - 7200
+        lines.append(json.dumps(rec) + "\n")
+    with open(jp, "w") as f:
+        f.writelines(lines)
+    obs_metrics.reset_registry()
+    _run_server(session, eng, [], idle_exit=0.2,
+                response_ttl_s=3600.0, journal_rotate_bytes=0)
+    assert not os.path.exists(
+        os.path.join(eng, "responses", "aged.json")
+    )
+
+
+def test_replay_republishes_missing_response(session, tmp_path):
+    """A kill after the completed marker but before the response write
+    (the mid-response-write chaos window) must not leave the submitter
+    polling forever: restart republishes from the journaled outcome —
+    both when the response file is GONE and when it still shows the
+    stale acceptance verdict (the real kill leaves 'pending' behind)."""
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    # rotation off: these restarts must find the completed record in
+    # the journal (startup compaction would consume it between runs)
+    _run_server(session, eng, [{"id": "gone", "tenant": "a"}],
+                journal_rotate_bytes=0)
+    os.unlink(os.path.join(eng, "responses", "gone.json"))
+    obs_metrics.reset_registry()
+    _run_server(session, eng, [], idle_exit=0.2, journal_rotate_bytes=0)
+    rec = _response(eng, "gone")
+    assert rec["state"] == "done" and rec.get("republished") is True
+    assert rec["outcome"]["status"] == "completed"
+    # stale-pending variant: overwrite with the acceptance response
+    with open(os.path.join(eng, "responses", "gone.json"), "w") as f:
+        json.dump({"unix": 1.0, "id": "gone", "verdict": "accepted",
+                   "state": "pending", "tenant": "a"}, f)
+    obs_metrics.reset_registry()
+    _run_server(session, eng, [], idle_exit=0.2, journal_rotate_bytes=0)
+    rec = _response(eng, "gone")
+    assert rec["state"] == "done" and rec.get("republished") is True
+
+
+# ---------------------------------------------------------------------------
+# /healthz liveness vs /readyz readiness
+# ---------------------------------------------------------------------------
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_healthz_liveness_vs_readyz_readiness(session, tmp_path):
+    """Pinned byte-stable vocabulary (ISSUE satellite): /healthz answers
+    live-200 whatever the admission state; /readyz flips not-ready with
+    the machine-readable reason for draining and degraded."""
+    from sartsolver_tpu.engine.httpd import EngineHTTPServer
+    from sartsolver_tpu.engine.server import EngineServer
+
+    obs_metrics.reset_registry()
+    server = EngineServer(
+        session, engine_dir=str(tmp_path / "eng"), lanes=2,
+        admission=adm_mod.AdmissionController(max_queue=4),
+    )
+    srv = EngineHTTPServer(
+        0, metrics_snapshot=lambda: [], health=server._health,
+        ready=server._ready, status=lambda: {},
+    )
+    srv.start()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        code, body = _get(base + "/healthz")
+        assert code == 200 and json.loads(body) == {"status": "live"}
+        code, body = _get(base + "/readyz")
+        assert code == 200 and json.loads(body) == {"status": "ready"}
+        # degraded: live stays 200, ready goes 503/degraded
+        server.admission.set_degraded("device OOM; lanes halved to 1")
+        assert _get(base + "/healthz")[0] == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(base + "/readyz")
+        assert exc.value.code == 503
+        rec = json.loads(exc.value.read())
+        assert rec["status"] == "not-ready"
+        assert rec["reason"] == req_mod.REASON_DEGRADED
+        # draining outranks degraded; healthz still live
+        server._draining = True
+        assert _get(base + "/healthz")[0] == 200
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(base + "/readyz")
+        assert json.loads(exc.value.read())["reason"] \
+            == req_mod.REASON_DRAINING
+    finally:
+        srv.stop()
+
+
+def test_lame_duck_never_clobbers_done_response(tmp_path):
+    """A resubmission of a COMPLETED id arriving during lame duck is a
+    duplicate: the recorded outcome must survive (the engine's
+    never-clobber contract), not be overwritten with a crash-loop
+    rejection."""
+    from sartsolver_tpu.resilience.supervisor import Supervisor
+
+    obs_metrics.reset_registry()
+    eng = str(tmp_path / "eng")
+    sup = Supervisor([], engine_dir=eng)
+    done_rec = {"unix": 1.0, "id": "dup1", "verdict": "accepted",
+                "state": "done", "outcome": {"status": "completed"}}
+    with open(os.path.join(eng, "responses", "dup1.json"), "w") as f:
+        json.dump(done_rec, f)
+    for rid in ("dup1", "new1"):
+        with open(os.path.join(eng, "ingest", f"{rid}.json"), "w") as f:
+            json.dump({"id": rid, "tenant": "a"}, f)
+    n = sup._reject_ingest(remaining_s=9.0)
+    # the new id got the crash-loop rejection; the completed one kept
+    # its recorded outcome and its ingest file was consumed
+    assert n == 1
+    assert not os.listdir(os.path.join(eng, "ingest"))
+    assert json.load(open(os.path.join(
+        eng, "responses", "dup1.json"))) == done_rec
+    new1 = json.load(open(os.path.join(eng, "responses", "new1.json")))
+    assert new1["reason"] == req_mod.REASON_CRASH_LOOP
+    assert new1["retry_after_s"] == 9.0
+
+
+# ---------------------------------------------------------------------------
+# restart storm: real supervised process
+# ---------------------------------------------------------------------------
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    for k in ("SART_FAULT", "SART_TEST_JOURNAL_DELAY",
+              "SART_TEST_SERVE_CRASH"):
+        env.pop(k, None)
+    for k, v in (extra or {}).items():
+        env[k] = v
+    return env
+
+
+def _supervised_cmd(paths, eng_dir, *extra):
+    return [
+        sys.executable, "-m", "sartsolver_tpu.cli", "serve",
+        "--engine_dir", eng_dir, *SOLVE_FLAGS,
+        "--lanes", "2", "--poll_interval", "0.05", "--supervised",
+        *extra,
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+    ]
+
+
+def test_restart_storm_breaker_opens_and_clears(world, tmp_path):
+    """The restart-storm drill (ISSUE satellite): a crash-looping worker
+    trips the breaker on schedule -> lame duck (healthz 503 crash-loop,
+    ingest rejected with the machine-readable reason + retry hint,
+    engine_crash_loop gauge up) -> the window clears, the fixed worker
+    serves, SIGTERM drains through the supervisor with exit 4."""
+    eng = str(tmp_path / "eng")
+    marker = str(tmp_path / "crash.marker")
+    open(marker, "w").write("boom")
+    env = _env({"SART_TEST_SERVE_CRASH": marker})
+    proc = subprocess.Popen(
+        _supervised_cmd(
+            world, eng,
+            "--restart_backoff", "0.05", "--restart_backoff_max", "0.2",
+            "--crash_loop_window", "25", "--crash_loop_threshold", "3",
+            "--http_port", "0",
+        ),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    guard = threading.Timer(240, proc.kill)
+    guard.start()
+    lines = []
+    state = {"resident": False, "port": None}
+    try:
+        lame_seen = False
+        for line in proc.stdout:
+            lines.append(line)
+            if "lame-duck-enter" in line and not lame_seen:
+                lame_seen = True
+                # worker is gone: the marker can come off so the breaker
+                # half-open spawn succeeds after the window clears
+                os.unlink(marker)
+                # journals-but-refuses: a request arriving now gets the
+                # crash-loop rejection with a retry hint
+                ingest = os.path.join(eng, "ingest")
+                os.makedirs(ingest, exist_ok=True)
+                with open(os.path.join(ingest, "ld1.json.tmp"),
+                          "w") as f:
+                    json.dump({"id": "ld1", "tenant": "a"}, f)
+                os.replace(os.path.join(ingest, "ld1.json.tmp"),
+                           os.path.join(ingest, "ld1.json"))
+            m = re.search(r"lame-duck-endpoint port=(\d+)", line)
+            if m:
+                state["port"] = int(m.group(1))
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    _get(f"http://127.0.0.1:{state['port']}/healthz")
+                assert exc.value.code == 503
+                assert json.loads(exc.value.read())["status"] \
+                    == req_mod.REASON_CRASH_LOOP
+                with pytest.raises(urllib.error.HTTPError) as exc:
+                    _get(f"http://127.0.0.1:{state['port']}/readyz")
+                rec = json.loads(exc.value.read())
+                assert rec == {"status": "not-ready",
+                               "reason": req_mod.REASON_CRASH_LOOP,
+                               "detail": rec["detail"]}
+            if "session resident" in line:
+                state["resident"] = True
+                proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        guard.cancel()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    text = "".join(lines)
+    assert rc == 4, text[-4000:]
+    assert lame_seen and state["resident"], text[-4000:]
+    assert "lame-duck-exit" in text
+    # three on-schedule crashes, classified
+    assert text.count("worker-crash code=") == 3
+    assert "reason=infrastructure" in text
+    # the lame-duck rejection landed machine-readable with a hint
+    rec = _response(eng, "ld1")
+    assert rec["verdict"] == "rejected"
+    assert rec["reason"] == req_mod.REASON_CRASH_LOOP
+    assert rec["retry_after_s"] >= 1.0
+    # durable supervisor artifacts: events journal + prom textfile
+    kinds = [json.loads(ln)["kind"]
+             for ln in open(os.path.join(eng, "supervisor.jsonl"))]
+    assert sum(k == "worker-crash" for k in kinds) == 3
+    assert "lame-duck-enter" in kinds and "lame-duck-exit" in kinds
+    prom = open(os.path.join(eng, "supervisor.prom")).read()
+    assert 'sart_engine_restarts_total{reason="infrastructure"} 3' \
+        in prom
+    assert "sart_engine_crash_loop" in prom
+    # the supervisor crash bundle names the breaker
+    bundle = json.load(open(os.path.join(eng, "supervisor.crash.json")))
+    assert "crash-loop" in bundle["reason"]
+
+
+def test_supervisor_config_error_is_final(world, tmp_path):
+    """A worker that exits 1 (flag error) must NOT be restarted — the
+    supervisor surfaces the config problem instead of looping."""
+    res = subprocess.run(
+        _supervised_cmd(world, str(tmp_path / "eng"),
+                        "--restart_backoff", "0.05", "--lanes", "0"),
+        env=_env(), capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 1
+    text = res.stdout + res.stderr
+    assert "worker-config-error" in text
+    assert "worker-crash" not in text
+
+
+def test_submit_retry_honors_hint_against_lame_duck(world, tmp_path):
+    """`submit --retry` against a crash-looping engine: the first
+    attempt is rejected crash-loop with a hint; once the breaker clears
+    and the worker serves, a retry completes the request."""
+    eng = str(tmp_path / "eng")
+    marker = str(tmp_path / "crash.marker")
+    open(marker, "w").write("boom")
+    env = _env({"SART_TEST_SERVE_CRASH": marker})
+    proc = subprocess.Popen(
+        _supervised_cmd(
+            world, eng,
+            "--restart_backoff", "0.05", "--restart_backoff_max", "0.2",
+            "--crash_loop_window", "20", "--crash_loop_threshold", "2",
+        ),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True,
+    )
+    guard = threading.Timer(240, proc.kill)
+    guard.start()
+    lines = []
+    submit = {"res": None}
+    try:
+        for line in proc.stdout:
+            lines.append(line)
+            if "lame-duck-enter" in line and submit["res"] is None:
+                os.unlink(marker)
+
+                def do_submit():
+                    submit["res"] = subprocess.run(
+                        [sys.executable, "-m", "sartsolver_tpu.cli",
+                         "submit", "--engine_dir", eng, "--id", "rt1",
+                         "--tenant", "a", "--wait", "120",
+                         "--retry", "8"],
+                        env=_env({"SART_RETRY_BASE_DELAY": "0.2",
+                                  "SART_RETRY_DEADLINE": "180"}),
+                        capture_output=True, text=True, timeout=200,
+                    )
+                    # retries done: drain the engine so the test ends
+                    proc.send_signal(signal.SIGTERM)
+
+                threading.Thread(target=do_submit, daemon=True).start()
+        rc = proc.wait(timeout=200)
+    finally:
+        guard.cancel()
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+    res = submit["res"]
+    assert res is not None and res.returncode == 0, (
+        (res.stdout + res.stderr if res else "no submit result")
+        + "".join(lines)[-3000:]
+    )
+    rec = json.loads(res.stdout)
+    assert rec["outcome"]["status"] == "completed"
+    assert "rejected (crash-loop); retry" in res.stderr
+    assert rc == 4
