@@ -94,6 +94,16 @@ def build_lint_parser() -> argparse.ArgumentParser:
     p.add_argument("--ignore", default="",
                    help="Comma-separated rule-id prefixes to skip, e.g. "
                         "'SL1'; applied after --select.")
+    p.add_argument("--protocol", action="store_true",
+                   help="Run the crash-point model checker: enumerate a "
+                        "crash at every durable-effect prefix (and every "
+                        "byte boundary of every append) of the engine's "
+                        "exactly-once protocol and assert the chaos "
+                        "invariants over each (analysis/protocol.py).")
+    p.add_argument("--protocol-stride", type=int, default=1,
+                   metavar="N",
+                   help="Thin the torn-append byte boundaries to every "
+                        "Nth byte (default 1: every byte).")
     p.add_argument("--json", dest="json_", action="store_true",
                    help="Machine-readable output (findings + audit reports).")
     p.add_argument("--list-rules", action="store_true",
@@ -173,7 +183,8 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
         return 0
 
     if not (args.paths or args.self_ or args.audit_only
-            or args.update_goldens or args.update_cost_goldens):
+            or args.update_goldens or args.update_cost_goldens
+            or args.protocol):
         print("sartsolve lint: pass paths to lint, or --self for the "
               "installed package (see --help).", file=sys.stderr)
         return 1
@@ -205,6 +216,22 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
             update_cost_goldens=args.update_cost_goldens,
         )
 
+    # ---- crash-point model checker ---------------------------------------
+    protocol_report = None
+    if args.protocol:
+        from sartsolver_tpu.analysis.protocol import run_protocol_check
+
+        # the drill spins up thousands of fsync-heavy scratch dirs;
+        # tmpfs makes that free without weakening the check (the crash
+        # states are constructed, not produced by real power loss)
+        if not os.environ.get("TMPDIR") and os.path.isdir("/dev/shm"):
+            os.environ["TMPDIR"] = "/dev/shm"
+            import tempfile
+
+            tempfile.tempdir = None  # re-read TMPDIR
+        protocol_report = run_protocol_check(
+            byte_stride=args.protocol_stride)
+
     n_err = sum(1 for f in findings if f.severity == "error")
     n_warn = sum(1 for f in findings if f.severity == "warning")
     n_info = len(findings) - n_err - n_warn
@@ -216,6 +243,8 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
         print(json.dumps({
             "findings": [dataclasses.asdict(f) for f in findings],
             "audit": [dataclasses.asdict(r) for r in reports],
+            "protocol": (dataclasses.asdict(protocol_report)
+                         if protocol_report else None),
             "errors": n_err,
             "warnings": n_warn,
             # which rules actually ran, and why (the --select/--ignore
@@ -258,6 +287,21 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
                 "diff above (docs/STATIC_ANALYSIS.md).",
                 file=sys.stderr,
             )
+        if protocol_report:
+            rep = protocol_report
+            for v in rep.violations:
+                print(f"protocol: VIOLATION {v}")
+            if not args.quiet:
+                for name in sorted(rep.scenarios_by_effect):
+                    print(f"protocol:   {name}: "
+                          f"{rep.scenarios_by_effect[name]} crash "
+                          f"state(s)")
+            print(f"protocol: {rep.scenarios_total} crash state(s) "
+                  f"over {rep.effects_armed} durable effects "
+                  f"({rep.effect_points} declared effect points, "
+                  f"byte stride {rep.byte_stride}): "
+                  f"{len(rep.violations)} violation(s), commit order "
+                  f"{'ok' if rep.commit_order_ok else 'VIOLATED'}")
         summary = (
             f"lint: {n_err} error(s), {n_warn} warning(s), "
             f"{n_info} info finding(s)"
@@ -269,4 +313,5 @@ def lint_main(argv: Optional[List[str]] = None) -> int:
             )
         print(summary)
 
-    return 1 if n_err or failed_reports else 0
+    return 1 if (n_err or failed_reports
+                 or (protocol_report and not protocol_report.ok)) else 0

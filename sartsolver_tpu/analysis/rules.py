@@ -986,11 +986,12 @@ def lint_paths(
     return findings
 
 
-# ---- concurrency family (SL101..) ----------------------------------------
-# Imported last: concurrency.py needs Rule/ModuleModel/Finding from above.
-# Import order is safe either way round — importing concurrency directly
+# ---- concurrency (SL101..) / durability (SL201..) families ---------------
+# Imported last: both need Rule/ModuleModel/Finding from above. Import
+# order is safe either way round — importing a family module directly
 # first triggers the analysis package __init__, which imports this module
 # before any submodule body runs.
 from sartsolver_tpu.analysis.concurrency import CONCURRENCY_RULES  # noqa: E402
+from sartsolver_tpu.analysis.durability import DURABILITY_RULES  # noqa: E402
 
-ALL_RULES = JAX_RULES + CONCURRENCY_RULES
+ALL_RULES = JAX_RULES + CONCURRENCY_RULES + DURABILITY_RULES

@@ -271,7 +271,10 @@ class AdmissionController:
         quarantined when the process died stays quarantined for
         whatever cooldown its wall-clock deadline still holds."""
         now_mono = self._clock()
-        now_unix = time.time()
+        # deliberate wall-clock: quarantine deadlines are checkpointed
+        # as unix stamps exactly so the REMAINING cooldown carries
+        # across restarts — the nondeterminism is the design
+        now_unix = time.time()  # sart-lint: disable=SL204
         for name, rec in (state.get("tenants") or {}).items():
             st = self._tenant(str(name))
             st.failures = max(st.failures, int(rec.get("failures", 0)))

@@ -31,6 +31,7 @@ from sartsolver_tpu.engine.request import (
     RequestError,
     parse_request,
 )
+from sartsolver_tpu.utils import atomicio
 
 EXIT_OK = 0
 EXIT_INPUT_ERROR = 1
@@ -561,12 +562,13 @@ def _submit_attempt(args, req, payload_text):
               file=sys.stderr)
         return None, EXIT_INFRASTRUCTURE
     t_submit = time.time()
-    tmp = os.path.join(ingest, f".{req.id}.{os.getpid()}.tmp")
     final = os.path.join(ingest, f"{req.id}.json")
     try:
-        with open(tmp, "w") as f:
-            f.write(payload_text)
-        os.replace(tmp, final)
+        # atomic rename publish: the engine's ingest scan only picks up
+        # `*.json`, and atomicio's tmp name (`<id>.json.<pid>.tmp`)
+        # never matches, so a torn submit is invisible to the scan.
+        # fsync'd so a machine crash can't admit a truncated request.
+        atomicio.write_atomic(final, payload_text, fsync=True)
     except OSError as err:
         print(f"sartsolve submit: submit failed: {err}", file=sys.stderr)
         return None, EXIT_INFRASTRUCTURE

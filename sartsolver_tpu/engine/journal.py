@@ -46,6 +46,7 @@ from sartsolver_tpu.engine.request import Request
 from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience import faults
 from sartsolver_tpu.resilience.retry import retry_call
+from sartsolver_tpu.utils import atomicio
 
 MARKER_ACCEPTED = "accepted"
 MARKER_DISPATCHED = "dispatched"
@@ -69,7 +70,7 @@ class RequestJournal:
     """Append-only journal over one JSONL file."""
 
     def __init__(self, path: str):
-        self.path = path
+        self.path = path  # durable: journal
 
     # ---- append ----------------------------------------------------------
 
@@ -97,10 +98,7 @@ class RequestJournal:
 
         def write() -> None:
             faults.fire(faults.SITE_JOURNAL_APPEND)
-            with open(self.path, "a") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
+            atomicio.append_line(self.path, line)
 
         # transient fs blips (an NFS hiccup under the engine dir) retry
         # with the shared policy; exhaustion raises RetriesExhausted,
@@ -221,10 +219,5 @@ class RequestJournal:
                 rec["trace"] = req.trace
             rec["request"] = req.to_dict()
             lines.append(json.dumps(rec) + "\n")
-        tmp = f"{self.path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            f.writelines(lines)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        atomicio.write_atomic(self.path, "".join(lines))
         return max(0, before - self.size())

@@ -46,11 +46,13 @@ from sartsolver_tpu.config import SDC_DETECTED, SartInputError
 from sartsolver_tpu.engine import request as reqmod
 from sartsolver_tpu.engine.admission import AdmissionController
 from sartsolver_tpu.engine.journal import RequestJournal
+from sartsolver_tpu.engine.protocol import needs_republish, uncounted_completed
 from sartsolver_tpu.engine.request import Request, RequestError, parse_request
 from sartsolver_tpu.engine.session import ResidentSession, absolute_deadline
 from sartsolver_tpu.obs import metrics as obs_metrics
 from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience import shutdown, watchdog
+from sartsolver_tpu.utils import atomicio
 from sartsolver_tpu.resilience.failures import (
     DEADLINE_EXCEEDED,
     DIVERGED,
@@ -124,7 +126,7 @@ class EngineServer:
         self.engine_dir = engine_dir
         self.ingest_dir = os.path.join(engine_dir, "ingest")
         self.outputs_dir = os.path.join(engine_dir, "outputs")
-        self.responses_dir = os.path.join(engine_dir, "responses")
+        self.responses_dir = os.path.join(engine_dir, "responses")  # durable: response
         for d in (engine_dir, self.ingest_dir, self.outputs_dir,
                   self.responses_dir):
             os.makedirs(d, exist_ok=True)
@@ -141,11 +143,12 @@ class EngineServer:
         self.response_ttl_s = max(0.0, float(response_ttl_s))
         self.trace_ttl_s = max(0.0, float(trace_ttl_s))
         self._last_sweep = 0.0
+        # checkpointed by: _save_state
         self.admission = admission if admission is not None \
             else AdmissionController(on_event=self._event)
         if self.admission._on_event is None:
             self.admission._on_event = self._event
-        self.lanes = int(lanes)
+        self.lanes = int(lanes)  # checkpointed by: _save_state
         self.initial_lanes = int(lanes)
         self.poll_interval = float(poll_interval)
         self.socket_path = socket_path
@@ -183,6 +186,14 @@ class EngineServer:
         self._requests: Dict[str, dict] = {}
         self._draining = False
         self._cycles = 0
+        # counted-outcome watermark (insertion-ordered): the ids whose
+        # outcome counters (engine_requests_total, SLO ok/breach) have
+        # reached — or are about to reach — a durable checkpoint. Rides
+        # the state payload so replay can re-count exactly the journal-
+        # completed ids a kill between the completed marker and the
+        # next checkpoint left uncounted (chaos invariant 4: counter
+        # continuity). checkpointed by: _save_state
+        self._counted_ids: Dict[str, None] = {}
         # bounded: a serve-forever daemon must not grow a list one
         # entry per request for the process lifetime (the telemetry
         # sink and stdout get every event; this is just the recent tail)
@@ -278,7 +289,9 @@ class EngineServer:
     def _respond(self, key: str, payload: dict) -> None:
         """Atomically publish a response record a submitter can poll."""
         path = os.path.join(self.responses_dir, f"{key}.json")
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # publish stamp, not replayed state: a republished response is
+        # SUPPOSED to carry a fresh wall-clock (the submitter's poll
+        # freshness anchor)  # sart-lint: disable=SL204
         payload = {"unix": round(time.time(), 3), **payload}
         delay = os.environ.get("SART_TEST_RESPONSE_DELAY")
         if delay:
@@ -293,10 +306,11 @@ class EngineServer:
             sys.stderr.flush()
             time.sleep(float(delay))
         try:
-            with open(tmp, "w") as f:
-                json.dump(payload, f)
-                f.write("\n")
-            os.replace(tmp, path)
+            # fsync=True: the pre-atomicio publish skipped the tmp
+            # fsync, so a crash straddling the rename could publish a
+            # zero-length "atomic" response (found by the SL202 lint
+            # while extracting this helper)
+            atomicio.write_json_atomic(path, payload, fsync=True)
         except OSError as err:
             self._event(f"response write for {key!r} failed: {err}")
 
@@ -318,9 +332,16 @@ class EngineServer:
                    "error": f"{type(err).__name__}: {err}",
                    "source": source}
             with self._lock:
+                # socket-thread admissions have no checkpoint boundary
+                # of their own; the serve loop saves once per ingest
+                # batch, and the journal — not the shed counter — is
+                # the correctness backbone  # sart-lint: disable=SL205
                 self.admission.shed(reqmod.REASON_MALFORMED)
             return rec
         with self._lock:
+            # same socket-thread path as above: the accepted marker
+            # below is the durable record; the dedup watermark rides
+            # the next serve-loop save  # sart-lint: disable=SL205
             reason = self.admission.admit(req, draining=self._draining)
             if reason is None:
                 self._set_span(req, "queued")
@@ -471,9 +492,20 @@ class EngineServer:
     def _state_payload(self) -> dict:
         from sartsolver_tpu.engine.state import capture_metrics
 
+        # counted-outcome watermark, capped like the dedup watermark
+        # (same knob): insertion order means the cap drops the OLDEST
+        # ids — exactly the ones whose counters are longest-durable
+        try:
+            cap = int(os.environ.get("SART_STATE_SEEN_CAP", "100000"))
+        except ValueError:
+            cap = 100000
+        counted = list(self._counted_ids)
+        if cap > 0:
+            counted = counted[-cap:]
         return {
             "lanes": int(self.lanes),
             "admission": self.admission.export_state(),
+            "counted_ids": counted,
             "metrics": capture_metrics(obs_metrics.get_registry()),
         }
 
@@ -515,6 +547,8 @@ class EngineServer:
         if payload is None:
             return
         self.admission.restore_state(payload.get("admission") or {})
+        for rid in payload.get("counted_ids") or []:
+            self._counted_ids[str(rid)] = None
         ckpt_lanes = int(payload.get("lanes") or 0)
         if 1 <= ckpt_lanes < self.lanes:
             # the OOM ladder is sticky across restarts: restarting into
@@ -560,6 +594,28 @@ class EngineServer:
                 "records reclaimed (dedup watermark in the state "
                 "checkpoint)"
             )
+
+    def _sweep_orphan_tmp(self) -> None:
+        """Startup sweep for ``*.tmp`` debris a kill mid-atomic-write
+        left behind (responses, traces, journal/state compaction tmps
+        in the engine dir itself) — crash debris must not accumulate
+        across supervised restarts. Counted into the same
+        ``engine_retention_deleted_total{dir=}`` family as the TTL
+        sweep so one dashboard covers both reclaim paths."""
+        for label, directory in (
+            ("engine", self.engine_dir),
+            ("responses", self.responses_dir),
+            ("traces", os.path.join(self.engine_dir, "traces")),
+        ):
+            removed = atomicio.sweep_orphans(directory)
+            if removed:
+                obs_metrics.get_registry().counter(
+                    "engine_retention_deleted_total", dir=label
+                ).inc(removed)
+                self._event(
+                    f"startup sweep: {removed} orphaned .tmp file(s) "
+                    f"removed from {label}/"
+                )
 
     def _sweep_retention(self) -> None:
         """TTL sweep for responses/ and traces/ — a resident engine must
@@ -626,31 +682,50 @@ class EngineServer:
         completed, pending = self.journal.replay()
         for rid, outcome in completed.items():
             self.admission.note_seen(rid)
-            # a missing response is only the mid-response-write crash
-            # window when the completion is YOUNGER than the retention
-            # TTL — older ones were swept on purpose and must not come
-            # back with a fresh mtime (and another full TTL) on restart
-            # a record without the stamp (legacy journal) counts fresh —
-            # better one resurrected response than a lost one
-            done_unix = float(outcome.get("journal_unix")
-                              or time.time()) if outcome else 0.0
-            fresh = (not self.response_ttl_s
-                     or time.time() - done_unix < self.response_ttl_s)
-            prev = self._read_response(rid) if outcome and fresh \
-                else None
-            # stale = missing OR still showing the acceptance verdict:
-            # the kill landed after the completed marker fsync'd but
-            # before the done response replaced the pending one
-            stale = (outcome and fresh
-                     and (prev is None or prev.get("state") != "done"))
-            if stale:
-                # republish from the journaled outcome so the submitter
-                # is never left polling a done request
+            # the republish gate lives in engine/protocol.py next to
+            # the effect-point table, and the crash-point model checker
+            # (analysis/protocol.py) drives that same function over
+            # every crash prefix — stale means missing OR still showing
+            # the acceptance verdict (the kill landed after the
+            # completed marker fsync'd but before the done response
+            # replaced the pending one), age-gated by the retention TTL
+            prev = self._read_response(rid) if outcome else None
+            if needs_republish(outcome, prev,
+                               response_ttl_s=self.response_ttl_s):
                 self._respond(rid, {
                     "id": rid, "verdict": "accepted", "state": "done",
                     "trace": outcome.get("trace"), "outcome": outcome,
                     "republished": True,
                 })
+        # counter continuity (chaos invariant 4): a kill between the
+        # completed marker and the next checkpoint restored a watermark
+        # that does not cover some journal-completed ids — their
+        # outcome/SLO increments died with the process, and replay used
+        # to republish the response WITHOUT re-counting. Re-derive
+        # exactly those increments from the journaled outcomes; no save
+        # here (idempotent until _rotate_journal's startup checkpoint
+        # absorbs the watermark).
+        recounted = 0
+        for rid, outcome in uncounted_completed(completed,
+                                                self._counted_ids):
+            self._requests_ctr(
+                str(outcome.get("status") or "unknown")
+            ).inc()
+            if self.slo_ms is not None:
+                latency = float(outcome.get("latency_s") or 0.0)
+                name = ("engine_slo_breach_total"
+                        if latency * 1e3 > self.slo_ms
+                        else "engine_slo_ok_total")
+                obs_metrics.get_registry().counter(
+                    name, tenant=str(outcome.get("tenant") or "default")
+                ).inc()
+            self._counted_ids[rid] = None
+            recounted += 1
+        if recounted:
+            self._event(
+                f"journal replay: {recounted} completed outcome(s) "
+                "re-counted (crashed before their checkpoint)"
+            )
         if not completed and not pending:
             return
         for req in pending:
@@ -714,6 +789,7 @@ class EngineServer:
                        if ar.writer is not None else None),
             "solve_s": round(wall, 3),
             "latency_s": round(latency, 3),
+            "tenant": ar.req.tenant,
             "trace": trace_id,
         }
         if error:
@@ -725,9 +801,11 @@ class EngineServer:
         self._requests_ctr(outcome).inc()
         # checkpoint BEFORE the response write: the completed marker is
         # already durable, and a kill inside the response window must
-        # not lose the outcome/SLO counters — restart republishes the
-        # response WITHOUT re-running or re-counting, so whatever is not
-        # checkpointed here is gone (chaos invariant 4)
+        # not lose the outcome/SLO counters. A kill BEFORE this save is
+        # covered too: the watermark below won't land, so the restart's
+        # replay re-counts this id from its journaled outcome (chaos
+        # invariant 4: counter continuity over every crash prefix)
+        self._counted_ids[ar.req.id] = None
         self._save_state()
         self._respond(ar.req.id, {
             "id": ar.req.id, "verdict": "accepted", "state": "done",
@@ -757,12 +835,9 @@ class EngineServer:
             return
         traces_dir = os.path.join(self.engine_dir, "traces")
         path = os.path.join(traces_dir, f"{ar.req.id}.trace.json")
-        tmp = f"{path}.{os.getpid()}.tmp"
         try:
             os.makedirs(traces_dir, exist_ok=True)
-            with open(tmp, "w") as f:
-                json.dump(payload, f)
-            os.replace(tmp, path)
+            atomicio.write_json_atomic(path, payload, fsync=True)
         except OSError as err:
             self._event(
                 f"trace write for {ar.req.id!r} failed: {err}"
@@ -962,6 +1037,9 @@ class EngineServer:
     def run(self) -> int:
         """Serve until SIGTERM/SIGINT (exit 4) or, with ``idle_exit``
         set, until the queue has been empty that long (exit 0)."""
+        # sweep BEFORE restore/replay: the orphan tmps are the previous
+        # incarnation's in-flight atomic writes, definitionally dead
+        self._sweep_orphan_tmp()
         # restore BEFORE replay: replay must see the restored dedup
         # watermark, and replayed work must run under the restored
         # quarantine/ladder state

@@ -55,6 +55,7 @@ from typing import Optional, Tuple
 
 from sartsolver_tpu.resilience import faults
 from sartsolver_tpu.resilience.retry import retry_call
+from sartsolver_tpu.utils import atomicio
 
 STATE_VERSION = 1
 
@@ -73,7 +74,7 @@ class StateStore:
     """Append-only checkpoint file with last-consistent-record restore."""
 
     def __init__(self, path: str):
-        self.path = path
+        self.path = path  # durable: state checkpoint
         self.serial = 0
         self._last_record_bytes = 0
 
@@ -100,10 +101,7 @@ class StateStore:
 
         def write() -> None:
             faults.fire(faults.SITE_STATE_CHECKPOINT)
-            with open(self.path, "a") as f:
-                f.write(line)
-                f.flush()
-                os.fsync(f.fileno())
+            atomicio.append_line(self.path, line)
 
         retry_call(write, site=faults.SITE_STATE_CHECKPOINT,
                    retry_on=(OSError,))
@@ -169,12 +167,7 @@ class StateStore:
         header = {k: full[k] for k in ("v", "serial", "unix", "crc")}
         line = (json.dumps(header)[:-1] + ', "state": ' + state_json
                 + "}\n")
-        tmp = f"{self.path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            f.write(line)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        atomicio.write_atomic(self.path, line)
 
     def rotate_bytes(self) -> int:
         raw = os.environ.get("SART_STATE_ROTATE_BYTES", "262144")

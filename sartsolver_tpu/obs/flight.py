@@ -177,11 +177,11 @@ def status_snapshot(blocking: bool = True, **extra) -> dict:
 
 
 def _write_json_atomic(path: str, payload: dict) -> None:
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-        f.write("\n")
-    os.replace(tmp, path)
+    from sartsolver_tpu.utils import atomicio
+
+    # fsync=True: crash bundles and status dumps exist to be read
+    # AFTER something went wrong — they must survive it
+    atomicio.write_json_atomic(path, payload, fsync=True)
 
 
 def write_status(path: str, blocking: bool = True, **extra) -> dict:

@@ -194,10 +194,12 @@ class PromSink:
         self.path = path
 
     def write(self, snapshot: Iterable[dict]) -> None:
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            f.write(render_prometheus(snapshot))
-        os.replace(tmp, self.path)
+        from sartsolver_tpu.utils import atomicio
+
+        # fsync=False: scrape textfiles are advisory and rewritten on
+        # every export; a torn file costs one scrape interval
+        atomicio.write_atomic(self.path, render_prometheus(snapshot),
+                              fsync=False)
 
 
 class ChromeTraceSink:

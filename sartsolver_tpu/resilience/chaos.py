@@ -144,12 +144,11 @@ def _solution_datasets(path: str) -> Dict[str, "np.ndarray"]:
 def _stage_requests(engine_dir: str, requests: List[dict]) -> None:
     ingest = os.path.join(engine_dir, "ingest")
     os.makedirs(ingest, exist_ok=True)
+    from sartsolver_tpu.utils import atomicio
+
     for i, payload in enumerate(requests):
         path = os.path.join(ingest, f"{i:03d}-{payload['id']}.json")
-        tmp = f"{path}.tmp"
-        with open(tmp, "w") as f:
-            json.dump(payload, f)
-        os.replace(tmp, path)
+        atomicio.write_json_atomic(path, payload, fsync=False)
 
 
 class CampaignError(Exception):

@@ -54,6 +54,7 @@ from typing import List, Optional
 
 from sartsolver_tpu.obs import flight as obs_flight
 from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.utils import atomicio
 
 
 def classify_exit(returncode: int) -> str:
@@ -150,7 +151,7 @@ class Supervisor:
         # managed to create them
         for sub in ("", "ingest", "responses"):
             os.makedirs(os.path.join(engine_dir, sub), exist_ok=True)
-        self.events_path = os.path.join(engine_dir, "supervisor.jsonl")
+        self.events_path = os.path.join(engine_dir, "supervisor.jsonl")  # durable: supervisor events
         self.prom_path = os.path.join(engine_dir, "supervisor.prom")
         self.bundle_path = os.path.join(engine_dir,
                                         "supervisor.crash.json")
@@ -174,8 +175,11 @@ class Supervisor:
               flush=True)
         obs_flight.record_event(f"supervisor.{kind}", **data)
         try:
-            with open(self.events_path, "a") as f:
-                f.write(json.dumps(rec) + "\n")
+            # flush+fsync like the journal/state appends: the
+            # supervisor is the component that survives the crash, so
+            # its record of the crash must survive it too
+            atomicio.append_line(self.events_path,
+                                 json.dumps(rec) + "\n")
         except OSError:
             pass
         self._write_prom()
@@ -334,12 +338,10 @@ class Supervisor:
                    "retry_after_s": round(max(remaining_s, 1.0), 1)}
             try:
                 os.makedirs(responses, exist_ok=True)
-                tmp = os.path.join(responses,
-                                   f"{rid}.json.{os.getpid()}.tmp")
-                with open(tmp, "w") as f:
-                    json.dump(rec, f)
-                    f.write("\n")
-                os.replace(tmp, os.path.join(responses, f"{rid}.json"))
+                atomicio.write_json_atomic(
+                    os.path.join(responses, f"{rid}.json"), rec,
+                    fsync=True,
+                )
             except OSError:
                 continue  # leave the request file for the next pass
             try:

@@ -329,13 +329,17 @@ def _write_heartbeat(path: str) -> None:
                 extra += " requests=" + (
                     ",".join(str(r) for r in active) if active else "-"
                 )
-        tmp = f"{path}.{os.getpid()}.tmp"
-        with open(tmp, "w") as f:
-            f.write(
-                f"phase={_last_work_phase} frames={_frames_done} "
-                f"serial={_serial}{extra} unix={time.time():.3f}\n"
-            )
-        os.replace(tmp, path)
+        from sartsolver_tpu.utils import atomicio
+
+        # fsync=False: the heartbeat is advisory and high-frequency —
+        # a torn line after a machine crash only costs one staleness
+        # reading, while an fsync per beat would tax the solve loop
+        atomicio.write_atomic(
+            path,
+            f"phase={_last_work_phase} frames={_frames_done} "
+            f"serial={_serial}{extra} unix={time.time():.3f}\n",
+            fsync=False,
+        )
     except OSError:
         pass
 

@@ -1,0 +1,145 @@
+"""Blessed durable-write helpers (docs/STATIC_ANALYSIS.md §Durability).
+
+Every durable byte the package writes goes through two primitives:
+
+- :func:`append_line` — append-only JSONL records (journal markers,
+  state checkpoints, supervisor events): write + flush + fsync before
+  returning, so a ``kill -9`` at any instant leaves a consistent prefix
+  plus at most one torn final line (which every reader skips).
+- :func:`write_atomic` / :func:`write_json_atomic` — whole-file
+  publishes (responses, compactions, heartbeats, Prom textfiles): write
+  to ``<path>.<pid>.tmp``, optionally fsync the tmp handle, then
+  ``os.replace`` into place. With ``fsync=True`` a crash can never
+  publish a truncated file; with ``fsync=False`` (advisory files only —
+  heartbeats, scrape textfiles) a crash straddling the rename may
+  publish a torn file, which is why the knob is explicit at every call
+  site. Either way a kill mid-write leaves only ``*.tmp`` debris, which
+  :func:`sweep_orphans` removes at startup.
+
+The SL2xx durability lint (analysis/durability.py) enforces that writes
+to ``# durable:``-declared paths happen through this module, and the
+crash-point model checker (analysis/protocol.py) swaps the backing
+filesystem via :func:`use_fs` to enumerate every crash prefix against
+an in-memory shim — which is why all I/O below routes through one
+small FS interface instead of calling ``open`` inline at each site.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Iterator
+
+
+class _RealFS:
+    """The production backend: plain POSIX files."""
+
+    def append(self, path: str, data: str, *, fsync: bool = True) -> None:
+        with open(path, "ab+") as f:
+            # Seal a torn tail before appending: a kill mid-append
+            # leaves a partial record with NO trailing newline, and a
+            # plain append would concatenate the next record onto it —
+            # one unparseable line swallowing BOTH records (the crash-
+            # point model checker found exactly this: the first
+            # checkpoint after a torn-tail restart vanished). A lone
+            # "\n" turns the torn prefix into a skippable line of its
+            # own and lets the new record start clean.
+            f.seek(0, os.SEEK_END)
+            if f.tell() > 0:
+                f.seek(-1, os.SEEK_END)
+                if f.read(1) != b"\n":
+                    f.write(b"\n")
+            f.write(data.encode("utf-8"))
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+
+    def write_atomic(self, path: str, data: str, *,
+                     fsync: bool = True) -> None:
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
+            f.write(data)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def remove(self, path: str) -> None:
+        os.unlink(path)
+
+
+_REAL_FS = _RealFS()
+# The active backend. Rebinding is test/checker-only and single-
+# threaded by contract (use_fs below); production never swaps it.
+_fs = _REAL_FS
+
+
+def current_fs():
+    """The active FS backend (the protocol checker's shim, or the real
+    one)."""
+    return _fs
+
+
+@contextlib.contextmanager
+def use_fs(fs) -> Iterator[None]:
+    """Route every helper below through ``fs`` for the duration of the
+    block (the crash-point model checker's in-memory shim). Not
+    thread-safe — checker/test use only."""
+    global _fs
+    prev = _fs
+    _fs = fs
+    try:
+        yield
+    finally:
+        _fs = prev
+
+
+def append_line(path: str, data: str, *, fsync: bool = True) -> None:
+    """Durably append ``data`` (one JSONL record, caller-terminated)
+    to ``path``: write + flush + fsync before returning."""
+    _fs.append(path, data, fsync=fsync)
+
+
+def write_atomic(path: str, data: str, *, fsync: bool = True) -> None:
+    """Atomically publish ``data`` as the whole content of ``path``
+    (tmp + rename). ``fsync=True`` guarantees the published file is
+    never torn; ``fsync=False`` is for advisory files only."""
+    _fs.write_atomic(path, data, fsync=fsync)
+
+
+def write_json_atomic(path: str, payload: dict, *,
+                      fsync: bool = True) -> None:
+    """:func:`write_atomic` for one JSON record (trailing newline)."""
+    _fs.write_atomic(path, json.dumps(payload) + "\n", fsync=fsync)
+
+
+def sweep_orphans(directory: str,
+                  suffix: str = ".tmp") -> int:
+    """Remove ``*.tmp`` debris a kill mid-atomic-write left behind
+    (startup sweep; engine/server.py counts the removals into
+    ``engine_retention_deleted_total{dir=}``). Returns the count;
+    a missing/unreadable directory sweeps nothing."""
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return 0
+    removed = 0
+    for name in sorted(names):
+        if not name.endswith(suffix):
+            continue
+        path = os.path.join(directory, name)
+        if not os.path.isfile(path):
+            continue
+        try:
+            _fs.remove(path)
+        except OSError:
+            continue
+        removed += 1
+    return removed
+
+
+__all__ = [
+    "append_line", "write_atomic", "write_json_atomic", "sweep_orphans",
+    "use_fs", "current_fs",
+]

@@ -304,6 +304,135 @@ RULE_FIXTURES = {
             return t
         """,
     ),
+    # SL2xx durability discipline: the family's full TP + near-miss
+    # matrix (derived locals, commit-order anchoring, checkpoint-boundary
+    # call graphs) lives in tests/test_durability_lint.py; these pairs
+    # keep the one-catalogue convention here
+    "SL201": (
+        # TP: raw append to a # durable:-declared path
+        """
+        class J:
+            def __init__(self, path):
+                self.path = path  # durable: journal
+            def append(self, line):
+                with open(self.path, 'a') as f:
+                    f.write(line)
+        """,
+        # near miss: reading the durable path is fine
+        """
+        class J:
+            def __init__(self, path):
+                self.path = path  # durable: journal
+            def replay(self):
+                with open(self.path) as f:
+                    return f.read()
+        """,
+    ),
+    "SL202": (
+        # TP: rename publish whose tmp handle was never fsynced
+        """
+        import os
+
+        def publish(path, data):
+            tmp = path + '.tmp'
+            with open(tmp, 'w') as f:
+                f.write(data)
+            os.replace(tmp, path)
+        """,
+        # near miss: fsync before the rename
+        """
+        import os
+
+        def publish(path, data):
+            tmp = path + '.tmp'
+            with open(tmp, 'w') as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        """,
+    ),
+    "SL203": (
+        # TP: response published before the completed marker commits
+        """
+        import os
+
+        class S:
+            def __init__(self, d, journal):
+                self.responses_dir = d  # durable: response
+                self.journal = journal
+            def _respond(self, rid, body):
+                p = os.path.join(self.responses_dir, rid + '.json')
+                write_json_atomic(p, body)
+            def _finish(self, req, outcome):
+                self._respond(req.id, {'state': 'done'})
+                self.journal.completed(req, outcome)
+        """,
+        # near miss: completed marker first, response second
+        """
+        import os
+
+        class S:
+            def __init__(self, d, journal):
+                self.responses_dir = d  # durable: response
+                self.journal = journal
+            def _respond(self, rid, body):
+                p = os.path.join(self.responses_dir, rid + '.json')
+                write_json_atomic(p, body)
+            def _finish(self, req, outcome):
+                self.journal.completed(req, outcome)
+                self._respond(req.id, {'state': 'done'})
+        """,
+    ),
+    "SL204": (
+        # TP: wall clock reachable from replay
+        """
+        import time
+
+        class S:
+            def _replay(self):
+                self._note()
+            def _note(self):
+                return time.time()
+        """,
+        # near miss: sorted listing, clock only outside replay paths
+        """
+        import os, time
+
+        class S:
+            def restore_state(self):
+                for name in sorted(os.listdir(self.d)):
+                    pass
+            def heartbeat(self):
+                return time.time()
+        """,
+    ),
+    "SL205": (
+        # TP: checkpointed state mutated on a path that never
+        # reaches the declared boundary
+        """
+        class S:
+            def __init__(self):
+                # checkpointed by: _save_state
+                self.counters = {}
+            def _save_state(self):
+                pass
+            def handle(self):
+                self.counters['x'] = 1
+        """,
+        # near miss: the mutation reaches the boundary
+        """
+        class S:
+            def __init__(self):
+                # checkpointed by: _save_state
+                self.counters = {}
+            def _save_state(self):
+                pass
+            def handle(self):
+                self.counters['x'] = 1
+                self._save_state()
+        """,
+    ),
 }
 
 

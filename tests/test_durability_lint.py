@@ -1,0 +1,341 @@
+"""Durability-discipline lint (SL201–SL205) fixtures and pins.
+
+Each rule gets a true-positive fixture AND a near-miss the rule must
+stay silent on — the near-misses encode the precision contract
+(docs/STATIC_ANALYSIS.md): reads of durable paths, fsync'd publishes,
+completed-before-publish ordering, sorted listings, and checkpoint-
+covered mutations are all fine. Plus the family-alone package self-lint
+pin (a regression in SL2xx cannot hide behind the other catalogues) and
+the catalogue/CLI integration.
+"""
+
+import os
+
+from sartsolver_tpu.analysis.durability import DURABILITY_RULES
+from sartsolver_tpu.analysis.rules import lint_paths, lint_source
+
+
+def _lint(src):
+    return lint_source("fixture.py", src, rules=DURABILITY_RULES)
+
+
+def _ids(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# SL201 — raw durable write
+# ---------------------------------------------------------------------------
+
+
+def test_sl201_raw_append_to_durable_path():
+    findings = _lint(
+        "class J:\n"
+        "    def __init__(self, path):\n"
+        "        self.path = path  # durable: journal\n"
+        "    def append(self, line):\n"
+        "        with open(self.path, 'a') as f:\n"
+        "            f.write(line)\n"
+    )
+    assert _ids(findings) == ["SL201"]
+    assert findings[0].line == 5
+
+
+def test_sl201_derived_local_path_is_still_durable():
+    findings = _lint(
+        "import os\n"
+        "class S:\n"
+        "    def __init__(self, d):\n"
+        "        self.responses_dir = d  # durable: response\n"
+        "    def publish(self, rid, data):\n"
+        "        p = os.path.join(self.responses_dir, rid + '.json')\n"
+        "        with open(p, 'w') as f:\n"
+        "            f.write(data)\n"
+    )
+    assert _ids(findings) == ["SL201"]
+
+
+def test_sl201_silent_on_reads_and_unmarked_paths():
+    findings = _lint(
+        "class J:\n"
+        "    def __init__(self, path, scratch):\n"
+        "        self.path = path  # durable: journal\n"
+        "        self.scratch = scratch\n"
+        "    def replay(self):\n"
+        "        with open(self.path) as f:\n"
+        "            return f.read()\n"
+        "    def note(self, line):\n"
+        "        with open(self.scratch, 'a') as f:\n"
+        "            f.write(line)\n"
+    )
+    assert not [f for f in findings if f.rule == "SL201"]
+
+
+def test_sl201_suppressible_with_line_comment():
+    findings = _lint(
+        "class J:\n"
+        "    def __init__(self, path):\n"
+        "        self.path = path  # durable: journal\n"
+        "    def append(self, line):\n"
+        "        # legacy escape hatch kept for the migration window\n"
+        "        with open(self.path, 'a') as f:  "
+        "# sart-lint: disable=SL201\n"
+        "            f.write(line)\n"
+    )
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# SL202 — os.replace without fsync
+# ---------------------------------------------------------------------------
+
+
+def test_sl202_replace_without_fsync():
+    findings = _lint(
+        "import os\n"
+        "def publish(path, data):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(data)\n"
+        "    os.replace(tmp, path)\n"
+    )
+    assert _ids(findings) == ["SL202"]
+    assert findings[0].line == 6
+
+
+def test_sl202_silent_when_tmp_handle_is_fsynced():
+    findings = _lint(
+        "import os\n"
+        "def publish(path, data):\n"
+        "    tmp = path + '.tmp'\n"
+        "    with open(tmp, 'w') as f:\n"
+        "        f.write(data)\n"
+        "        f.flush()\n"
+        "        os.fsync(f.fileno())\n"
+        "    os.replace(tmp, path)\n"
+    )
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# SL203 — commit-order violation
+# ---------------------------------------------------------------------------
+
+_SL203_BASE = (
+    "import os\n"
+    "class S:\n"
+    "    def __init__(self, d, journal):\n"
+    "        self.responses_dir = d  # durable: response\n"
+    "        self.journal = journal\n"
+    "    def _respond(self, rid, body):\n"
+    "        p = os.path.join(self.responses_dir, rid + '.json')\n"
+    "        write_json_atomic(p, body)\n"
+)
+
+
+def test_sl203_publish_before_completed_append():
+    findings = _lint(
+        _SL203_BASE
+        + "    def _finish(self, req, outcome):\n"
+          "        self._respond(req.id, {'state': 'done'})\n"
+          "        self.journal.completed(req, outcome)\n"
+    )
+    assert _ids(findings) == ["SL203"]
+
+
+def test_sl203_silent_when_completed_commits_first():
+    findings = _lint(
+        _SL203_BASE
+        + "    def _finish(self, req, outcome):\n"
+          "        self.journal.completed(req, outcome)\n"
+          "        self._respond(req.id, {'state': 'done'})\n"
+    )
+    assert not findings
+
+
+def test_sl203_only_anchors_the_direct_completed_handler():
+    # the serve loop publishes OTHER requests' responses (replay
+    # republish, acceptance verdicts) before calling into the handler;
+    # only the function appending the completed marker itself is held
+    # to the ordering
+    findings = _lint(
+        _SL203_BASE
+        + "    def _finish(self, req, outcome):\n"
+          "        self.journal.completed(req, outcome)\n"
+          "        self._respond(req.id, {'state': 'done'})\n"
+          "    def run(self, reqs):\n"
+          "        self._respond('other', {'state': 'pending'})\n"
+          "        for req in reqs:\n"
+          "            self._finish(req, {})\n"
+    )
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# SL204 — replay nondeterminism
+# ---------------------------------------------------------------------------
+
+
+def test_sl204_wall_clock_reachable_from_replay():
+    findings = _lint(
+        "import time\n"
+        "class S:\n"
+        "    def _replay(self):\n"
+        "        self._note()\n"
+        "    def _note(self):\n"
+        "        return time.time()\n"
+    )
+    assert _ids(findings) == ["SL204"]
+    assert findings[0].line == 6
+
+
+def test_sl204_unsorted_listdir_in_restore():
+    findings = _lint(
+        "import os\n"
+        "class S:\n"
+        "    def restore_state(self):\n"
+        "        for name in os.listdir(self.d):\n"
+        "            pass\n"
+    )
+    assert _ids(findings) == ["SL204"]
+
+
+def test_sl204_silent_on_sorted_listdir_and_foreign_functions():
+    findings = _lint(
+        "import os, time\n"
+        "class S:\n"
+        "    def restore_state(self):\n"
+        "        for name in sorted(os.listdir(self.d)):\n"
+        "            pass\n"
+        "    def heartbeat(self):\n"
+        "        return time.time()\n"
+    )
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# SL205 — uncheckpointed mutation
+# ---------------------------------------------------------------------------
+
+_SL205_BASE = (
+    "class S:\n"
+    "    def __init__(self):\n"
+    "        # checkpointed by: _save_state\n"
+    "        self.counters = {}\n"
+    "    def _save_state(self):\n"
+    "        pass\n"
+)
+
+
+def test_sl205_mutation_with_no_boundary():
+    findings = _lint(
+        _SL205_BASE
+        + "    def handle(self):\n"
+          "        self.counters['x'] = 1\n"
+    )
+    assert _ids(findings) == ["SL205"]
+
+
+def test_sl205_silent_with_local_boundary():
+    findings = _lint(
+        _SL205_BASE
+        + "    def handle(self):\n"
+          "        self.counters['x'] = 1\n"
+          "        self._save_state()\n"
+    )
+    assert not findings
+
+
+def test_sl205_caller_boundary_covers_the_callee():
+    findings = _lint(
+        _SL205_BASE
+        + "    def _bump(self):\n"
+          "        self.counters['x'] = 1\n"
+          "    def handle(self):\n"
+          "        self._bump()\n"
+          "        self._save_state()\n"
+    )
+    assert not findings
+
+
+def test_sl205_one_uncovered_caller_is_enough():
+    findings = _lint(
+        _SL205_BASE
+        + "    def _bump(self):\n"
+          "        self.counters['x'] = 1\n"
+          "    def handle(self):\n"
+          "        self._bump()\n"
+          "        self._save_state()\n"
+          "    def hotpath(self):\n"
+          "        self._bump()\n"
+    )
+    assert _ids(findings) == ["SL205"]
+
+
+def test_sl205_mutator_verb_call_counts_as_mutation():
+    findings = _lint(
+        "class S:\n"
+        "    def __init__(self, admission):\n"
+        "        # checkpointed by: _save_state\n"
+        "        self.admission = admission\n"
+        "    def _save_state(self):\n"
+        "        pass\n"
+        "    def reject(self, req):\n"
+        "        self.admission.shed(req, 'overload')\n"
+        "    def view(self):\n"
+        "        return self.admission.export_state()\n"
+    )
+    assert _ids(findings) == ["SL205"]
+    assert findings[0].line == 8
+
+
+# ---------------------------------------------------------------------------
+# catalogue + package integration
+# ---------------------------------------------------------------------------
+
+
+def test_sl2xx_registered_in_full_catalogue():
+    from sartsolver_tpu.analysis.rules import ALL_RULES
+
+    ids = {rule.id for rule in ALL_RULES}
+    assert {"SL201", "SL202", "SL203", "SL204", "SL205"} <= ids
+
+
+def test_package_self_lint_clean_with_only_sl2xx():
+    """Acceptance: the package self-lint passes with the durability
+    family alone — a regression in SL2xx cannot hide behind the other
+    catalogues. The only suppressions in tree carry why-comments."""
+    import sartsolver_tpu
+
+    pkg = os.path.dirname(os.path.abspath(sartsolver_tpu.__file__))
+    findings = lint_paths([pkg], rules=DURABILITY_RULES)
+    assert not findings, "\n".join(f.format() for f in findings)
+
+
+def test_list_rules_covers_sl2xx(capsys):
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    assert lint_main(["--list-rules", "--select", "SL2"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("SL201", "SL202", "SL203", "SL204", "SL205"):
+        assert rule_id in out
+
+
+def test_select_family_runs_alone(tmp_path, capsys):
+    """--select SL2 on a file with both a JAX hazard and a durability
+    hazard reports only the durability one."""
+    from sartsolver_tpu.analysis.cli import lint_main
+
+    src = (
+        "class J:\n"
+        "    def __init__(self, path):\n"
+        "        self.path = path  # durable: journal\n"
+        "    def append(self, line):\n"
+        "        with open(self.path, 'a') as f:\n"
+        "            f.write(line)\n"
+    )
+    p = tmp_path / "fixture.py"
+    p.write_text(src)
+    assert lint_main([str(p), "--select", "SL2", "--no-audit"]) == 1
+    out = capsys.readouterr().out
+    assert "SL201" in out

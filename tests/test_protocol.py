@@ -1,0 +1,265 @@
+"""Crash-point model checker drills (analysis/protocol.py).
+
+The checker's job is to FAIL when the exactly-once protocol regresses,
+so beyond the green exhaustive pass the drills here re-introduce the
+bugs this PR (and PR 15) fixed and assert the checker catches each:
+
+- the PR 15 replay gate (republish on *missing* response only — the
+  real kill leaves a stale ``pending`` response behind);
+- the recount path disabled (a kill between the completed marker and
+  the next checkpoint silently loses outcome counters);
+- the response publish dropping ``fsync=True`` (a crash straddling the
+  rename publishes a torn "atomic" file).
+
+Plus the torn-write drills for the atomicio primitives the checker
+leans on: the self-sealing append (a torn tail must never swallow the
+next record — the checker found exactly that bug on its first
+exhaustive pass) and journal replay under truncation at every byte,
+and the startup orphan-tmp sweep with its server metric.
+"""
+
+import json
+import os
+
+import pytest
+
+import sartsolver_tpu.analysis.protocol as ap
+import sartsolver_tpu.engine.protocol as ep
+from sartsolver_tpu.engine.journal import RequestJournal
+from sartsolver_tpu.engine.request import Request
+from sartsolver_tpu.utils import atomicio
+
+@pytest.fixture(autouse=True)
+def _shm_tmpdir(monkeypatch):
+    # the drills spin up hundreds of fsync-heavy scratch dirs; tmpfs
+    # makes that free without weakening the check (the crash states are
+    # constructed, not produced by real power loss)
+    if os.path.isdir("/dev/shm"):
+        import tempfile
+
+        monkeypatch.setenv("TMPDIR", "/dev/shm")
+        tempfile.tempdir = None
+        yield
+        tempfile.tempdir = None
+    else:
+        yield
+
+
+# ---------------------------------------------------------------------------
+# the exhaustive pass
+# ---------------------------------------------------------------------------
+
+
+def test_exhaustive_pass_green_and_reaches_every_effect_point():
+    rep = ap.run_protocol_check(byte_stride=3)
+    assert rep.ok, "\n".join(rep.violations)
+    assert rep.commit_order_ok
+    # the workload arms every declared effect point at least once — a
+    # point the checker cannot reach is a hole in the exhaustiveness
+    # claim
+    armed = set(rep.scenarios_by_effect)
+    declared = {p.name for p in ep.PROTOCOL}
+    assert armed == declared
+    # every append effect contributes multiple torn-byte states
+    assert rep.scenarios_by_effect["journal.completed"] > 10
+    assert rep.scenarios_by_effect["state.checkpoint"] > 10
+
+
+def test_enumeration_dwarfs_the_sampled_chaos_campaign():
+    """Acceptance: the checker's crash states must outnumber the chaos
+    campaign's sampled kill windows (CI seed set x max kills per seed)
+    by a wide margin — exhaustive vs sampled is the whole point."""
+    rep = ap.run_protocol_check(byte_stride=6)
+    ci_seeds = os.environ.get("SART_CHAOS_SEEDS", "3,5").split(",")
+    sampled = len([s for s in ci_seeds if s.strip()]) * 2  # max_kills=2
+    assert rep.scenarios_total > 10 * sampled
+    # and stride 1 (make protocol) covers every byte: strictly more
+    # scenarios than any thinned run
+    assert rep.scenarios_total > rep.effects_armed
+
+
+def test_report_maps_violations_to_chaos_windows(monkeypatch):
+    """A violation at a chaos-sampled effect names the kill window so
+    the runbook can cross-reference `sartsolve chaos` output."""
+    monkeypatch.setattr(ep, "uncounted_completed",
+                        lambda completed, counted: [])
+    rep = ap.run_protocol_check(byte_stride=30)
+    assert not rep.ok
+    assert any("chaos kill window: ckpt" in v for v in rep.violations)
+
+
+# ---------------------------------------------------------------------------
+# re-broken-bug regression drills
+# ---------------------------------------------------------------------------
+
+
+def test_pr15_missing_only_republish_gate_is_caught(monkeypatch):
+    """Re-break the PR 15 replay bug: gate the republish on a MISSING
+    response only. The kill after the completed-marker fsync leaves the
+    stale `pending` acceptance response behind, and the checker must
+    see it survive recovery."""
+
+    def broken(outcome, prev, *, response_ttl_s, now=None):
+        import time as _t
+
+        if not outcome:
+            return False
+        now = _t.time() if now is None else now
+        done = float(outcome.get("journal_unix") or now)
+        fresh = (not response_ttl_s) or (now - done < response_ttl_s)
+        return bool(fresh and prev is None)  # <- the bug
+
+    monkeypatch.setattr(ep, "needs_republish", broken)
+    rep = ap.run_protocol_check(byte_stride=30)
+    assert not rep.ok
+    assert any("stuck in state 'pending'" in v for v in rep.violations)
+
+
+def test_disabled_recount_loses_counters_and_is_caught(monkeypatch):
+    monkeypatch.setattr(ep, "uncounted_completed",
+                        lambda completed, counted: [])
+    rep = ap.run_protocol_check(byte_stride=30)
+    assert not rep.ok
+    assert any("counters" in v for v in rep.violations)
+
+
+def test_response_publish_without_fsync_is_caught(monkeypatch):
+    """Re-break the server bug this PR fixed: response publishes with
+    fsync=False. The shim then models the rename landing with only a
+    data prefix durable, and the checker must flag the torn published
+    response BEFORE recovery even runs (clients read at any instant)."""
+    monkeypatch.setattr(ap, "RESPONSE_FSYNC", False)
+    rep = ap.run_protocol_check(byte_stride=30)
+    assert not rep.ok
+    assert any("torn" in v and "atomic-publish" in v
+               for v in rep.violations)
+
+
+# ---------------------------------------------------------------------------
+# atomicio torn-write drills
+# ---------------------------------------------------------------------------
+
+
+def test_append_seals_a_torn_tail(tmp_path):
+    """The bug the checker found on its first exhaustive pass: a torn
+    final line has no newline, and a plain append would concatenate the
+    next record onto it — one garbage line swallowing BOTH records.
+    append_line must seal the tail so the new record survives."""
+    path = str(tmp_path / "log.jsonl")
+    atomicio.append_line(path, json.dumps({"n": 1}) + "\n")
+    with open(path, "a") as f:
+        f.write('{"n": 2, "torn')  # kill -9 mid-append
+    atomicio.append_line(path, json.dumps({"n": 3}) + "\n")
+    lines = open(path).read().splitlines()
+    parsed = []
+    for ln in lines:
+        try:
+            parsed.append(json.loads(ln))
+        except ValueError:
+            parsed.append(None)
+    assert parsed[0] == {"n": 1}
+    assert parsed[-1] == {"n": 3}, "record after a torn tail was lost"
+    assert parsed.count(None) == 1  # the torn line, sealed on its own
+
+
+def test_append_after_every_truncation_point(tmp_path):
+    """Property drill: whatever prefix of the file a crash leaves, the
+    next append_line lands a parseable final record."""
+    base = str(tmp_path / "base.jsonl")
+    for i in range(3):
+        atomicio.append_line(base, json.dumps({"i": i}) + "\n")
+    data = open(base, "rb").read()
+    rec = json.dumps({"i": "after"}) + "\n"
+    for cut in range(len(data) + 1):
+        path = str(tmp_path / f"cut{cut}.jsonl")
+        with open(path, "wb") as f:
+            f.write(data[:cut])
+        atomicio.append_line(path, rec)
+        last = open(path).read().splitlines()[-1]
+        assert json.loads(last) == {"i": "after"}
+
+
+def test_journal_replay_tolerates_truncation_at_every_byte(tmp_path):
+    """The real journal + real replay under every torn-tail state: no
+    exception, and the recovered story is always a consistent prefix
+    (never a completed id the journal prefix does not contain)."""
+    j = RequestJournal(str(tmp_path / "journal.jsonl"))
+    reqs = [Request(id=f"r{i}", trace=f"t{i}") for i in range(3)]
+    for r in reqs:
+        j.accepted(r)
+        j.dispatched(r)
+        j.completed(r, {"status": "completed"})
+    data = open(j.path, "rb").read()
+    all_ids = {r.id for r in reqs}
+    prev_known = -1
+    for cut in range(len(data) + 1):
+        p = str(tmp_path / "cut.jsonl")
+        with open(p, "wb") as f:
+            f.write(data[:cut])
+        completed, pending = RequestJournal(p).replay()
+        known = set(completed) | {r.id for r in pending}
+        assert known <= all_ids
+        # longer prefixes never know fewer requests
+        assert len(known) >= prev_known
+        prev_known = len(known)
+    assert prev_known == 3
+
+
+def test_sweep_orphans_removes_only_tmp_files(tmp_path):
+    d = str(tmp_path)
+    open(os.path.join(d, "keep.json"), "w").write("{}")
+    open(os.path.join(d, "a.json.123.tmp"), "w").write("debris")
+    open(os.path.join(d, "b.json.456.tmp"), "w").write("debris")
+    os.makedirs(os.path.join(d, "sub.tmp"))  # directory: not swept
+    assert atomicio.sweep_orphans(d) == 2
+    assert sorted(os.listdir(d)) == ["keep.json", "sub.tmp"]
+    assert atomicio.sweep_orphans(os.path.join(d, "missing")) == 0
+
+
+def test_server_startup_sweep_counts_into_retention_metric(tmp_path):
+    """The server's startup sweep removes publish debris from all three
+    durable dirs and counts it into engine_retention_deleted_total
+    (same family as the TTL sweep — one dashboard, both reclaim
+    paths). EngineServer.__init__ never touches the session, so a
+    bare object() stands in."""
+    from sartsolver_tpu.engine.server import EngineServer
+    from sartsolver_tpu.obs import metrics as obs_metrics
+
+    eng = str(tmp_path / "engine")
+    server = EngineServer(object(), engine_dir=eng, lanes=1)
+    os.makedirs(os.path.join(eng, "traces"), exist_ok=True)
+    for rel in ("journal.jsonl.77.tmp", "responses/r1.json.77.tmp",
+                "traces/r1.trace.json.77.tmp"):
+        with open(os.path.join(eng, rel), "w") as f:
+            f.write("debris")
+
+    def _swept(snapshot):
+        return sum(
+            s["value"] for s in snapshot
+            if s["name"] == "engine_retention_deleted_total"
+            and s["labels"].get("dir") in ("engine", "responses",
+                                           "traces"))
+
+    before = _swept(obs_metrics.get_registry().snapshot())
+    server._sweep_orphan_tmp()
+    after = _swept(obs_metrics.get_registry().snapshot())
+    assert after - before == 3
+    assert not [n for n in os.listdir(eng) if n.endswith(".tmp")]
+    assert not os.listdir(os.path.join(eng, "responses"))
+
+
+def test_supervisor_event_append_is_sealed_and_fsynced(tmp_path):
+    """Satellite: supervisor.jsonl appends ride atomicio (flush+fsync
+    + torn-tail seal) — the record of a crash must survive the crash,
+    and a torn tail from the previous incarnation must not swallow the
+    restart's first event."""
+    from sartsolver_tpu.resilience.supervisor import Supervisor
+
+    sup = Supervisor.__new__(Supervisor)
+    sup.events_path = str(tmp_path / "supervisor.jsonl")
+    sup.prom_path = str(tmp_path / "supervisor.prom")
+    with open(sup.events_path, "w") as f:
+        f.write('{"kind": "worker-exit", "torn')  # previous crash
+    sup._event("respawn", attempt=1)
+    lines = open(sup.events_path).read().splitlines()
+    assert json.loads(lines[-1])["kind"] == "respawn"
