@@ -37,6 +37,12 @@ plus the publish-atomicity contract the sampled campaign cannot see):
   (the ``counted_ids`` watermark + recount path);
 - no ``*.tmp`` publish debris survives the startup sweep; a response
   swept by the retention TTL stays swept (no resurrection);
+- fleet failover (docs/SERVING.md §10): a request handed off a dead
+  worker's journal completes exactly once fleet-wide — whatever prefix
+  of the handoff protocol (handoff marker, routing publish, fleet
+  event, ingest re-stage, survivor lifecycle) the crash cut short —
+  and the outcome counters stay continuous when summed across every
+  worker's checkpoint;
 - published (renamed) files are never torn — only possible when a
   publish site drops ``fsync=True``, which is exactly the server bug
   this PR fixed, so the shim models the ``fsync=False`` failure mode
@@ -57,6 +63,7 @@ import tempfile
 from typing import Dict, List, Optional, Set, Tuple
 
 from sartsolver_tpu.engine import protocol as engine_protocol
+from sartsolver_tpu.engine import routing as fleet_routing
 from sartsolver_tpu.engine.journal import RequestJournal
 from sartsolver_tpu.engine.request import Request
 from sartsolver_tpu.engine.state import StateStore
@@ -72,6 +79,10 @@ OLD_ID = "old-0"                # completed long ago; past the TTL
 ANCIENT_UNIX = 1000.0           # its journal stamp (epoch dawn)
 SLO_MS = 600.0
 RESPONSE_TTL_S = 3600.0
+# The failover epilogue's request: accepted by worker 0, which then
+# "dies"; the controller hands it off to worker 1 (docs/SERVING.md §10)
+HANDOFF_ID = "req-d"
+HANDOFF_TARGET = 1
 
 # Re-break knob for tests/test_protocol.py: flipping this to False
 # re-introduces the server's missing-fsync response bug, and the shim's
@@ -147,6 +158,10 @@ def _classify(op: str, path: str,
         return engine_protocol.effect(name).name, None
     if base == "supervisor.jsonl":
         return engine_protocol.effect("supervisor.event").name, None
+    if base == "fleet.jsonl":
+        return engine_protocol.effect("fleet.event").name, None
+    if base == fleet_routing.ROUTING_BASENAME:
+        return engine_protocol.effect("routing.publish").name, None
     stem = base[:-len(".json")] if base.endswith(".json") else base
     if parent == "responses":
         if op == "delete":
@@ -155,7 +170,10 @@ def _classify(op: str, path: str,
         name = "response.done" if state == "done" else "response.accepted"
         return engine_protocol.effect(name).name, stem
     if parent == "ingest":
-        return engine_protocol.effect("ingest.consume").name, stem
+        # delete = the worker consuming an admitted file; publish = the
+        # controller re-staging a handed-off payload on a survivor
+        name = "ingest.stage" if op == "publish" else "ingest.consume"
+        return engine_protocol.effect(name).name, stem
     if parent == "traces":
         name = engine_protocol.effect("trace.publish").name
         return name, stem[:-len(".trace")] if stem.endswith(".trace") \
@@ -224,6 +242,30 @@ class ShimFS:
 # ---------------------------------------------------------------------------
 
 
+class _Worker:
+    """One worker's durable world inside the simulated fleet: its own
+    journal shard, state checkpoint and ingest dir (responses/outputs
+    are fleet-shared, held by the driver)."""
+
+    def __init__(self, engine_dir: str, ingest_dir: str):
+        self.engine_dir = engine_dir
+        self.ingest_dir = ingest_dir
+        os.makedirs(engine_dir, exist_ok=True)
+        os.makedirs(ingest_dir, exist_ok=True)
+        self.journal_path = os.path.join(engine_dir, "journal.jsonl")
+        self.state_path = os.path.join(engine_dir, "state.jsonl")
+        self.journal = RequestJournal(self.journal_path)
+        self.state = StateStore(self.state_path)
+        self.counters: Dict[str, int] = {}
+        self.slo = {"ok": 0, "breach": 0}
+        self.counted: Dict[str, None] = {}
+        self.seen: Dict[str, None] = {}
+
+    def reopen(self) -> None:
+        self.journal = RequestJournal(self.journal_path)
+        self.state = StateStore(self.state_path)
+
+
 class ProtocolDriver:
     """One serving workload over the real journal/state/response code.
 
@@ -231,10 +273,16 @@ class ProtocolDriver:
     (journal accepted -> pending response -> ingest consume ->
     checkpoint -> dispatched -> solve -> completed -> count ->
     checkpoint -> done response), plus a retention delete of a long-
-    completed id and a mid-run checkpoint+compact rotation.
+    completed id, a mid-run checkpoint+compact rotation, and the fleet
+    failover epilogue: worker 0 accepts :data:`HANDOFF_ID` and dies,
+    the controller appends the handoff marker to the dead journal,
+    republishes the routing table, logs the fleet event and re-stages
+    the payload on worker 1, which drives it to completion.
     :meth:`recover` is the restart: the same sweep/restore/replay/
     republish/recount/rescan/re-drive sequence ``EngineServer.run``
-    performs, built from the same shared gates.
+    performs on every worker, built from the same shared gates, plus
+    the controller's handoff-resolution pass
+    (:func:`engine_protocol.needs_restage`).
     """
 
     def __init__(self, root: str):
@@ -243,26 +291,35 @@ class ProtocolDriver:
         self.ingest_dir = os.path.join(root, "ingest")
         self.responses_dir = os.path.join(self.engine_dir, "responses")
         self.traces_dir = os.path.join(self.engine_dir, "traces")
-        for d in (self.engine_dir, self.ingest_dir, self.responses_dir,
-                  self.traces_dir):
+        self.worker_b_dir = os.path.join(root, "workers", "w1")
+        self.b_ingest_dir = os.path.join(self.worker_b_dir, "ingest")
+        for d in (self.responses_dir, self.traces_dir):
             os.makedirs(d, exist_ok=True)
-        self.journal_path = os.path.join(self.engine_dir, "journal.jsonl")
-        self.state_path = os.path.join(self.engine_dir, "state.jsonl")
         self.supervisor_path = os.path.join(self.engine_dir,
                                             "supervisor.jsonl")
-        self.journal = RequestJournal(self.journal_path)
-        self.state = StateStore(self.state_path)
-        self.counters: Dict[str, int] = {}
-        self.slo = {"ok": 0, "breach": 0}
-        self.counted: Dict[str, None] = {}
-        self.seen: Dict[str, None] = {}
+        self.fleet_path = os.path.join(root, "fleet.jsonl")
+        self.w = [_Worker(self.engine_dir, self.ingest_dir),
+                  _Worker(self.worker_b_dir, self.b_ingest_dir)]
+        # worker 0 aliases (the single-worker story most scenarios crash
+        # inside)
+        self.journal_path = self.w[0].journal_path
+        self.state_path = self.w[0].state_path
         self.solves: Dict[str, int] = {}
         self.republished: Set[str] = set()
+
+    def _publish_routing(self) -> None:
+        fleet_routing.publish_routing(
+            self.root,
+            [{"index": i, "ingest_dir": w.ingest_dir, "http_port": None,
+              "state": "up" if i != 0 else "down"}
+             for i, w in enumerate(self.w)],
+            responses_dir=self.responses_dir,
+            ingest_dir=self.ingest_dir)
 
     # ---- setup (unarmed: the pre-existing world) ------------------------
 
     def setup(self) -> None:
-        for rid in REQUEST_IDS:
+        for rid in REQUEST_IDS + (HANDOFF_ID,):
             with open(os.path.join(self.ingest_dir, f"{rid}.json"),
                       "w") as f:
                 json.dump({"id": rid, "tenant": f"t-{rid}",
@@ -286,71 +343,110 @@ class ProtocolDriver:
                   "w") as f:
             json.dump({"id": OLD_ID, "verdict": "accepted",
                        "state": "done", "outcome": outcome}, f)
-        self.seen[OLD_ID] = None
-        self._count(OLD_ID, outcome)
-        self.state.save(self._state_payload())
+        w = self.w[0]
+        w.seen[OLD_ID] = None
+        self._count(w, OLD_ID, outcome)
+        w.state.save(self._state_payload(w))
 
     # ---- the armed run (the incarnation that dies) ----------------------
 
     def run_armed(self) -> None:
+        a, b = self.w
         atomicio.append_line(
             self.supervisor_path,
             json.dumps({"kind": "worker-start", "pid": 1}) + "\n")
-        self._lifecycle(REQUEST_IDS[0])
+        self._lifecycle(a, REQUEST_IDS[0])
+        # session-cache audit record (engine/session.py): attach/evict
+        # events ride the journal's durability; replay must skip them
+        a.journal.session_event("session-attach", "default", bytes=4096)
         atomicio.current_fs().remove(
             os.path.join(self.responses_dir, f"{OLD_ID}.json"))
-        self._lifecycle(REQUEST_IDS[1])
+        self._lifecycle(a, REQUEST_IDS[1])
         # rotation: checkpoint FIRST (the dedup/counted watermark must
         # be durable before compaction drops the completed records)
-        self._checkpoint()
-        self.journal.compact()
-        self.state.compact()
-        self._lifecycle(REQUEST_IDS[2])
+        self._checkpoint(a)
+        a.journal.compact()
+        a.state.compact()
+        self._lifecycle(a, REQUEST_IDS[2])
         atomicio.write_json_atomic(
             os.path.join(self.traces_dir,
                          f"{REQUEST_IDS[2]}.trace.json"),
             {"id": REQUEST_IDS[2], "spans": []}, fsync=True)
-
-    def _lifecycle(self, rid: str) -> None:
+        # ---- failover epilogue (docs/SERVING.md §10) --------------------
+        # worker 0 accepts HANDOFF_ID ... and dies before dispatching it
+        rid = HANDOFF_ID
         req = Request(id=rid, tenant=f"t-{rid}", trace=f"tr-{rid}")
-        self.journal.accepted(req)
-        self.seen[rid] = None
+        a.journal.accepted(req)
+        a.seen[rid] = None
         self._respond(rid, {"id": rid, "verdict": "accepted",
                             "state": "pending", "trace": req.trace})
         atomicio.current_fs().remove(
-            os.path.join(self.ingest_dir, f"{rid}.json"))
-        self._checkpoint()
-        self.journal.dispatched(req)
-        outcome = self._solve(rid)
-        self.journal.completed(req, outcome)
-        self._count(rid, outcome)
-        self._checkpoint()
+            os.path.join(a.ingest_dir, f"{rid}.json"))
+        self._checkpoint(a)
+        # the controller takes over: handoff marker on the DEAD journal
+        # FIRST (the re-stage file existing implies the marker is
+        # durable, so worker 0's restart can never become a second
+        # driver), then the routing/event/re-stage publishes
+        a.journal.handoff(rid, HANDOFF_TARGET, trace_id=req.trace)
+        self._publish_routing()
+        atomicio.append_line(
+            self.fleet_path,
+            json.dumps({"kind": "worker-crash", "worker": 0,
+                        "handoff": [rid],
+                        "target": HANDOFF_TARGET}) + "\n")
+        atomicio.write_json_atomic(
+            os.path.join(b.ingest_dir, f"{rid}.json"),
+            {"id": rid, "tenant": f"t-{rid}", "trace": f"tr-{rid}",
+             "handoff": True}, fsync=True)
+        # the survivor drives the handed-off request to completion
+        self._lifecycle(b, rid, handoff=True)
+
+    def _lifecycle(self, w: _Worker, rid: str,
+                   handoff: bool = False) -> None:
+        req = Request(id=rid, tenant=f"t-{rid}", trace=f"tr-{rid}",
+                      handoff=handoff)
+        w.journal.accepted(req)
+        w.seen[rid] = None
         self._respond(rid, {"id": rid, "verdict": "accepted",
-                            "state": "done", "trace": req.trace,
-                            "outcome": outcome})
+                            "state": "pending", "trace": req.trace})
+        atomicio.current_fs().remove(
+            os.path.join(w.ingest_dir, f"{rid}.json"))
+        self._checkpoint(w)
+        self._dispatch_and_complete(w, req)
+
+    def _dispatch_and_complete(self, w: _Worker,
+                               req: Request) -> None:
+        w.journal.dispatched(req)
+        outcome = self._solve(req.id)
+        w.journal.completed(req, outcome)
+        self._count(w, req.id, outcome)
+        self._checkpoint(w)
+        self._respond(req.id, {"id": req.id, "verdict": "accepted",
+                               "state": "done", "trace": req.trace,
+                               "outcome": outcome})
 
     def _solve(self, rid: str) -> dict:
         self.solves[rid] = self.solves.get(rid, 0) + 1
         return dict(expected_outcome(rid))
 
-    def _count(self, rid: str, outcome: dict) -> None:
+    def _count(self, w: _Worker, rid: str, outcome: dict) -> None:
         status = str(outcome.get("status") or "unknown")
-        self.counters[status] = self.counters.get(status, 0) + 1
+        w.counters[status] = w.counters.get(status, 0) + 1
         if float(outcome.get("latency_s") or 0.0) * 1000.0 > SLO_MS:
-            self.slo["breach"] += 1
+            w.slo["breach"] += 1
         else:
-            self.slo["ok"] += 1
-        self.counted[rid] = None
+            w.slo["ok"] += 1
+        w.counted[rid] = None
 
-    def _state_payload(self) -> dict:
+    def _state_payload(self, w: _Worker) -> dict:
         return {"lanes": 2,
-                "admission": {"seen_ids": list(self.seen)},
-                "counted_ids": list(self.counted),
-                "counters": dict(self.counters),
-                "slo": dict(self.slo)}
+                "admission": {"seen_ids": list(w.seen)},
+                "counted_ids": list(w.counted),
+                "counters": dict(w.counters),
+                "slo": dict(w.slo)}
 
-    def _checkpoint(self) -> None:
-        self.state.save(self._state_payload())
+    def _checkpoint(self, w: _Worker) -> None:
+        w.state.save(self._state_payload(w))
 
     def _respond(self, rid: str, body: dict) -> None:
         atomicio.write_json_atomic(
@@ -368,70 +464,107 @@ class ProtocolDriver:
     # ---- recovery (the restart incarnation; real code, real fs) ---------
 
     def recover(self) -> Tuple[Set[str], List[str]]:
-        """Run the restart path against the crash state. Returns
+        """Run the restart path against the crash state — every worker
+        restarts, and the controller resolves interrupted handoffs
+        before the survivor rescans its ingest. Returns
         ``(completed_at_crash, redriven_ids)`` for the invariant
         checks."""
-        self.journal = RequestJournal(self.journal_path)
-        self.state = StateStore(self.state_path)
-        for d in (self.engine_dir, self.responses_dir, self.traces_dir):
+        for w in self.w:
+            w.reopen()
+        for d in (self.engine_dir, self.responses_dir, self.traces_dir,
+                  self.worker_b_dir, self.b_ingest_dir, self.root):
             atomicio.sweep_orphans(d)
-        restored = self.state.load() or {}
-        self.counters = dict(restored.get("counters") or {})
-        slo = restored.get("slo") or {}
-        self.slo = {"ok": int(slo.get("ok") or 0),
-                    "breach": int(slo.get("breach") or 0)}
-        self.counted = {str(r): None
-                        for r in restored.get("counted_ids") or []}
-        self.seen = {str(r): None for r in
-                     (restored.get("admission") or {}).get("seen_ids")
-                     or []}
-        completed, pending = self.journal.replay()
-        completed_at_crash = set(completed)
-        for rid, outcome in completed.items():
-            self.seen.setdefault(rid, None)
-            prev = self._read_response(rid)
-            if engine_protocol.needs_republish(
-                    outcome, prev, response_ttl_s=RESPONSE_TTL_S):
-                self._respond(rid, {
-                    "id": rid, "verdict": "accepted", "state": "done",
-                    "outcome": {k: v for k, v in outcome.items()
-                                if k != "journal_unix"},
-                    "republished": True})
-                self.republished.add(rid)
-        for rid, outcome in engine_protocol.uncounted_completed(
-                completed, self.counted):
-            self._count(rid, outcome)
-        # ingest rescan: files whose id the journal/watermark already
-        # knows are duplicates of consumed work; unseen files admit
-        pending_ids = {req.id for req in pending}
-        for name in sorted(os.listdir(self.ingest_dir)):
-            if not name.endswith(".json"):
-                continue
-            rid = name[:-len(".json")]
-            path = os.path.join(self.ingest_dir, name)
-            if rid in completed or rid in pending_ids or rid in self.seen:
-                os.unlink(path)
-                continue
-            req = Request(id=rid, tenant=f"t-{rid}", trace=f"tr-{rid}")
-            self.journal.accepted(req)
-            self.seen[rid] = None
-            self._respond(rid, {"id": rid, "verdict": "accepted",
-                                "state": "pending", "trace": req.trace})
-            os.unlink(path)
-            pending.append(req)
-            pending_ids.add(rid)
+        for w in self.w:
+            restored = w.state.load() or {}
+            w.counters = dict(restored.get("counters") or {})
+            slo = restored.get("slo") or {}
+            w.slo = {"ok": int(slo.get("ok") or 0),
+                     "breach": int(slo.get("breach") or 0)}
+            w.counted = {str(r): None
+                         for r in restored.get("counted_ids") or []}
+            w.seen = {str(r): None for r in
+                      (restored.get("admission") or {}).get("seen_ids")
+                      or []}
+        completed0, pending0, handed_off = self.w[0].journal.replay_full()
+        completed1, pending1, _ = self.w[1].journal.replay_full()
+        completed_at_crash = set(completed0) | set(completed1)
+        stories = [(self.w[0], completed0, pending0),
+                   (self.w[1], completed1, pending1)]
+        for w, completed, _pending in stories:
+            for rid, outcome in completed.items():
+                w.seen.setdefault(rid, None)
+                prev = self._read_response(rid)
+                if engine_protocol.needs_republish(
+                        outcome, prev, response_ttl_s=RESPONSE_TTL_S):
+                    self._respond(rid, {
+                        "id": rid, "verdict": "accepted",
+                        "state": "done",
+                        "outcome": {k: v for k, v in outcome.items()
+                                    if k != "journal_unix"},
+                        "republished": True})
+                    self.republished.add(rid)
+            for rid, outcome in engine_protocol.uncounted_completed(
+                    completed, w.counted):
+                self._count(w, rid, outcome)
+        # controller recovery: an interrupted handoff (marker durable,
+        # re-stage not) is re-staged on the survivor BEFORE the
+        # survivor's ingest rescan picks up new work
+        pending1_ids = {req.id for req in pending1}
+        for rid, story in handed_off.items():
+            staged = os.path.exists(
+                os.path.join(self.b_ingest_dir, f"{rid}.json"))
+            if engine_protocol.needs_restage(
+                    completed_anywhere=(rid in completed0
+                                        or rid in completed1),
+                    pending_on_target=rid in pending1_ids,
+                    staged_on_target=staged):
+                req = story.get("request")
+                atomicio.write_json_atomic(
+                    os.path.join(self.b_ingest_dir, f"{rid}.json"),
+                    {"id": rid,
+                     "tenant": req.tenant if req else f"t-{rid}",
+                     "trace": req.trace if req else f"tr-{rid}",
+                     "handoff": True}, fsync=True)
+        # the controller always republishes the routing table at start
+        self._publish_routing()
         redriven: List[str] = []
-        for req in pending:
-            self.journal.dispatched(req)
-            outcome = self._solve(req.id)
-            self.journal.completed(req, outcome)
-            self._count(req.id, outcome)
-            self._checkpoint()
-            self._respond(req.id, {"id": req.id, "verdict": "accepted",
-                                   "state": "done", "trace": req.trace,
-                                   "outcome": outcome})
-            redriven.append(req.id)
-        self._checkpoint()
+        for w, completed, pending in stories:
+            # ingest rescan: files whose id the journal/watermark
+            # already knows are duplicates of consumed work; unseen
+            # files admit
+            pending_ids = {req.id for req in pending}
+            for name in sorted(os.listdir(w.ingest_dir)):
+                if not name.endswith(".json"):
+                    continue
+                rid = name[:-len(".json")]
+                path = os.path.join(w.ingest_dir, name)
+                if (rid in completed or rid in pending_ids
+                        or rid in w.seen):
+                    os.unlink(path)
+                    continue
+                req = Request(id=rid, tenant=f"t-{rid}",
+                              trace=f"tr-{rid}")
+                w.journal.accepted(req)
+                w.seen[rid] = None
+                self._respond(rid, {"id": rid, "verdict": "accepted",
+                                    "state": "pending",
+                                    "trace": req.trace})
+                os.unlink(path)
+                pending.append(req)
+                pending_ids.add(rid)
+            for req in pending:
+                w.journal.dispatched(req)
+                outcome = self._solve(req.id)
+                w.journal.completed(req, outcome)
+                self._count(w, req.id, outcome)
+                self._checkpoint(w)
+                self._respond(req.id, {"id": req.id,
+                                       "verdict": "accepted",
+                                       "state": "done",
+                                       "trace": req.trace,
+                                       "outcome": outcome})
+                redriven.append(req.id)
+            self._checkpoint(w)
         return completed_at_crash, redriven
 
     # ---- invariants ------------------------------------------------------
@@ -465,12 +598,17 @@ class ProtocolDriver:
         for rid, n in self.solves.items():
             if n > 2:
                 out.append(f"{rid}: solved {n} times")
-        for rid in completed_at_crash & set(REQUEST_IDS):
+        for rid in completed_at_crash & set(REQUEST_IDS + (HANDOFF_ID,)):
             if self.solves.get(rid, 0) != 1:
                 out.append(f"{rid}: completed at crash but solved "
                            f"{self.solves.get(rid, 0)} times")
+        # exactly one driver per handed-off id: whatever prefix of the
+        # handoff protocol landed, the request is solved at most twice
+        # (once per incarnation) and never concurrently re-driven —
+        # covered by the checks above; additionally it must END done
+        # fleet-wide, which the response loop below asserts
         # no lost outcome
-        for rid in REQUEST_IDS:
+        for rid in REQUEST_IDS + (HANDOFF_ID,):
             body = self._read_response(rid)
             if body is None:
                 out.append(f"{rid}: done response missing or torn")
@@ -494,9 +632,19 @@ class ProtocolDriver:
             if body is None or body.get("state") != "done":
                 out.append(f"stale/torn response {name} survived "
                            f"recovery")
-        # counter continuity across the crash
-        final = StateStore(self.state_path).load() or {}
-        ids = (OLD_ID,) + REQUEST_IDS
+        # counter continuity across the crash, summed FLEET-WIDE: the
+        # handed-off request counts on whichever worker completed it,
+        # and the sum over every worker's final checkpoint must cover
+        # every request exactly once
+        got_counters: Dict[str, int] = {}
+        got_slo = {"ok": 0, "breach": 0}
+        for w in self.w:
+            final = StateStore(w.state_path).load() or {}
+            for k, v in (final.get("counters") or {}).items():
+                got_counters[k] = got_counters.get(k, 0) + int(v)
+            for k in got_slo:
+                got_slo[k] += int((final.get("slo") or {}).get(k) or 0)
+        ids = (OLD_ID,) + REQUEST_IDS + (HANDOFF_ID,)
         exp_counters: Dict[str, int] = {}
         exp_slo = {"ok": 0, "breach": 0}
         for rid in ids:
@@ -506,11 +654,10 @@ class ProtocolDriver:
             key = ("breach" if o["latency_s"] * 1000.0 > SLO_MS
                    else "ok")
             exp_slo[key] += 1
-        if (final.get("counters") or {}) != exp_counters:
-            out.append(f"outcome counters {final.get('counters')} != "
+        if got_counters != exp_counters:
+            out.append(f"outcome counters {got_counters} != "
                        f"{exp_counters} (lost or double count)")
-        got_slo = final.get("slo") or {}
-        if {k: int(got_slo.get(k) or 0) for k in exp_slo} != exp_slo:
+        if got_slo != exp_slo:
             out.append(f"slo tallies {got_slo} != {exp_slo}")
         # publish debris must not survive the startup sweep
         for dirpath, _, files in os.walk(self.root):
@@ -524,16 +671,20 @@ class ProtocolDriver:
                        f"by replay")
         if OLD_ID in redriven:
             out.append(f"{OLD_ID}: long-completed request re-driven")
-        # supervisor log: at most one torn line, and it is the last
-        if os.path.exists(self.supervisor_path):
-            with open(self.supervisor_path) as f:
+        # supervisor/fleet logs: at most one torn line, and it is the
+        # last (appends are fsync'd in order)
+        for label, path in (("supervisor.jsonl", self.supervisor_path),
+                            ("fleet.jsonl", self.fleet_path)):
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
                 lines = [ln for ln in f.read().splitlines() if ln]
             for ln in lines[:-1]:
                 try:
                     json.loads(ln)
                 except ValueError:
-                    out.append("supervisor.jsonl torn on a NON-final "
-                               "line (append not fsync'd in order)")
+                    out.append(f"{label} torn on a NON-final line "
+                               f"(append not fsync'd in order)")
         return out
 
 
@@ -665,6 +816,6 @@ def run_protocol_check(byte_stride: int = 1) -> ProtocolReport:
 
 __all__ = [
     "CrashPlan", "EffectRecord", "ProtocolDriver", "ProtocolReport",
-    "ShimFS", "SimulatedCrash", "REQUEST_IDS", "expected_outcome",
-    "run_protocol_check",
+    "ShimFS", "SimulatedCrash", "REQUEST_IDS", "HANDOFF_ID",
+    "expected_outcome", "run_protocol_check",
 ]

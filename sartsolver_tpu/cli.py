@@ -40,7 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
                "/ `sartsolve submit` — resident serving engine with "
                "admission control, deadlines and a crash-recoverable "
                "request journal (docs/SERVING.md; `serve --supervised` "
-               "adds self-healing restarts); `sartsolve chaos` — "
+               "adds self-healing restarts); `sartsolve fleet` — M "
+               "serve workers behind one controller with "
+               "tenant-affinity routing and journal-backed failover "
+               "(docs/SERVING.md §10); `sartsolve chaos` — "
                "randomized fault/kill campaign proving the supervised "
                "engine's exactly-once and byte-identity invariants. "
                "A running solve "
@@ -406,6 +409,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         from sartsolver_tpu.engine.cli import serve_main
 
         return serve_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        # fleet controller (docs/SERVING.md §10): M serve workers,
+        # tenant-affinity routing table, journal-backed failover;
+        # dispatched like `serve`, before the solver parser sees argv
+        from sartsolver_tpu.engine.cli import fleet_cli_main
+
+        return fleet_cli_main(argv[1:])
     if argv and argv[0] == "submit":
         # serving-engine client (docs/SERVING.md): submit a request to
         # a running `sartsolve serve` and optionally await its outcome

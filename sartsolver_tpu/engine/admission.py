@@ -11,14 +11,17 @@ Check order in :meth:`AdmissionController.admit` (most-specific verdict
 first, so a rejected client learns the *actionable* reason):
 
 1. ``draining`` — the engine is stopping (SIGTERM); resubmit elsewhere.
-2. ``duplicate-id`` — the id was already accepted or completed
+2. ``wrong-worker`` — fleet tenant affinity routes this tenant to a
+   different worker (docs/SERVING.md §10); bypassed for requests the
+   controller re-staged with the ``handoff`` flag.
+3. ``duplicate-id`` — the id was already accepted or completed
    (idempotent replay: a resubmitted completed request is NOT re-run).
-3. ``tenant-quarantined`` — this tenant's requests keep failing; the
+4. ``tenant-quarantined`` — this tenant's requests keep failing; the
    pool is protected until the cooldown passes.
-4. ``degraded`` — load-shed mode (the OOM ladder engaged or the queue
+5. ``degraded`` — load-shed mode (the OOM ladder engaged or the queue
    saturated); only :attr:`degraded_admit_below` headroom is served.
-5. ``queue-full`` — the bounded queue is at capacity (backpressure).
-6. ``tenant-quota`` — the tenant's in-queue share is at its cap.
+6. ``queue-full`` — the bounded queue is at capacity (backpressure).
+7. ``tenant-quota`` — the tenant's in-queue share is at its cap.
 
 Quarantine: :attr:`quarantine_after` *consecutive* terminal failures
 (REQ_FAILED / REQ_PARTIAL — frames hitting FAILED/SDC/DIVERGED) rate-
@@ -34,6 +37,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from sartsolver_tpu.engine import request as reqmod
+from sartsolver_tpu.engine.routing import tenant_worker
 from sartsolver_tpu.obs import metrics as obs_metrics
 
 
@@ -68,11 +72,24 @@ class AdmissionController:
         quarantine_cooldown: float = 60.0,
         on_event: Optional[Callable[[str], None]] = None,
         clock: Callable[[], float] = time.monotonic,
+        affinity: Optional[tuple] = None,  # (worker_index, fleet_size)
     ):
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1.")
         if quarantine_after < 1:
             raise ValueError("quarantine_after must be >= 1.")
+        if affinity is not None:
+            index, size = int(affinity[0]), int(affinity[1])
+            if size < 1 or not 0 <= index < size:
+                raise ValueError(
+                    f"affinity index {index} out of range for fleet "
+                    f"size {size}.")
+            affinity = (index, size)
+        # fleet tenant affinity (docs/SERVING.md §10): with (k, M) set,
+        # tenants whose affinity hash routes elsewhere are rejected
+        # REASON_WRONG_WORKER (retryable) unless the request carries
+        # the controller's handoff flag
+        self.affinity = affinity
         self.max_queue = int(max_queue)
         self.max_per_tenant = int(max_per_tenant)
         self.quarantine_after = int(quarantine_after)
@@ -157,6 +174,11 @@ class AdmissionController:
         if draining:
             self.shed(reqmod.REASON_DRAINING)
             return reqmod.REASON_DRAINING
+        if self.affinity is not None and not request.handoff:
+            index, size = self.affinity
+            if tenant_worker(request.tenant, size) != index:
+                self.shed(reqmod.REASON_WRONG_WORKER)
+                return reqmod.REASON_WRONG_WORKER
         if request.id in self._seen_ids:
             self.shed(reqmod.REASON_DUPLICATE)
             return reqmod.REASON_DUPLICATE

@@ -125,6 +125,27 @@ def build_serve_parser() -> argparse.ArgumentParser:
                      help="Retention sweep: delete per-request trace "
                           "files older than S seconds (0 = keep "
                           "forever). Default 86400 (1 day).")
+    flt = p.add_argument_group("fleet membership (docs/SERVING.md §10)")
+    flt.add_argument("--responses_dir", default=None, metavar="DIR",
+                     help="Write verdict/outcome responses here instead "
+                          "of <engine_dir>/responses (the fleet "
+                          "controller points every worker at one shared "
+                          "dir so clients poll a single place).")
+    flt.add_argument("--outputs_dir", default=None, metavar="DIR",
+                     help="Write solution HDF5 files here instead of "
+                          "<engine_dir>/outputs (shared across a "
+                          "fleet, like --responses_dir).")
+    flt.add_argument("--worker_index", type=int, default=None,
+                     metavar="K",
+                     help="This worker's shard index in a fleet of "
+                          "--fleet_size workers: requests whose tenant "
+                          "hashes to a different shard are shed with "
+                          "reason 'wrong-worker' (requests re-staged by "
+                          "the controller's failover carry handoff=true "
+                          "and bypass the check). Needs --fleet_size.")
+    flt.add_argument("--fleet_size", type=int, default=None, metavar="M",
+                     help="Total workers in the fleet (tenant-affinity "
+                          "modulus). Needs --worker_index.")
     sup = p.add_argument_group(
         "supervision (docs/SERVING.md §9, docs/RESILIENCE.md §10)"
     )
@@ -184,6 +205,15 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         print("Arguments journal_rotate_bytes/response_ttl/trace_ttl "
               "must be >= 0.", file=sys.stderr)
         return EXIT_INPUT_ERROR
+    if (args.worker_index is None) != (args.fleet_size is None):
+        print("Arguments worker_index and fleet_size must be given "
+              "together.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if (args.worker_index is not None
+            and not 0 <= args.worker_index < args.fleet_size):
+        print("Argument worker_index must satisfy "
+              "0 <= worker_index < fleet_size.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
 
     if args.supervised:
         # the supervisor is deliberately jax-free: it must stay alive
@@ -235,7 +265,7 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
     from sartsolver_tpu.config import SartInputError
     from sartsolver_tpu.engine.admission import AdmissionController
     from sartsolver_tpu.engine.server import EngineServer
-    from sartsolver_tpu.engine.session import ResidentSession
+    from sartsolver_tpu.engine.session import ResidentSession, SessionCache
     from sartsolver_tpu.obs import flight as obs_flight
     from sartsolver_tpu.obs.run import RunTelemetry
     from sartsolver_tpu.resilience import shutdown, watchdog
@@ -282,14 +312,22 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             lanes=int(args.lanes),
             max_queue=int(args.max_queue),
         )
+        # multi-session residency (docs/SERVING.md §10): the eagerly
+        # built default session is seeded into a byte-budgeted cache so
+        # flag/input errors still surface before the first request, and
+        # later keys warm through the same validated builder
+        cache = SessionCache(lambda key: ResidentSession.build(args))
+        cache.seed("default", session)
         admission = AdmissionController(
             max_queue=args.max_queue,
             max_per_tenant=args.max_per_tenant,
             quarantine_after=args.quarantine_after,
             quarantine_cooldown=args.quarantine_cooldown,
+            affinity=((args.worker_index, args.fleet_size)
+                      if args.worker_index is not None else None),
         )
         server = EngineServer(
-            session,
+            cache,
             engine_dir=args.engine_dir,
             lanes=args.lanes,
             admission=admission,
@@ -304,6 +342,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             journal_rotate_bytes=args.journal_rotate_bytes,
             response_ttl_s=args.response_ttl,
             trace_ttl_s=args.trace_ttl,
+            responses_dir=args.responses_dir,
+            outputs_dir=args.outputs_dir,
         )
         code = server.run()
         if code == EXIT_INTERRUPTED:
@@ -334,6 +374,103 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
             wd.stop()
         shutdown.uninstall()
         telem.finalize_local(None)
+
+
+# ---------------------------------------------------------------------------
+# fleet
+# ---------------------------------------------------------------------------
+
+def build_fleet_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="sartsolve fleet",
+        description="Run M supervised serve workers behind one "
+                    "controller: tenant-affinity routing "
+                    "(routing.json), shared responses/outputs dirs, "
+                    "and journal-backed failover — a dead worker's "
+                    "accepted-but-uncompleted requests are re-driven "
+                    "on a survivor exactly once (docs/SERVING.md §10). "
+                    "Worker flags (the full `sartsolve serve` set) go "
+                    "after `--`.",
+    )
+    p.add_argument("--fleet_dir", required=True,
+                   help="Fleet state directory: routing.json, "
+                        "fleet.jsonl, shared ingest/responses/outputs, "
+                        "workers/w<k>/ engine dirs.")
+    p.add_argument("--size", type=int, default=3, metavar="M",
+                   help="Worker count (tenant-affinity modulus). "
+                        "Default 3.")
+    p.add_argument("--base_port", type=int, default=None, metavar="PORT",
+                   help="Give worker k the live endpoint PORT+k "
+                        "(/readyz drives the controller's "
+                        "load-balancing and drain detection). Default: "
+                        "no endpoints.")
+    p.add_argument("--restart_backoff", type=float, default=0.5,
+                   metavar="S",
+                   help="Base respawn delay after a worker crash; "
+                        "doubles per consecutive crash. Default 0.5.")
+    p.add_argument("--restart_backoff_max", type=float, default=10.0,
+                   metavar="S",
+                   help="Respawn delay ceiling. Default 10.")
+    p.add_argument("--max_restarts", type=int, default=0, metavar="N",
+                   help="Fleet-wide restart budget; exhausted -> exit "
+                        "3. 0 = unlimited (default).")
+    p.add_argument("--poll_interval", type=float, default=0.1,
+                   metavar="S",
+                   help="Controller loop interval (worker liveness, "
+                        "intake routing). Default 0.1.")
+    p.add_argument("worker_args", nargs=argparse.REMAINDER,
+                   help="Flags forwarded to every worker's `sartsolve "
+                        "serve` (put them after `--`).")
+    return p
+
+
+def fleet_cli_main(argv: Optional[List[str]] = None) -> int:
+    parser = build_fleet_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as err:
+        raise SystemExit(1 if err.code else 0) from None
+    if args.size < 1:
+        print("Argument size must be >= 1.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if (args.restart_backoff < 0 or args.restart_backoff_max < 0
+            or args.max_restarts < 0 or args.poll_interval <= 0):
+        print("Arguments restart_backoff/restart_backoff_max/"
+              "max_restarts must be >= 0 and poll_interval > 0.",
+              file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    if (args.base_port is not None
+            and not 1 <= args.base_port <= 65535 - args.size):
+        print("Argument base_port must leave room for size ports "
+              "below 65536.", file=sys.stderr)
+        return EXIT_INPUT_ERROR
+    worker_argv = list(args.worker_args)
+    if worker_argv[:1] == ["--"]:
+        worker_argv = worker_argv[1:]
+    for banned in ("--engine_dir", "--worker_index", "--fleet_size",
+                   "--responses_dir", "--outputs_dir", "--http_port",
+                   "--supervised"):
+        if any(a == banned or a.startswith(banned + "=")
+               for a in worker_argv):
+            print(f"sartsolve fleet: {banned} is controller-owned; "
+                  "drop it from the worker flags.", file=sys.stderr)
+            return EXIT_INPUT_ERROR
+
+    # like `serve --supervised`, the controller stays off the jax path:
+    # it must outlive exactly the failures that wedge a worker
+    from sartsolver_tpu.resilience.supervisor import FleetController
+
+    controller = FleetController(
+        worker_argv,
+        fleet_dir=args.fleet_dir,
+        size=args.size,
+        base_port=args.base_port,
+        backoff_base=args.restart_backoff,
+        backoff_max=args.restart_backoff_max,
+        max_restarts=args.max_restarts,
+        poll_interval=args.poll_interval,
+    )
+    return controller.run()
 
 
 # ---------------------------------------------------------------------------
@@ -556,6 +693,23 @@ def _submit_attempt(args, req, payload_text):
 
     ingest = os.path.join(args.engine_dir, "ingest")
     responses = os.path.join(args.engine_dir, "responses")
+    # fleet awareness: when --engine_dir is a fleet dir (it holds a
+    # routing.json), resolve this tenant's affinity worker. Resolution
+    # happens here — inside the per-attempt path — so every --retry
+    # attempt re-reads the table and follows a failover that moved the
+    # tenant's shard between attempts. A down worker (or torn table)
+    # falls back to the controller intake dir, which routes centrally.
+    from sartsolver_tpu.engine import routing as fleet_routing
+
+    routing = fleet_routing.read_routing(args.engine_dir)
+    if routing is not None:
+        row = fleet_routing.resolve_worker(routing, req.tenant)
+        if (row is not None and row.get("state") == "up"
+                and row.get("ingest_dir")):
+            ingest = row["ingest_dir"]
+        elif routing.get("ingest_dir"):
+            ingest = routing["ingest_dir"]
+        responses = routing.get("responses_dir") or responses
     if not os.path.isdir(ingest):
         print(f"sartsolve submit: no engine ingest dir at {ingest} "
               "(is `sartsolve serve` running with this --engine_dir?).",

@@ -51,8 +51,17 @@ from sartsolver_tpu.utils import atomicio
 MARKER_ACCEPTED = "accepted"
 MARKER_DISPATCHED = "dispatched"
 MARKER_COMPLETED = "completed"
+# fleet failover (docs/SERVING.md §10): the controller appends a
+# handoff marker to a DEAD worker's journal before re-staging the
+# request on a survivor — the dead worker's own replay then skips the
+# id (exactly one of {local re-drive, fleet handoff} can happen)
+MARKER_HANDOFF = "handoff"
+# session-cache attach/evict events (engine/session.py): observability
+# records riding the journal's durability; replay skips them
+MARKER_SESSION = "session"
 
-_MARKERS = (MARKER_ACCEPTED, MARKER_DISPATCHED, MARKER_COMPLETED)
+_MARKERS = (MARKER_ACCEPTED, MARKER_DISPATCHED, MARKER_COMPLETED,
+            MARKER_HANDOFF, MARKER_SESSION)
 
 
 def _crash_window(point: str) -> None:
@@ -61,7 +70,13 @@ def _crash_window(point: str) -> None:
     real serve process deterministically inside it. Zero work unset."""
     delay = os.environ.get("SART_TEST_JOURNAL_DELAY")
     if delay:
-        sys.stderr.write(f"SART_JOURNAL_POINT {point}\n")
+        # fleet workers tag the announcement so the fleet chaos harness
+        # can SIGKILL the SPECIFIC worker sleeping in this window; the
+        # controller (no SART_WORKER_ID) announces untagged, which is
+        # how the harness recognizes a mid-handoff controller
+        worker = os.environ.get("SART_WORKER_ID")
+        tag = f" worker={worker}" if worker else ""
+        sys.stderr.write(f"SART_JOURNAL_POINT {point}{tag}\n")
         sys.stderr.flush()
         time.sleep(float(delay))
 
@@ -122,6 +137,23 @@ class RequestJournal:
         self.append(MARKER_COMPLETED, request.id, trace_id=request.trace,
                     outcome=outcome)
 
+    def handoff(self, request_id: str, target: int, *,
+                trace_id: Optional[str] = None) -> None:
+        """Record that the fleet controller re-drove this accepted-but-
+        uncompleted request onto worker ``target``. Appended to the
+        DEAD worker's journal BEFORE the payload is re-staged, so a
+        crash between the two leaves at most an unacted-on marker (the
+        controller re-stages on recovery) and never two drivers."""
+        self.append(MARKER_HANDOFF, request_id, trace_id=trace_id,
+                    target=int(target))
+
+    def session_event(self, kind: str, key: str, **data) -> None:
+        """Journal a session-cache attach/evict event (kind is
+        ``session-attach`` / ``session-evict``). Replay skips these —
+        they carry no request lifecycle, only the audit trail."""
+        self.append(MARKER_SESSION, f"{kind}:{key}", event=kind,
+                    key=key, **data)
+
     # ---- replay ----------------------------------------------------------
 
     def replay(self) -> Tuple[Dict[str, dict], List[Request]]:
@@ -131,15 +163,30 @@ class RequestJournal:
         never re-run, and re-submissions of the same id are rejected as
         duplicates). ``pending`` holds the accepted-but-not-completed
         requests, reconstructed from their journaled payloads, in
-        acceptance order — the restart re-runs exactly these. A torn
-        final line (kill mid-append) is skipped; a torn line anywhere
-        else would mean the fsync contract broke, but replay still
-        degrades per-line rather than refusing the whole journal."""
+        acceptance order — the restart re-runs exactly these. Requests
+        with a ``handoff`` marker are NOT pending here: the controller
+        re-drove them on another worker (see :meth:`replay_full`). A
+        torn final line (kill mid-append) is skipped; a torn line
+        anywhere else would mean the fsync contract broke, but replay
+        still degrades per-line rather than refusing the whole
+        journal."""
+        completed, pending, _ = self.replay_full()
+        return completed, pending
+
+    def replay_full(self) -> Tuple[Dict[str, dict], List[Request],
+                                   Dict[str, dict]]:
+        """:meth:`replay` plus the handoff story: ``(completed,
+        pending, handed_off)`` where ``handed_off`` maps each
+        re-driven (and not locally completed) request id to
+        ``{"target": worker-index, "request": Request-or-None}`` — the
+        payload rides along so the controller can re-stage it if the
+        handoff was interrupted before the survivor saw the file."""
         completed: Dict[str, dict] = {}
         accepted: Dict[str, Request] = {}
+        handoff: Dict[str, dict] = {}
         order: List[str] = []
         if not os.path.exists(self.path):
-            return completed, []
+            return completed, [], {}
         with open(self.path) as f:
             for line in f:
                 line = line.strip()
@@ -174,10 +221,13 @@ class RequestJournal:
                         # is the same request, and its spans/markers must
                         # join against the pre-crash ones
                         trace=str(raw.get("trace", "")),
+                        handoff=bool(raw.get("handoff", False)),
                     )
                     if rid not in accepted:
                         accepted[rid] = req
                         order.append(rid)
+                elif marker == MARKER_HANDOFF:
+                    handoff[rid] = {"target": rec.get("target")}
                 elif marker == MARKER_COMPLETED:
                     outcome = dict(rec.get("outcome") or {})
                     if outcome:
@@ -187,8 +237,14 @@ class RequestJournal:
                         outcome.setdefault("journal_unix",
                                            rec.get("unix"))
                     completed[rid] = outcome
-        pending = [accepted[rid] for rid in order if rid not in completed]
-        return completed, pending
+        handed_off = {
+            rid: {"target": rec.get("target"),
+                  "request": accepted.get(rid)}
+            for rid, rec in handoff.items() if rid not in completed
+        }
+        pending = [accepted[rid] for rid in order
+                   if rid not in completed and rid not in handed_off]
+        return completed, pending, handed_off
 
     # ---- rotation --------------------------------------------------------
 
@@ -204,20 +260,35 @@ class RequestJournal:
         (acceptance order preserved). Completed records are dropped —
         which is only safe once their ids are durable in the engine
         state checkpoint's dedup watermark (engine/state.py), so the
-        server always checkpoints BEFORE compacting. Atomic rename, so
-        a kill mid-compaction leaves the previous journal intact.
-        Returns the bytes reclaimed (0 when nothing to do)."""
+        server always checkpoints BEFORE compacting. Handoff stories
+        for non-completed ids survive compaction (accepted + handoff
+        markers re-written) — dropping them would resurrect the id as
+        pending on the dead worker's next replay, re-driving a request
+        the fleet already owns elsewhere. Atomic rename, so a kill
+        mid-compaction leaves the previous journal intact. Returns the
+        bytes reclaimed (0 when nothing to do)."""
         before = self.size()
         if before == 0:
             return 0
-        completed, pending = self.replay()
+        completed, pending, handed_off = self.replay_full()
         lines = []
-        for req in pending:
+
+        def accepted_line(req: Request) -> str:
             rec = {"marker": MARKER_ACCEPTED, "id": req.id,
                    "unix": round(time.time(), 3)}
             if req.trace:
                 rec["trace"] = req.trace
             rec["request"] = req.to_dict()
-            lines.append(json.dumps(rec) + "\n")
+            return json.dumps(rec) + "\n"
+
+        for req in pending:
+            lines.append(accepted_line(req))
+        for rid, story in handed_off.items():
+            if story.get("request") is not None:
+                lines.append(accepted_line(story["request"]))
+            lines.append(json.dumps(
+                {"marker": MARKER_HANDOFF, "id": rid,
+                 "unix": round(time.time(), 3),
+                 "target": story.get("target")}) + "\n")
         atomicio.write_atomic(self.path, "".join(lines))
         return max(0, before - self.size())

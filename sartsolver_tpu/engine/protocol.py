@@ -125,6 +125,43 @@ PROTOCOL: Tuple[EffectPoint, ...] = (
         "supervisor event append — the record of the crash must "
         "survive the crash (flush+fsync like the journal)",
     ),
+    EffectPoint(
+        "journal.session", "engine/session.py", "append", "journal",
+        None,
+        "session-cache attach/evict audit record riding the journal's "
+        "durability — replay skips it (no request lifecycle), "
+        "compaction drops it",
+    ),
+    # ---- fleet failover effects (docs/SERVING.md §10) ---------------------
+    EffectPoint(
+        "journal.handoff", "resilience/supervisor.py", "append",
+        "journal", "handoff",
+        "handoff marker appended to the DEAD worker's journal BEFORE "
+        "the payload is re-staged on a survivor — the marker is what "
+        "keeps a later restart of the dead worker from re-driving the "
+        "same request (exactly one driver per id)",
+    ),
+    EffectPoint(
+        "ingest.stage", "resilience/supervisor.py", "publish", "ingest",
+        None,
+        "failover re-stage: the handed-off payload published "
+        "atomically into the survivor's ingest dir (handoff flag set "
+        "so affinity admits it); a crash before it leaves the handoff "
+        "marker, which controller recovery resolves by re-staging",
+    ),
+    EffectPoint(
+        "routing.publish", "resilience/supervisor.py", "publish",
+        "routing", None,
+        "fleet routing-table publish (atomic rename, fsync'd) — "
+        "clients re-read it every retry attempt, so a torn table "
+        "would strand every retrying client at once",
+    ),
+    EffectPoint(
+        "fleet.event", "resilience/supervisor.py", "append", "fleet",
+        None,
+        "controller event append (worker death, handoff, routing "
+        "change) — same durability as supervisor events",
+    ),
 )
 
 # The per-request commit order the clean effect trace must honor (a
@@ -202,7 +239,23 @@ def uncounted_completed(
             if rid not in counted]
 
 
+def needs_restage(*, completed_anywhere: bool, pending_on_target: bool,
+                  staged_on_target: bool) -> bool:
+    """Whether controller recovery must re-stage a handed-off id.
+
+    A handoff marker on a dead worker's journal promises the request to
+    a survivor, but the crash may have landed between the marker and
+    the re-stage publish. Recovery re-stages exactly when no other copy
+    of the story exists: the id is not completed anywhere in the fleet,
+    not pending in the survivor's journal, and not already staged in
+    the survivor's ingest. Any one of those means a driver exists and a
+    re-stage would risk a duplicate (the dedup watermark would catch
+    it, but the invariant is cheaper to hold than to repair)."""
+    return not (completed_anywhere or pending_on_target
+                or staged_on_target)
+
+
 __all__ = [
     "EffectPoint", "PROTOCOL", "REQUEST_COMMIT_ORDER", "effect",
-    "needs_republish", "uncounted_completed",
+    "needs_republish", "uncounted_completed", "needs_restage",
 ]

@@ -34,11 +34,12 @@ REASON_DRAINING = "draining"                # engine is stopping (SIGTERM)
 REASON_DEGRADED = "degraded"                # load-shed mode (e.g. after OOM)
 REASON_DUPLICATE = "duplicate-id"           # id already accepted or completed
 REASON_CRASH_LOOP = "crash-loop"            # supervisor breaker open (lame duck)
+REASON_WRONG_WORKER = "wrong-worker"        # tenant affinity routes elsewhere
 
 SHED_REASONS = (
     REASON_MALFORMED, REASON_QUEUE_FULL, REASON_TENANT_QUOTA,
     REASON_TENANT_QUARANTINED, REASON_DRAINING, REASON_DEGRADED,
-    REASON_DUPLICATE, REASON_CRASH_LOOP,
+    REASON_DUPLICATE, REASON_CRASH_LOOP, REASON_WRONG_WORKER,
 )
 
 # Rejections a client should retry after backing off (`sartsolve submit
@@ -48,6 +49,7 @@ SHED_REASONS = (
 RETRYABLE_REASONS = (
     REASON_QUEUE_FULL, REASON_TENANT_QUOTA, REASON_DEGRADED,
     REASON_DRAINING, REASON_TENANT_QUARANTINED, REASON_CRASH_LOOP,
+    REASON_WRONG_WORKER,
 )
 
 # ---- terminal request outcomes (journal / response records) ---------------
@@ -88,12 +90,18 @@ class Request:
     # journal marker, response record, frame record and trace span the
     # request touches carries it
     trace: str = ""
+    # fleet failover flag (docs/SERVING.md §10): set by the controller
+    # when it re-stages a dead worker's journal entry on a survivor —
+    # the survivor's admission must accept it even though tenant
+    # affinity would normally route the tenant elsewhere
+    handoff: bool = False
 
     def to_dict(self) -> dict:
         return {
             "id": self.id, "tenant": self.tenant,
             "time_range": self.time_range, "deadline_s": self.deadline_s,
             "submitted_unix": self.submitted_unix, "trace": self.trace,
+            "handoff": self.handoff,
         }
 
 
@@ -119,7 +127,7 @@ def parse_request(payload, *, default_deadline_s: Optional[float] = None
         )
     unknown = set(payload) - {
         "id", "tenant", "time_range", "deadline_s", "submitted_unix",
-        "trace",
+        "trace", "handoff",
     }
     if unknown:
         raise RequestError(
@@ -169,7 +177,11 @@ def parse_request(payload, *, default_deadline_s: Optional[float] = None
             "Request field 'trace' must be 1-128 characters of "
             "[A-Za-z0-9._-]."
         )
+    handoff = payload.get("handoff", False)
+    if not isinstance(handoff, bool):
+        raise RequestError("Request field 'handoff' must be a boolean.")
     return Request(
         id=req_id, tenant=tenant, time_range=time_range,
         deadline_s=deadline_s, submitted_unix=submitted, trace=trace_id,
+        handoff=handoff,
     )

@@ -48,7 +48,11 @@ from sartsolver_tpu.engine.admission import AdmissionController
 from sartsolver_tpu.engine.journal import RequestJournal
 from sartsolver_tpu.engine.protocol import needs_republish, uncounted_completed
 from sartsolver_tpu.engine.request import Request, RequestError, parse_request
-from sartsolver_tpu.engine.session import ResidentSession, absolute_deadline
+from sartsolver_tpu.engine.session import (
+    ResidentSession,
+    SessionCache,
+    absolute_deadline,
+)
 from sartsolver_tpu.obs import metrics as obs_metrics
 from sartsolver_tpu.obs import trace as obs_trace
 from sartsolver_tpu.resilience import shutdown, watchdog
@@ -74,12 +78,16 @@ class _ActiveRequest:
 
     __slots__ = ("req", "deadline", "expected", "got", "by_status",
                  "writer", "t_dispatch", "deadline_missed", "output",
-                 "t_accepted")
+                 "t_accepted", "session")
 
     def __init__(self, req: Request, expected: int,
                  deadline: Optional[float], output: str,
-                 t_accepted: Optional[float] = None):
+                 t_accepted: Optional[float] = None, session=None):
         self.req = req
+        # the leased ResidentSession this request solved on (None for
+        # pre-attach finishes) — _finish must flush the writer against
+        # the SAME session, not whatever the cache holds by then
+        self.session = session
         self.deadline = deadline
         self.expected = int(expected)
         self.got = 0
@@ -119,19 +127,35 @@ class EngineServer:
         journal_rotate_bytes: int = 64 * 2 ** 20,
         response_ttl_s: float = 7 * 86400.0,
         trace_ttl_s: float = 86400.0,
+        responses_dir: Optional[str] = None,
+        outputs_dir: Optional[str] = None,
     ):
         if lanes < 1:
             raise ValueError("lanes must be >= 1.")
+        # ``session`` may be a plain ResidentSession or a SessionCache
+        # (multi-session residency, docs/SERVING.md §10); the cache is
+        # leased per solve cycle, never touched at construction time
         self.session = session
+        self._session_cache = (session if isinstance(session, SessionCache)
+                               else None)
         self.engine_dir = engine_dir
         self.ingest_dir = os.path.join(engine_dir, "ingest")
-        self.outputs_dir = os.path.join(engine_dir, "outputs")
-        self.responses_dir = os.path.join(engine_dir, "responses")  # durable: response
+        # fleet mode points every worker at SHARED responses/outputs
+        # dirs (one poll surface for clients regardless of failover);
+        # standalone serve keeps them under the engine dir
+        self.outputs_dir = outputs_dir or os.path.join(engine_dir, "outputs")
+        self.responses_dir = (responses_dir or
+                              os.path.join(engine_dir, "responses"))  # durable: response
         for d in (engine_dir, self.ingest_dir, self.outputs_dir,
                   self.responses_dir):
             os.makedirs(d, exist_ok=True)
         self.journal = RequestJournal(os.path.join(engine_dir,
                                                    "journal.jsonl"))
+        if self._session_cache is not None \
+                and self._session_cache._on_event is None:
+            # cache attach/evict events land in the journal (audit
+            # record; replay skips them) and the event stream
+            self._session_cache._on_event = self._cache_event
         # durable soft state (docs/SERVING.md §9): tenant quarantine,
         # lane ladder, SLO counters, dedup watermark — restored in run()
         from sartsolver_tpu.engine.state import StateStore
@@ -223,6 +247,26 @@ class EngineServer:
         if self.telemetry is not None:
             self.telemetry.record_event(message)
         print(f"sartsolve engine: {message}", flush=True)
+
+    def _cache_event(self, kind: str, **data) -> None:
+        """Session-cache attach/evict sink: one journal audit marker
+        (replay skips it, compaction drops it) + one event line."""
+        key = data.pop("key", "default")
+        try:
+            with self._lock:
+                self.journal.session_event(kind, key, **data)
+        except OSError as err:
+            self._event(f"session journal marker failed: {err}")
+        detail = " ".join(f"{k}={v}" for k, v in sorted(data.items()))
+        self._event(f"{kind}: key={key}{' ' + detail if detail else ''}")
+
+    def _lease_session(self, req: Request):
+        """The ResidentSession this request solves on: the cache lease
+        (attach-or-build under the byte budget) in fleet/cache mode, the
+        one resident session otherwise."""
+        if self._session_cache is not None:
+            return self._session_cache.lease(req)
+        return self.session
 
     def _requests_ctr(self, outcome: str):
         ctr = self._requests_ctrs.get(outcome)
@@ -679,7 +723,19 @@ class EngineServer:
     # ---- replay ----------------------------------------------------------
 
     def _replay(self) -> None:
-        completed, pending = self.journal.replay()
+        completed, pending, handed_off = self.journal.replay_full()
+        # a handed-off id is now another worker's story: this worker
+        # must neither re-drive it (replay_full already excludes it
+        # from pending) nor re-admit a resubmission of it — the
+        # survivor owns the response, and a second acceptance here
+        # would break exactly-once fleet-wide
+        for rid in handed_off:
+            self.admission.note_seen(rid)
+        if handed_off:
+            self._event(
+                f"journal replay: {len(handed_off)} handed-off "
+                "request(s) pinned as duplicates (survivor owns them)"
+            )
         for rid, outcome in completed.items():
             self.admission.note_seen(rid)
             # the republish gate lives in engine/protocol.py next to
@@ -758,11 +814,12 @@ class EngineServer:
                 error: Optional[str] = None) -> None:
         trace_id = ar.req.trace
         if ar.writer is not None:
+            sess = ar.session if ar.session is not None else self.session
             self._set_span(ar.req, "io.write")
             with obs_trace.request_span(trace_id, "io.write",
                                         frames=ar.got):
                 ar.writer.flush()
-                self.session.grid.write_hdf5(ar.output, "voxel_map")
+                sess.grid.write_hdf5(ar.output, "voxel_map")
         wall = time.perf_counter() - ar.t_dispatch
         self._solve_hist.observe(wall)
         latency = time.monotonic() - ar.t_accepted
@@ -896,15 +953,20 @@ class EngineServer:
             try:
                 with obs_trace.request_span(req.trace, "session.attach",
                                             time_range=req.time_range):
-                    image = self.session.attach(req)
+                    # cache mode: attach-or-build under the byte budget
+                    # (a build failure fails THIS request, like a torn
+                    # attach — the engine keeps serving)
+                    sess = self._lease_session(req)
+                    image = sess.attach(req)
             except (SartInputError,) + RECOVERABLE_FRAME_ERRORS as err:
                 ar = _ActiveRequest(req, 0, deadline, output,
                                     t_accepted=t_acc)
                 self._finish(ar, reqmod.REQ_FAILED,
                              error=f"{type(err).__name__}: {err}")
                 continue
-            ar = _ActiveRequest(req, self.session.n_frames(image),
-                                deadline, output, t_accepted=t_acc)
+            ar = _ActiveRequest(req, sess.n_frames(image),
+                                deadline, output, t_accepted=t_acc,
+                                session=sess)
             self._active_ids.append(req.id)
             if ar.expected == 0:
                 self._finish(ar, reqmod.REQ_COMPLETED)
@@ -912,12 +974,18 @@ class EngineServer:
             self._set_span(req, "solve")
             active.append(ar)
             route.extend([ar] * ar.expected)
-            gens.append(self.session.frame_items(image, deadline,
-                                                 trace_id=req.trace))
+            gens.append(sess.frame_items(image, deadline,
+                                         trace_id=req.trace))
         if not active:
             return
 
-        nvoxel = self.session.nvoxel
+        # one batcher run per cycle, on the cycle's LAST leased session:
+        # a batch shares one cache key under the default keying, and a
+        # forced mid-batch eviction rebuilds the same key — the
+        # deterministic frame solve keeps outputs byte-identical across
+        # that churn (the eviction drill's assertion)
+        session = active[-1].session or self.session
+        nvoxel = session.nvoxel
 
         def add_row(ar: _ActiveRequest, row, status: int, ftime,
                     cam_times, iterations: int) -> None:
@@ -925,7 +993,7 @@ class EngineServer:
                 from sartsolver_tpu.io.solution import SolutionWriter
 
                 ar.writer = SolutionWriter(
-                    ar.output, self.session.camera_names, nvoxel,
+                    ar.output, session.camera_names, nvoxel,
                 )
             ar.writer.add(row, status, ftime, cam_times,
                           iterations=iterations)
@@ -967,7 +1035,7 @@ class EngineServer:
         interrupted = False
         while True:
             batcher = ContinuousBatcher(
-                self.session.solver, lanes=self.lanes,
+                session.solver, lanes=self.lanes,
                 on_result=on_result, on_failed=on_failed,
                 stop_check=shutdown.stop_requested,
                 on_event=self._event, isolate=True,
@@ -1124,6 +1192,8 @@ class EngineServer:
             # too (queued-but-undispatched work stays journaled; its
             # tenants' state must survive into the next serve)
             self._save_state()
+            if self._session_cache is not None:
+                self._session_cache.close()
         return exit_code
 
     # ---- live pull endpoint (--http_port) --------------------------------

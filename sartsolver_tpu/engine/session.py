@@ -17,8 +17,10 @@ run, whatever order or lane assignment the scheduler picks.
 
 from __future__ import annotations
 
+import os
 import sys
-from typing import Iterator, List, Optional, Tuple
+from collections import OrderedDict
+from typing import Callable, Iterator, List, Optional, Tuple
 
 import numpy as np
 
@@ -34,7 +36,8 @@ class ResidentSession:
     def __init__(self, *, solver, grid, opts: SolverOptions,
                  camera_names: List[str], sorted_image_files,
                  rtm_frame_masks, npixel: int, nvoxel: int,
-                 max_cached_frames: int = 100):
+                 max_cached_frames: int = 100,
+                 mesh_shape: Optional[Tuple[int, int]] = None):
         self.solver = solver
         self.grid = grid
         self.opts = opts
@@ -44,6 +47,7 @@ class ResidentSession:
         self.npixel = int(npixel)
         self.nvoxel = int(nvoxel)
         self.max_cached_frames = int(max_cached_frames)
+        self.mesh_shape = tuple(mesh_shape) if mesh_shape else None
 
     # ---- construction ----------------------------------------------------
 
@@ -188,6 +192,7 @@ class ResidentSession:
             rtm_frame_masks=rtm_frame_masks,
             npixel=npixel, nvoxel=nvoxel,
             max_cached_frames=args.max_cached_frames,
+            mesh_shape=(n_pix, n_vox),
         )
 
     # ---- per-request attachment ------------------------------------------
@@ -256,6 +261,190 @@ class ResidentSession:
             close()
 
 
+# ---------------------------------------------------------------------------
+# multi-session residency (docs/SERVING.md §10)
+# ---------------------------------------------------------------------------
+
+
+def session_key(npixel: int, nvoxel: int, dtype, mesh_shape) -> str:
+    """The one-compiled-program cache key: two sessions share compiled
+    lane programs exactly when shapes, dtype and mesh shape agree
+    (docs/PERFORMANCE.md §8) — so that is what the session cache keys
+    on too."""
+    mesh = "x".join(str(int(m)) for m in (mesh_shape or ()))
+    return f"{int(npixel)}x{int(nvoxel)}:{dtype}:{mesh or '-'}"
+
+
+def key_of(session) -> str:
+    """:func:`session_key` for a built session object."""
+    opts = getattr(session, "opts", None)
+    dtype = getattr(opts, "rtm_dtype", None) or getattr(
+        opts, "dtype", "unknown")
+    return session_key(session.npixel, session.nvoxel, dtype,
+                       getattr(session, "mesh_shape", None))
+
+
+def session_nbytes(session) -> int:
+    """Resident footprint estimate, dominated by the sharded RTM:
+    ``npixel * nvoxel * itemsize``. A session may pin its own number
+    via an ``nbytes`` attribute (test stubs do)."""
+    explicit = getattr(session, "nbytes", None)
+    if explicit is not None:
+        return int(explicit() if callable(explicit) else explicit)
+    opts = getattr(session, "opts", None)
+    try:
+        item = np.dtype(
+            getattr(opts, "rtm_dtype", None) or getattr(opts, "dtype", None)
+        ).itemsize
+    except TypeError:
+        item = 4
+    return int(session.npixel) * int(session.nvoxel) * int(item)
+
+
+class SessionCache:
+    """Byte-budgeted LRU of warm :class:`ResidentSession` entries.
+
+    One worker serves a tenant population: each distinct
+    :func:`session_key` — the same ``(shape, dtype, mesh)`` tuple that
+    pins the one-compiled-program contract — holds at most one warm
+    ``(RTM, mesh, compiled lane programs)`` entry. ``SART_SESSION_BYTES``
+    bounds the resident total; building past the budget evicts
+    least-recently-attached entries (closing their solvers) until the
+    new entry fits. A rebuilt entry with a previously-seen key re-enters
+    jax's in-process jit cache, so its lane programs come back without a
+    re-trace (counted in ``session_cache_compile_reuse_total``).
+
+    Counters (deliberately NOT ``engine_``-prefixed: cache state dies
+    with the process, so the metrics must reset with the cold cache
+    instead of riding the state checkpoint):
+    ``session_cache_{hits,misses,evictions}_total`` and the
+    ``session_resident_bytes`` gauge.
+
+    ``SART_TEST_EVICT_EVERY=N`` (test hook) force-evicts the target
+    entry every Nth attach, making every Nth request pay a full
+    rebuild — byte-identity of the solutions across that churn is the
+    eviction-correctness drill's whole assertion.
+    """
+
+    DEFAULT_BYTES = 2 * 2**30
+
+    def __init__(self, builder: Callable[[str], "ResidentSession"], *,
+                 byte_budget: Optional[int] = None,
+                 key_for: Optional[Callable] = None,
+                 on_event: Optional[Callable] = None):
+        self._builder = builder
+        if byte_budget is None:
+            byte_budget = int(
+                os.environ.get("SART_SESSION_BYTES")
+                or self.DEFAULT_BYTES)
+        self.byte_budget = int(byte_budget)
+        self._key_for = key_for
+        self._on_event = on_event
+        self._entries: "OrderedDict[str, object]" = OrderedDict()
+        self._built_keys: set = set()
+        self._attaches = 0
+        self._evict_every = int(
+            os.environ.get("SART_TEST_EVICT_EVERY") or 0)
+
+    # ---- bookkeeping -----------------------------------------------------
+
+    def _registry(self):
+        from sartsolver_tpu.obs import metrics as obs_metrics
+
+        return obs_metrics.get_registry()
+
+    def _emit(self, kind: str, **data) -> None:
+        if self._on_event is not None:
+            self._on_event(kind, **data)
+
+    def _update_gauge(self) -> None:
+        self._registry().gauge("session_resident_bytes").set(
+            float(self.resident_bytes()))
+
+    def resident_bytes(self) -> int:
+        return sum(session_nbytes(s) for s in self._entries.values())
+
+    def keys(self) -> List[str]:
+        return list(self._entries)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ---- the cache proper ------------------------------------------------
+
+    def key_for(self, request: Request) -> str:
+        """The request's session key. With one RTM resident per worker
+        (the serve CLI today) every tenant maps to the default key; a
+        ``key_for`` hook routes tenants onto their own RTMs."""
+        if self._key_for is not None:
+            return str(self._key_for(request))
+        return "default"
+
+    def get(self, key: str = "default"):
+        """The keyed warm session, building (and budget-evicting) on a
+        miss. LRU order is attach order — ``get`` touches."""
+        reg = self._registry()
+        sess = self._entries.get(key)
+        if sess is not None:
+            reg.counter("session_cache_hits_total").inc()
+            self._entries.move_to_end(key)
+            return sess
+        reg.counter("session_cache_misses_total").inc()
+        if key in self._built_keys:
+            reg.counter("session_cache_compile_reuse_total").inc()
+        sess = self._builder(key)
+        self._entries[key] = sess
+        self._built_keys.add(key)
+        self._emit("session-attach", key=key,
+                   bytes=session_nbytes(sess))
+        self._shrink_to_budget(protect=key)
+        self._update_gauge()
+        return sess
+
+    def seed(self, key: str, session) -> None:
+        """Pre-warm an entry built OUTSIDE the cache: serve startup
+        builds the default session eagerly so flag/input errors surface
+        before the first request ever arrives."""
+        self._entries[key] = session
+        self._built_keys.add(key)
+        self._update_gauge()
+
+    def lease(self, request: Request):
+        """Per-request entry point: resolve the request's session,
+        honoring the forced-eviction test hook."""
+        self._attaches += 1
+        key = self.key_for(request)
+        if self._evict_every and self._attaches % self._evict_every == 0:
+            self.evict(key, reason="test-forced")
+        return self.get(key)
+
+    def evict(self, key: str, *, reason: str = "budget") -> bool:
+        sess = self._entries.pop(key, None)
+        if sess is None:
+            return False
+        self._registry().counter("session_cache_evictions_total").inc()
+        self._emit("session-evict", key=key, reason=reason,
+                   bytes=session_nbytes(sess))
+        close = getattr(sess, "close", None)
+        if close is not None:
+            close()
+        self._update_gauge()
+        return True
+
+    def _shrink_to_budget(self, protect: str) -> None:
+        # never evict the entry just built: a single session larger
+        # than the budget stays resident alone rather than thrashing
+        while (self.byte_budget > 0
+               and self.resident_bytes() > self.byte_budget
+               and len(self._entries) > 1):
+            victim = next(k for k in self._entries if k != protect)
+            self.evict(victim, reason="budget")
+
+    def close(self) -> None:
+        for key in list(self._entries):
+            self.evict(key, reason="shutdown")
+
+
 def absolute_deadline(request: Request,
                       accepted_monotonic: float) -> Optional[float]:
     """A request's absolute ``time.monotonic()`` deadline, anchored at
@@ -266,4 +455,7 @@ def absolute_deadline(request: Request,
     return accepted_monotonic + float(request.deadline_s)
 
 
-__all__ = ["ResidentSession", "absolute_deadline"]
+__all__ = [
+    "ResidentSession", "SessionCache", "absolute_deadline",
+    "session_key", "key_of", "session_nbytes",
+]

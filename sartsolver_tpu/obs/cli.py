@@ -188,6 +188,26 @@ def summarize(records: List[dict]) -> dict:
                 "breaches": slo_breach,
                 "burn_rate": slo_breach / total,
             }
+        # session-cache residency (docs/SERVING.md §10): the hit rate
+        # is the multi-session contract's headline — a drop means the
+        # byte budget is thrashing (evict/rebuild churn eats the warm-
+        # session latency win). Summed across label sets so fleet
+        # artifacts (worker=... labels) roll up like the SLO pair.
+        cache_hits = sum(v for k, v in out["counters"].items()
+                         if k.startswith("session_cache_hits_total"))
+        cache_misses = sum(v for k, v in out["counters"].items()
+                           if k.startswith("session_cache_misses_total"))
+        if cache_hits or cache_misses:
+            out["engine"]["session_cache"] = {
+                "hits": cache_hits,
+                "misses": cache_misses,
+                "evictions": sum(
+                    v for k, v in out["counters"].items()
+                    if k.startswith("session_cache_evictions_total")),
+                "hit_rate": cache_hits / (cache_hits + cache_misses),
+                "resident_bytes": out["gauges"].get(
+                    "session_resident_bytes"),
+            }
     if bench:
         out["bench"] = {
             "metric": bench[0]["metric"], "value": bench[0]["value"],
@@ -485,6 +505,18 @@ def diff(old: dict, new: dict) -> dict:
         burn_pts = 100.0 * (b - a)
         out["engine_slo_burn"] = {"old": a, "new": b}
     out["engine_slo_burn_pts"] = burn_pts
+    # session-cache hit rate, compared in percentage points with DROP
+    # as the regression direction (positive = worse, matching the other
+    # point gates): a thrashing cache rebuilds sessions it just evicted
+    cache_pts = None
+    a = ((old.get("engine") or {}).get("session_cache")
+         or {}).get("hit_rate")
+    b = ((new.get("engine") or {}).get("session_cache")
+         or {}).get("hit_rate")
+    if a is not None and b is not None:
+        cache_pts = 100.0 * (a - b)
+        out["engine_cache_hit"] = {"old": a, "new": b}
+    out["engine_cache_hit_drop_pts"] = cache_pts
     # roofline utilization (bench detail.roofline, obs/roofline.py):
     # achieved-vs-peak MXU / HBM fractions are rates — a drop past the
     # threshold is a regression, independently of the raw headline
@@ -546,6 +578,13 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
             notes.append(f"SLO accounting missing from the {side} "
                          "artifact (--slo_ms unset?) — the error-budget "
                          "burn comparison skipped")
+        if (("session_cache" in old["engine"])
+                != ("session_cache" in new["engine"])):
+            side = ("baseline" if "session_cache" in new["engine"]
+                    else "new")
+            notes.append(f"session-cache counters missing from the "
+                         f"{side} artifact (pre-multi-session engine?) "
+                         "— the cache hit-rate comparison skipped")
     zero_checks = [
         ("bench", "value", "bench headline value"),
         ("straggler", "occ_frame_iter_s", "straggler occ frame-iter/s"),
@@ -700,6 +739,12 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                 print(f"  engine SLO burn rate: {d['old']:g} -> "
                       f"{d['new']:g} "
                       f"({delta['engine_slo_burn_pts']:+.1f} pts)")
+            if delta["engine_cache_hit_drop_pts"] is not None:
+                d = delta["engine_cache_hit"]
+                print(f"  engine session-cache hit rate: {d['old']:g} "
+                      f"-> {d['new']:g} "
+                      f"({-delta['engine_cache_hit_drop_pts']:+.1f} "
+                      "pts)")
         # a gate that did not run must say so — an artifact missing its
         # bench section, a zero baseline — never silently pass
         for note in delta.get("notes", ()):
@@ -813,6 +858,16 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['engine_slo_burn_pts']:+.1f} percentage "
                       f"points, exceeding the {args.threshold:g}-point "
                       "threshold.", file=sys.stderr)
+                return 2
+            if (delta["engine_cache_hit_drop_pts"] is not None
+                    and delta["engine_cache_hit_drop_pts"]
+                    > args.threshold):
+                print(f"sartsolve metrics: engine session-cache hit "
+                      f"rate dropped "
+                      f"{delta['engine_cache_hit_drop_pts']:+.1f} "
+                      f"percentage points, exceeding the "
+                      f"{args.threshold:g}-point threshold.",
+                      file=sys.stderr)
                 return 2
         return 0
 

@@ -32,6 +32,7 @@ status dump, a hang is not.
 from __future__ import annotations
 
 import math
+import os
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from sartsolver_tpu.utils.locking import named_lock, stale_read
@@ -286,12 +287,20 @@ _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 class MetricsRegistry:
     """Thread-safe, insertion-ordered instrument store."""
 
-    def __init__(self) -> None:
+    def __init__(self, default_labels: Optional[Dict[str, str]] = None
+                 ) -> None:
         self._lock = named_lock("obs.metrics.registry")
         # dict preserves insertion order — the snapshot/summary ordering
         self._instruments: Dict[Tuple[str, str, tuple], _Instrument] = {}  # guarded by: self._lock
+        # folded into EVERY instrument's labels (explicit labels win):
+        # fleet workers get their worker= identity here so one scrape of
+        # merged worker registries stays attributable per shard
+        self._default_labels = {str(k): str(v)
+                                for k, v in (default_labels or {}).items()}
 
     def _get(self, cls, name: str, labels: Dict[str, str]) -> _Instrument:
+        if self._default_labels:
+            labels = {**self._default_labels, **labels}
         key = (cls.kind, name, _label_key(labels))
         # double-checked fast path: a dict get is GIL-atomic, and a miss
         # re-checks under the lock before inserting
@@ -362,10 +371,20 @@ class MetricsRegistry:
                 inst.merge(snap)
 
 
+def _env_default_labels() -> Dict[str, str]:
+    """Fleet worker identity: ``SART_WORKER_ID`` (set by the fleet
+    controller on each spawned worker) labels every instrument with
+    ``worker=`` so per-worker series stay distinguishable when scraped
+    or folded fleet-wide. Unset (standalone serve, tests, bench
+    baselines) adds nothing — series names stay byte-stable."""
+    worker = os.environ.get("SART_WORKER_ID")
+    return {"worker": worker} if worker else {}
+
+
 # Process-wide default registry. The CLI resets it at the start of every
 # run (like reset_retry_stats) so artifacts account one run, not the
 # process lifetime; library modules grab handles from it lazily.
-_default = MetricsRegistry()
+_default = MetricsRegistry(default_labels=_env_default_labels())
 _default_lock = named_lock("obs.metrics.default")
 
 
@@ -381,5 +400,5 @@ def reset_registry() -> MetricsRegistry:
     handles after the CLI's reset."""
     global _default
     with _default_lock:
-        _default = MetricsRegistry()
+        _default = MetricsRegistry(default_labels=_env_default_labels())
     return _default

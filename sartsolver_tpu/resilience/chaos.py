@@ -433,6 +433,330 @@ class ChaosCampaign:
 
 
 # ---------------------------------------------------------------------------
+# fleet campaign (docs/SERVING.md §10)
+# ---------------------------------------------------------------------------
+
+# fleet workers tag their window announcements (journal.py) so the kill
+# plan can target the SPECIFIC worker sleeping inside the window
+_WINDOW_WORKER_RE = re.compile(r"SART_JOURNAL_POINT (\S+) worker=w(\d+)")
+_SPAWN_WORKER_RE = re.compile(
+    r"worker-spawn pid=(\d+) spawn=\d+ worker=(\d+)")
+
+
+class FleetSchedule:
+    """One seed's fleet campaign: SIGKILL one of M workers inside a
+    journal commit window, optionally SIGKILL the whole node (controller
+    + workers) while the controller sleeps inside its handoff-marker
+    append, with forced session evictions armed throughout."""
+
+    WINDOWS = ("accepted", "dispatched", "pre-flush")
+
+    def __init__(self, seed: int, *, size: int = 3):
+        self.seed = int(seed)
+        self.size = max(2, int(size))
+        rng = np.random.default_rng([0x5A48, self.seed])
+        self.window = self.WINDOWS[int(rng.integers(0,
+                                                    len(self.WINDOWS)))]
+        self.occurrence = int(rng.integers(1, 3))
+        self.kill_controller_in_handoff = bool(rng.integers(0, 2))
+        # fixed, not drawn: with >= 2*size requests some worker
+        # incarnation must lease twice (pigeonhole), so every-2nd-lease
+        # eviction provably fires — the campaign's eviction-under-load
+        # leg can assert it happened instead of hoping
+        self.evict_every = 2
+
+    def describe(self) -> dict:
+        return {"seed": self.seed,
+                "window": f"{self.window}#{self.occurrence}",
+                "controller_kill": self.kill_controller_in_handoff,
+                "evict_every": self.evict_every}
+
+
+class FleetCampaign(ChaosCampaign):
+    """Reference pass (undisturbed single serve) + fleet passes:
+    ``sartsolve fleet`` with M workers under seeded worker/controller
+    SIGKILLs and forced session evictions, judged on the same
+    invariants — fleet-wide: exactly one completed marker per id across
+    ALL worker journals, done responses in the SHARED responses dir,
+    byte-identical outputs, counter continuity summed across every
+    worker's state checkpoint."""
+
+    def __init__(self, *, size: int = 3, **kwargs):
+        super().__init__(**kwargs)
+        self.size = max(2, int(size))
+
+    def _fleet_cmd(self, fleet_dir: str) -> List[str]:
+        worker = ["--poll_interval", "0.05", "--idle_exit", "3.0",
+                  "--journal_rotate_bytes", "0"]
+        if self.slo_ms is not None:
+            worker += ["--slo_ms", str(self.slo_ms)]
+        return [sys.executable, "-m", "sartsolver_tpu.cli", "fleet",
+                "--fleet_dir", fleet_dir, "--size", str(self.size),
+                "--restart_backoff", "0.05",
+                "--restart_backoff_max", "0.5",
+                "--poll_interval", "0.05",
+                "--"] + worker + self.serve_args
+
+    def run_fleet_seed(self, schedule: FleetSchedule) -> dict:
+        fleet_dir = os.path.join(self.root, f"fleet{schedule.seed}")
+        os.makedirs(os.path.join(fleet_dir, "ingest"), exist_ok=True)
+        # requests go through the controller intake: tenant-affinity
+        # routing distributes them across the worker shards
+        _stage_requests(fleet_dir, self.requests)
+        env = self._env({
+            "SART_TEST_JOURNAL_DELAY": "0.25",
+            "SART_TEST_EVICT_EVERY": str(schedule.evict_every),
+        })
+        self.say(f"chaos: fleet seed {schedule.seed} "
+                 f"{schedule.describe()}")
+        cmd = self._fleet_cmd(fleet_dir)
+        pids: Dict[int, int] = {}
+        lines: List[str] = []
+        kills_fired = 0
+        controller_kills = 0
+        relaunches = 0
+        worker_kill_pending = True
+        count = 0
+        launch = 0
+        while True:
+            launch += 1
+            proc = subprocess.Popen(cmd, env=env,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+            guard = threading.Timer(self.timeout, proc.kill)
+            guard.start()
+            try:
+                for line in proc.stdout:
+                    lines.append(line)
+                    m = _SPAWN_WORKER_RE.search(line)
+                    if m:
+                        pids[int(m.group(2))] = int(m.group(1))
+                        continue
+                    if (schedule.kill_controller_in_handoff
+                            and controller_kills == 0
+                            and line_window(line) == "handoff"):
+                        # only the controller appends handoff markers:
+                        # it is sleeping inside the append — the marker
+                        # is durable, the re-staged payload is NOT. The
+                        # node-crash model takes out the controller AND
+                        # every worker; recovery on relaunch must
+                        # re-stage via the needs_restage gate.
+                        for pid in [proc.pid] + list(pids.values()):
+                            try:
+                                os.kill(pid, signal.SIGKILL)
+                            except OSError:
+                                pass
+                        controller_kills += 1
+                        self.say(f"chaos: fleet seed {schedule.seed} "
+                                 "SIGKILL controller (+workers) in "
+                                 "handoff window")
+                        continue
+                    if not worker_kill_pending:
+                        continue
+                    m = _WINDOW_WORKER_RE.search(line)
+                    if not m or m.group(1) != schedule.window:
+                        continue
+                    count += 1
+                    if count < schedule.occurrence:
+                        continue
+                    victim = int(m.group(2))
+                    pid = pids.get(victim)
+                    if pid is not None:
+                        try:
+                            os.kill(pid, signal.SIGKILL)
+                            kills_fired += 1
+                            self.say(f"chaos: fleet seed "
+                                     f"{schedule.seed} SIGKILL worker "
+                                     f"{victim} pid={pid} in window "
+                                     f"{schedule.window}"
+                                     f"#{schedule.occurrence}")
+                        except OSError:
+                            pass
+                    worker_kill_pending = False
+                rc = proc.wait(timeout=self.timeout)
+            finally:
+                guard.cancel()
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=30)
+            if controller_kills and relaunches == 0:
+                relaunches += 1
+                self.say(f"chaos: fleet seed {schedule.seed} "
+                         "relaunching after controller kill")
+                continue
+            break
+        text = "".join(lines)
+        if rc != 0:
+            for pid in pids.values():  # no stray workers past a failure
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            raise CampaignError(
+                f"fleet seed {schedule.seed}: controller exited {rc} "
+                f"(expected 0)\n{text[-6000:]}"
+            )
+        verdict = self._judge_fleet(fleet_dir, schedule, kills_fired,
+                                    controller_kills, text)
+        verdict["exit"] = rc
+        return verdict
+
+    # ---- fleet-wide invariants -------------------------------------------
+
+    def _judge_fleet(self, fleet_dir: str, schedule: FleetSchedule,
+                     kills_fired: int, controller_kills: int,
+                     text: str) -> dict:
+        from sartsolver_tpu.engine.journal import RequestJournal
+        from sartsolver_tpu.engine.state import StateStore
+
+        ids = [r["id"] for r in self.requests]
+        marks: Dict[str, int] = {}
+        completed_union: Dict[str, dict] = {}
+        pending_ids: List[str] = []
+        evictions = 0
+        for k in range(self.size):
+            jpath = os.path.join(fleet_dir, "workers", f"w{k}",
+                                 "journal.jsonl")
+            completed, pending, _handed = \
+                RequestJournal(jpath).replay_full()
+            completed_union.update(completed)
+            pending_ids += [req.id for req in pending]
+            try:
+                f = open(jpath)
+            except OSError:
+                continue
+            with f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("marker") == "completed":
+                        marks[rec["id"]] = marks.get(rec["id"], 0) + 1
+                    elif (rec.get("marker") == "session"
+                          and rec.get("event") == "session-evict"
+                          and rec.get("reason") == "test-forced"):
+                        evictions += 1
+        # 1a. fleet-wide completion: every request completed SOMEWHERE,
+        # none left pending on any shard
+        if set(completed_union) != set(ids) or pending_ids:
+            raise CampaignError(
+                f"fleet seed {schedule.seed}: completed="
+                f"{sorted(completed_union)} pending={pending_ids}, "
+                f"expected all of {ids}"
+            )
+        # 1b. exactly once fleet-wide: ONE completed marker per id
+        # across every worker journal (a handoff that double-drove a
+        # request shows up as two markers on two shards)
+        doubled = {rid: n for rid, n in marks.items() if n != 1}
+        if doubled:
+            raise CampaignError(
+                f"fleet seed {schedule.seed}: completed-marker counts "
+                f"!= 1 across the fleet: {doubled} (a request was lost "
+                "or double-solved)"
+            )
+        # 1c. done response per id in the SHARED responses dir, status
+        # matching the undisturbed reference
+        for rid in ids:
+            resp = self._response(fleet_dir, rid)
+            if not resp or resp.get("state") != "done":
+                raise CampaignError(
+                    f"fleet seed {schedule.seed}: no done response "
+                    f"for {rid!r}"
+                )
+            status = (resp.get("outcome") or {}).get("status")
+            want = self.reference[rid]["status"]
+            if status != want:
+                raise CampaignError(
+                    f"fleet seed {schedule.seed}: {rid!r} ended "
+                    f"{status!r}, reference says {want!r}"
+                )
+        # 2. byte-identical outputs (shared outputs dir) — this is also
+        # the eviction-correctness gate: a rebuilt session that solved
+        # differently, or a handoff re-drive that lost frames, breaks it
+        for rid in ids:
+            got = _solution_datasets(
+                os.path.join(fleet_dir, "outputs", f"{rid}.h5")
+            )
+            ref = self.reference[rid]["datasets"]
+            if sorted(got) != sorted(ref):
+                raise CampaignError(
+                    f"fleet seed {schedule.seed}: {rid!r} dataset set "
+                    "differs"
+                )
+            for key in sorted(ref):
+                if not np.array_equal(got[key], ref[key]):
+                    raise CampaignError(
+                        f"fleet seed {schedule.seed}: {rid!r} "
+                        f"solution/{key} not byte-identical to the "
+                        "undisturbed run"
+                    )
+        # 3. bounded unavailability: each worker SIGKILL costs at most
+        # one controller-observed crash (node kills die WITH the
+        # controller and are respawns, not crashes)
+        restarts = text.count("fleet: worker-crash code=")
+        if restarts > kills_fired:
+            raise CampaignError(
+                f"fleet seed {schedule.seed}: {restarts} worker "
+                f"crash(es) for {kills_fired} scheduled kill(s) — "
+                "workers are crashing on their own"
+            )
+        # 4. counter continuity fleet-wide: summed across every
+        # worker's state checkpoint, the cumulative totals account
+        # each request exactly once — across kills, handoffs and a
+        # controller relaunch
+        totals: Dict[str, float] = {}
+        slo_total = 0.0
+        for k in range(self.size):
+            payload = StateStore(os.path.join(
+                fleet_dir, "workers", f"w{k}", "state.jsonl")).load()
+            for snap in (payload or {}).get("metrics") or []:
+                if snap.get("kind") != "counter":
+                    continue
+                name = snap.get("name")
+                if name == "engine_requests_total":
+                    outcome = (snap.get("labels") or {}).get(
+                        "outcome", "?")
+                    totals[outcome] = totals.get(outcome, 0) \
+                        + float(snap.get("value", 0))
+                elif name in ("engine_slo_ok_total",
+                              "engine_slo_breach_total"):
+                    slo_total += float(snap.get("value", 0))
+        if sum(totals.values()) != len(ids):
+            raise CampaignError(
+                f"fleet seed {schedule.seed}: fleet-summed "
+                f"engine_requests_total={totals} does not account "
+                f"{len(ids)} request(s) exactly once"
+            )
+        if self.slo_ms is not None and slo_total != len(ids):
+            raise CampaignError(
+                f"fleet seed {schedule.seed}: fleet-summed SLO "
+                f"ok+breach={slo_total:g} for {len(ids)} request(s)"
+            )
+        # 5. the eviction leg is not vacuous: forced evictions fired
+        # (byte-identity above proves they were harmless)
+        if evictions == 0:
+            raise CampaignError(
+                f"fleet seed {schedule.seed}: SART_TEST_EVICT_EVERY="
+                f"{schedule.evict_every} armed but no forced eviction "
+                "fired — the eviction-under-load leg ran vacuously"
+            )
+        return {
+            **schedule.describe(),
+            "kills_fired": kills_fired,
+            "controller_kills": controller_kills,
+            "restarts": restarts,
+            "evictions": evictions,
+            "requests": len(ids),
+            "requests_total": totals,
+            "verdict": "ok",
+        }
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -457,6 +781,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_kills", type=int, default=2,
                    help="Max SIGKILLs a seed's schedule may draw. "
                         "Default 2.")
+    p.add_argument("--fleet", type=int, default=0, metavar="M",
+                   help="Run fleet campaigns instead: each seed passes "
+                        "through `sartsolve fleet` with M workers under "
+                        "a seeded worker SIGKILL inside a commit "
+                        "window, an optional controller kill mid-"
+                        "handoff, and forced session evictions — "
+                        "judged fleet-wide (docs/SERVING.md §10). "
+                        "0 = single supervised engine (default).")
     p.add_argument("--slo_ms", type=float, default=None,
                    help="Arm the engine SLO pair and assert its burn "
                         "accounting is continuous across restarts.")
@@ -494,18 +826,50 @@ def chaos_main(argv: Optional[List[str]] = None) -> int:
         print("sartsolve chaos: need >=1 seed, >=1 request, >=1 kill.",
               file=sys.stderr)
         return 1
-    requests = [
-        {"id": f"chaos-{i}", "tenant": f"t{i % 2}"}
-        for i in range(args.requests)
-    ]
-    campaign = ChaosCampaign(
-        root=args.engine_dir, serve_args=serve_args, requests=requests,
-        slo_ms=args.slo_ms, timeout=args.timeout,
-    )
-    report = {"seeds": seeds, "requests": args.requests, "passes": []}
+    if args.fleet < 0 or args.fleet == 1:
+        print("sartsolve chaos: --fleet needs >= 2 workers (or 0 for "
+              "the single-engine campaign).", file=sys.stderr)
+        return 1
+    if args.fleet:
+        # >= 2*size requests with DISTINCT tenants: affinity spreads
+        # them across shards, and pigeonhole guarantees some worker
+        # incarnation leases twice so the forced-eviction leg fires
+        n_requests = max(args.requests, 2 * args.fleet + 2)
+        requests = [
+            {"id": f"chaos-{i}", "tenant": f"t{i}"}
+            for i in range(n_requests)
+        ]
+        campaign = FleetCampaign(
+            size=args.fleet, root=args.engine_dir,
+            serve_args=serve_args, requests=requests,
+            slo_ms=args.slo_ms, timeout=args.timeout,
+        )
+    else:
+        requests = [
+            {"id": f"chaos-{i}", "tenant": f"t{i % 2}"}
+            for i in range(args.requests)
+        ]
+        campaign = ChaosCampaign(
+            root=args.engine_dir, serve_args=serve_args,
+            requests=requests, slo_ms=args.slo_ms, timeout=args.timeout,
+        )
+    report = {"seeds": seeds, "requests": len(requests),
+              "fleet": args.fleet, "passes": []}
     try:
         campaign.run_reference()
         for seed in seeds:
+            if args.fleet:
+                verdict = campaign.run_fleet_seed(
+                    FleetSchedule(seed, size=args.fleet)
+                )
+                report["passes"].append(verdict)
+                print(f"chaos: fleet seed {seed} OK — "
+                      f"{verdict['kills_fired']} worker kill(s), "
+                      f"{verdict['controller_kills']} controller "
+                      f"kill(s), {verdict['evictions']} forced "
+                      f"eviction(s), {verdict['requests']} request(s) "
+                      "exactly once fleet-wide, outputs byte-identical")
+                continue
             schedule = FaultSchedule(seed, max_kills=args.max_kills)
             verdict = campaign.run_seed(schedule)
             report["passes"].append(verdict)
@@ -534,5 +898,6 @@ def chaos_main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
-__all__ = ["ChaosCampaign", "CampaignError", "FaultSchedule",
-           "chaos_main", "line_window", "FAULT_POOL", "KILL_WINDOWS"]
+__all__ = ["ChaosCampaign", "FleetCampaign", "CampaignError",
+           "FaultSchedule", "FleetSchedule", "chaos_main",
+           "line_window", "FAULT_POOL", "KILL_WINDOWS"]

@@ -52,9 +52,62 @@ import time
 from collections import deque
 from typing import List, Optional
 
+from sartsolver_tpu.engine import routing as fleet_routing
 from sartsolver_tpu.obs import flight as obs_flight
 from sartsolver_tpu.obs import metrics as obs_metrics
 from sartsolver_tpu.utils import atomicio
+
+# supervisor.jsonl / fleet.jsonl size-based rotation knob: past this
+# many bytes the log is compacted to its newest half-limit tail. A
+# supervisor that survives weeks of crash-loops must bound its own
+# disk, same reasoning as the engine's journal rotation. 0 disables.
+DEFAULT_ROTATE_BYTES = 256 * 1024
+
+
+def _rotate_limit() -> int:
+    try:
+        return int(os.environ.get("SART_SUPERVISOR_ROTATE_BYTES")
+                   or DEFAULT_ROTATE_BYTES)
+    except ValueError:
+        return DEFAULT_ROTATE_BYTES
+
+
+def rotate_events(path: str, limit: int) -> int:
+    """Size-based event-log rotation: once ``path`` passes ``limit``
+    bytes, atomically rewrite it down to its newest ~``limit/2`` tail
+    of whole lines (oldest records are the ones already mirrored to
+    every other surface). Returns bytes reclaimed, 0 when nothing
+    happened. Rotation failure is silent by design — the log keeps
+    growing rather than the supervisor dying over housekeeping."""
+    if not limit or limit <= 0:
+        return 0
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return 0
+    if size <= limit:
+        return 0
+    try:
+        with open(path, errors="replace") as f:
+            lines = f.readlines()
+    except OSError:
+        return 0
+    keep: List[str] = []
+    budget = limit // 2
+    kept = 0
+    for line in reversed(lines):
+        if kept + len(line) > budget and keep:
+            break
+        keep.append(line)
+        kept += len(line)
+    keep.reverse()
+    try:
+        # durable: rotated event log (atomic rename — every reader sees
+        # a complete file; fsync'd so the kept tail survives a crash)
+        atomicio.write_atomic(path, "".join(keep), fsync=True)
+    except OSError:
+        return 0
+    return max(0, size - kept)
 
 
 def classify_exit(returncode: int) -> str:
@@ -152,6 +205,7 @@ class Supervisor:
         for sub in ("", "ingest", "responses"):
             os.makedirs(os.path.join(engine_dir, sub), exist_ok=True)
         self.events_path = os.path.join(engine_dir, "supervisor.jsonl")  # durable: supervisor events
+        self.rotate_bytes = _rotate_limit()
         self.prom_path = os.path.join(engine_dir, "supervisor.prom")
         self.bundle_path = os.path.join(engine_dir,
                                         "supervisor.crash.json")
@@ -174,6 +228,9 @@ class Supervisor:
               + (f" {detail}" if detail else ""), file=sys.stderr,
               flush=True)
         obs_flight.record_event(f"supervisor.{kind}", **data)
+        # getattr: drills construct bare instances via __new__ with only
+        # the paths set — rotation simply stays off there
+        rotate_events(self.events_path, getattr(self, "rotate_bytes", 0))
         try:
             # flush+fsync like the journal/state appends: the
             # supervisor is the component that survives the crash, so
@@ -490,6 +547,440 @@ class Supervisor:
             self._write_prom()
 
 
+class FleetController:
+    """M supervised serve workers + tenant-affinity routing +
+    journal-backed failover (docs/SERVING.md §10).
+
+    Layout under ``fleet_dir``::
+
+        routing.json        atomically-published routing table
+        fleet.jsonl         controller events (rotated like supervisor.jsonl)
+        ingest/             controller intake (client fallback routing)
+        responses/          SHARED verdict/outcome files (all workers)
+        outputs/            SHARED solution files (all workers)
+        workers/w<k>/       each worker's own engine dir (journal, state)
+
+    Each worker is a normal ``sartsolve serve`` process pinned to its
+    shard: ``--worker_index k --fleet_size M`` arms the admission
+    affinity check (``wrong-worker`` sheds misrouted tenants), and the
+    shared responses/outputs dirs mean a client polls ONE place no
+    matter which worker — or which worker's *survivor* — solved its
+    request.
+
+    Failover is journal-backed: when a worker dies abnormally the
+    controller replays its journal shard, appends a ``handoff`` marker
+    per accepted-but-uncompleted request to the DEAD worker's journal
+    (marker first: once durable, a restart of that worker will never
+    re-drive the id), then re-stages each payload — ``handoff`` flag
+    set so affinity admits it — into a surviving worker's ingest dir.
+    The dedup watermark + shared responses dir carry the exactly-once
+    story across the handoff; the crash-point model checker
+    (analysis/protocol.py) enumerates a crash at every effect boundary
+    of this dance, with :func:`~sartsolver_tpu.engine.protocol.
+    needs_restage` as the shared recovery gate.
+    """
+
+    def __init__(
+        self,
+        worker_argv: List[str],
+        *,
+        fleet_dir: str,
+        size: int = 3,
+        base_port: Optional[int] = None,
+        backoff_base: float = 0.5,
+        backoff_max: float = 10.0,
+        max_restarts: int = 0,
+        poll_interval: float = 0.1,
+    ):
+        self.worker_argv = list(worker_argv)
+        self.fleet_dir = fleet_dir
+        self.size = max(1, int(size))
+        self.base_port = None if base_port is None else int(base_port)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.max_restarts = max(0, int(max_restarts))
+        self.poll_interval = float(poll_interval)
+        self.restarts = 0
+        self._stop = False
+        self._signame: Optional[str] = None
+        self._forwarded = False
+        self.ingest_dir = os.path.join(fleet_dir, "ingest")
+        self.responses_dir = os.path.join(fleet_dir, "responses")
+        self.outputs_dir = os.path.join(fleet_dir, "outputs")
+        self.events_path = os.path.join(fleet_dir, "fleet.jsonl")  # durable: fleet events
+        self.rotate_bytes = _rotate_limit()
+        for d in (fleet_dir, self.ingest_dir, self.responses_dir,
+                  self.outputs_dir):
+            os.makedirs(d, exist_ok=True)
+        self.workers: List[dict] = []
+        for k in range(self.size):
+            wdir = os.path.join(fleet_dir, "workers", f"w{k}")
+            os.makedirs(os.path.join(wdir, "ingest"), exist_ok=True)
+            self.workers.append({
+                "index": k, "dir": wdir, "proc": None, "state": "down",
+                "spawns": 0, "streak": 0, "next_spawn": 0.0,
+                "t_spawn": 0.0, "done": False,
+            })
+
+    # ---- events / plumbing -----------------------------------------------
+
+    def _event(self, kind: str, **data) -> None:
+        rec = {"unix": round(time.time(), 3), "kind": str(kind)}
+        rec.update(data)
+        detail = " ".join(f"{k}={v}" for k, v in data.items())
+        print(f"sartsolve fleet: {kind}"
+              + (f" {detail}" if detail else ""), file=sys.stderr,
+              flush=True)
+        obs_flight.record_event(f"fleet.{kind}", **data)
+        rotate_events(self.events_path, getattr(self, "rotate_bytes", 0))
+        try:
+            atomicio.append_line(self.events_path,
+                                 json.dumps(rec) + "\n")
+        except OSError:
+            pass
+
+    def _journal(self, k: int):
+        from sartsolver_tpu.engine.journal import RequestJournal
+
+        return RequestJournal(
+            os.path.join(self.workers[k]["dir"], "journal.jsonl")
+        )
+
+    def _worker_port(self, k: int) -> Optional[int]:
+        return None if self.base_port is None else self.base_port + k
+
+    def _alive(self, k: int) -> bool:
+        proc = self.workers[k]["proc"]
+        return (self.workers[k]["state"] == "up" and proc is not None
+                and proc.poll() is None)
+
+    def _ready(self, k: int) -> bool:
+        """Best-effort ``/readyz`` poll (portless fleets count every
+        alive worker as ready — the ingest backlog still load-balances)."""
+        port = self._worker_port(k)
+        if port is None:
+            return True
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/readyz", timeout=0.5) as r:
+                return r.status == 200
+        except Exception:  # noqa: BLE001 - a dead endpoint is "not ready"
+            return False
+
+    def _publish_routing(self) -> None:
+        rows = [
+            {"index": w["index"],
+             "ingest_dir": os.path.join(w["dir"], "ingest"),
+             "http_port": self._worker_port(w["index"]),
+             "state": w["state"]}
+            for w in self.workers
+        ]
+        fleet_routing.publish_routing(
+            self.fleet_dir, rows, responses_dir=self.responses_dir,
+            ingest_dir=self.ingest_dir,
+        )
+
+    # ---- worker lifecycle ------------------------------------------------
+
+    def _spawn(self, k: int) -> None:
+        w = self.workers[k]
+        cmd = [sys.executable, "-m", "sartsolver_tpu.cli", "serve",
+               "--engine_dir", w["dir"],
+               "--responses_dir", self.responses_dir,
+               "--outputs_dir", self.outputs_dir,
+               "--worker_index", str(k),
+               "--fleet_size", str(self.size)]
+        port = self._worker_port(k)
+        if port is not None:
+            cmd += ["--http_port", str(port)]
+        cmd += self.worker_argv
+        env = dict(os.environ)
+        # per-worker metric identity: every engine series the worker
+        # registers carries worker=w<k> (obs/metrics.py default labels)
+        env["SART_WORKER_ID"] = f"w{k}"
+        proc = subprocess.Popen(cmd, env=env)  # stdout/stderr inherited
+        w["proc"] = proc
+        w["state"] = "up"
+        w["spawns"] += 1
+        w["t_spawn"] = time.monotonic()
+        self._event("worker-spawn", pid=proc.pid, spawn=w["spawns"],
+                    worker=k)
+
+    def _pick_survivor(self, exclude: int) -> Optional[int]:
+        """The failover/fallback target: an alive worker, ready ones
+        first, least ingest backlog breaking ties."""
+        alive = [w["index"] for w in self.workers
+                 if w["index"] != exclude and self._alive(w["index"])]
+        if not alive:
+            return None
+        ready = [k for k in alive if self._ready(k)]
+        pool = ready or alive
+
+        def backlog(k: int) -> int:
+            try:
+                return len(os.listdir(
+                    os.path.join(self.workers[k]["dir"], "ingest")))
+            except OSError:
+                return 0
+
+        return min(pool, key=lambda k: (backlog(k), k))
+
+    def _failover(self, k: int) -> None:
+        """Re-drive a dead worker's accepted-but-uncompleted journal
+        entries on a survivor (handoff marker FIRST — see the class
+        docstring for the crash-ordering argument)."""
+        w = self.workers[k]
+        w["state"] = "down"
+        self._publish_routing()
+        journal = self._journal(k)
+        _completed, pending, _handed = journal.replay_full()
+        if not pending:
+            return
+        target = self._pick_survivor(exclude=k)
+        if target is None:
+            # nobody to hand off to: the respawned worker replays its
+            # own journal — same exactly-once story, just slower
+            self._event("handoff-skipped", worker=k,
+                        pending=len(pending))
+            return
+        target_ingest = os.path.join(self.workers[target]["dir"],
+                                     "ingest")
+        for req in pending:
+            journal.handoff(req.id, target, trace_id=req.trace)
+            payload = req.to_dict()
+            payload["handoff"] = True
+            # a partial solution from the dead worker's interrupted
+            # attempt is removed so the survivor writes it fresh
+            # (byte-identical re-drive; same contract as single-worker
+            # journal replay) — safe because pending means no completed
+            # marker exists anywhere for this id
+            try:
+                os.unlink(os.path.join(self.outputs_dir,
+                                       f"{req.id}.h5"))
+            except OSError:
+                pass
+            # durable: failover re-stage (fsync'd atomic publish)
+            atomicio.write_json_atomic(
+                os.path.join(target_ingest, f"{req.id}.json"),
+                payload, fsync=True,
+            )
+            self._event("handoff", id=req.id, source=k, target=target)
+
+    def _recover(self) -> None:
+        """Controller-restart recovery: finish any handoff a previous
+        incarnation's crash interrupted. The crash may have landed
+        between the handoff marker and the re-stage publish — the
+        shared gate :func:`~sartsolver_tpu.engine.protocol.
+        needs_restage` re-stages exactly when no other copy of the
+        story exists anywhere in the fleet."""
+        from sartsolver_tpu.engine.protocol import needs_restage
+
+        replays = [self._journal(w["index"]).replay_full()
+                   for w in self.workers]
+        completed_anywhere: set = set()
+        for completed, _pending, _handed in replays:
+            completed_anywhere.update(completed)
+        restaged = 0
+        for w in self.workers:
+            _completed, _pending, handed = replays[w["index"]]
+            for rid, story in handed.items():
+                target = story.get("target")
+                if target is None or not 0 <= int(target) < self.size:
+                    continue
+                target = int(target)
+                t_ingest = os.path.join(self.workers[target]["dir"],
+                                        "ingest")
+                staged = os.path.exists(
+                    os.path.join(t_ingest, f"{rid}.json"))
+                pending_ids = {req.id for req in replays[target][1]}
+                if not needs_restage(
+                        completed_anywhere=rid in completed_anywhere,
+                        pending_on_target=rid in pending_ids,
+                        staged_on_target=staged):
+                    continue
+                req = story.get("request")
+                payload = req.to_dict() if req is not None else {"id": rid}
+                payload["handoff"] = True
+                try:
+                    os.unlink(os.path.join(self.outputs_dir,
+                                           f"{rid}.h5"))
+                except OSError:
+                    pass
+                # durable: failover re-stage (recovery pass)
+                atomicio.write_json_atomic(
+                    os.path.join(t_ingest, f"{rid}.json"), payload,
+                    fsync=True,
+                )
+                restaged += 1
+                self._event("handoff-restage", id=rid,
+                            source=w["index"], target=target)
+        if restaged:
+            self._event("recovery", restaged=restaged)
+
+    # ---- controller intake -----------------------------------------------
+
+    def _pump_intake(self) -> int:
+        """Route requests dropped in the fleet-level ingest dir (the
+        routing table's client fallback) to their tenant-affinity
+        worker — or, when that worker is down, to a survivor with the
+        handoff flag set so admission accepts them there."""
+        try:
+            names = sorted(os.listdir(self.ingest_dir))
+        except OSError:
+            return 0
+        n = 0
+        for name in names:
+            if not name.endswith(".json"):
+                continue
+            path = os.path.join(self.ingest_dir, name)
+            try:
+                with open(path) as f:
+                    payload = json.load(f)
+            except (OSError, ValueError):
+                continue  # torn mid-write; picked up next pass
+            tenant = "default"
+            if isinstance(payload, dict):
+                tenant = str(payload.get("tenant") or "default")
+            k = fleet_routing.tenant_worker(tenant, self.size)
+            if not self._alive(k):
+                target = self._pick_survivor(exclude=k)
+                if target is None:
+                    return n  # nobody up; keep the file, retry next loop
+                if isinstance(payload, dict):
+                    payload = {**payload, "handoff": True}
+                k = target
+            dst = os.path.join(self.workers[k]["dir"], "ingest", name)
+            try:
+                # durable: routed intake (fsync'd atomic publish into
+                # the worker's ingest, then the fleet copy is dropped)
+                atomicio.write_json_atomic(dst, payload, fsync=True)
+                os.unlink(path)
+            except OSError:
+                continue
+            n += 1
+        if n:
+            self._event("intake-routed", requests=n)
+        return n
+
+    # ---- signals / main loop ---------------------------------------------
+
+    def _handler(self, signum, _frame) -> None:
+        name = signal.Signals(signum).name
+        if self._stop:
+            for w in self.workers:
+                proc = w["proc"]
+                if proc is not None and proc.poll() is None:
+                    try:
+                        proc.kill()
+                    except OSError:
+                        pass
+            signal.signal(signum, signal.SIG_DFL)
+            signal.raise_signal(signum)
+            return
+        self._stop = True
+        self._signame = name
+        sys.stderr.write(
+            f"sartsolve fleet: received {name} — forwarding SIGTERM "
+            "to every worker for one graceful drain. Send again to "
+            "abort immediately.\n"
+        )
+        sys.stderr.flush()
+
+    def run(self) -> int:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, self._handler)
+        obs_flight.install()
+        self._event("start", size=self.size, fleet_dir=self.fleet_dir)
+        exit_code = 0
+        try:
+            self._recover()
+            for w in self.workers:
+                self._spawn(w["index"])
+            self._publish_routing()
+            while True:
+                if self._stop and not self._forwarded:
+                    self._forwarded = True
+                    for w in self.workers:
+                        proc = w["proc"]
+                        if proc is not None and proc.poll() is None:
+                            try:
+                                proc.send_signal(signal.SIGTERM)
+                            except OSError:
+                                pass
+                    self._event("sigterm-forwarded",
+                                signal=self._signame)
+                self._pump_intake()
+                now = time.monotonic()
+                for w in self.workers:
+                    if w["done"]:
+                        continue
+                    proc = w["proc"]
+                    if proc is None:
+                        if not self._stop and now >= w["next_spawn"]:
+                            self._spawn(w["index"])
+                            self._publish_routing()
+                        continue
+                    rc = proc.poll()
+                    if rc is None:
+                        continue
+                    lifetime = now - w["t_spawn"]
+                    reason = classify_exit(rc)
+                    w["proc"] = None
+                    if rc in (0, 4) or (self._stop and rc != 1):
+                        # clean idle exit / graceful drain — final
+                        w["done"] = True
+                        w["state"] = "down"
+                        self._event("worker-done", worker=w["index"],
+                                    code=rc)
+                        self._publish_routing()
+                        continue
+                    if rc == 1:
+                        self._event("worker-config-error",
+                                    worker=w["index"], code=rc)
+                        self._stop = True
+                        exit_code = 1
+                        continue
+                    self.restarts += 1
+                    self._event("worker-crash", code=rc, reason=reason,
+                                worker=w["index"],
+                                lifetime_s=round(lifetime, 1),
+                                restarts=self.restarts)
+                    self._failover(w["index"])
+                    if (self.max_restarts
+                            and self.restarts >= self.max_restarts):
+                        self._event("restart-budget-exhausted",
+                                    restarts=self.restarts)
+                        self._stop = True
+                        exit_code = 3
+                        continue
+                    w["streak"] = (1 if lifetime > 30.0
+                                   else w["streak"] + 1)
+                    w["next_spawn"] = now + restart_backoff(
+                        w["streak"], self.backoff_base, self.backoff_max
+                    )
+                running = any(
+                    w["proc"] is not None and w["proc"].poll() is None
+                    for w in self.workers
+                )
+                if all(w["done"] for w in self.workers):
+                    break
+                if self._stop and not running and all(
+                        w["done"] or w["proc"] is None
+                        for w in self.workers):
+                    break
+                time.sleep(self.poll_interval)
+        finally:
+            obs_flight.uninstall()
+        if exit_code:
+            return exit_code
+        if self._signame is not None:
+            return 4
+        self._event("fleet-done", restarts=self.restarts)
+        return 0
+
+
 def supervisor_main(args, worker_argv: List[str]) -> int:
     """`sartsolve serve --supervised` entry (engine/cli.py): ``args`` is
     the parsed serve namespace (supervision knobs), ``worker_argv`` the
@@ -508,5 +999,6 @@ def supervisor_main(args, worker_argv: List[str]) -> int:
     return sup.run()
 
 
-__all__ = ["Supervisor", "CrashLoopBreaker", "classify_exit",
-           "restart_backoff", "supervisor_main"]
+__all__ = ["Supervisor", "FleetController", "CrashLoopBreaker",
+           "classify_exit", "restart_backoff", "supervisor_main",
+           "rotate_events", "DEFAULT_ROTATE_BYTES"]

@@ -1,0 +1,548 @@
+"""Fleet-scale serving matrix (docs/SERVING.md §10; `make fleet`).
+
+Units: session-cache LRU/byte-budget eviction + forced-eviction hook +
+counters, routing-table affinity/publish/read/torn-table behavior,
+journal handoff markers through replay and compaction, fleet event-log
+rotation, admission tenant-affinity (wrong-worker shed + handoff
+bypass), `sartsolve submit` per-attempt routing re-resolution, and the
+FleetController's failover / recovery / intake-routing state machines
+driven directly against on-disk journals (no processes).
+
+End-to-end: the fleet chaos campaign — M real workers under a real
+controller, SIGKILL mid-commit-window (one seed also SIGKILLs the
+controller mid-handoff and relaunches it), forced session evictions
+under load — asserting exactly-once, byte-identical outputs and
+counter continuity fleet-wide.
+"""
+
+import json
+import os
+
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.engine import request as req_mod
+from sartsolver_tpu.engine import routing as routing_mod
+from sartsolver_tpu.engine.admission import AdmissionController
+from sartsolver_tpu.engine.cli import _submit_attempt, build_submit_parser
+from sartsolver_tpu.engine.journal import RequestJournal
+from sartsolver_tpu.engine.request import Request, parse_request
+from sartsolver_tpu.engine.session import SessionCache, session_key
+from sartsolver_tpu.obs import metrics as obs_metrics
+from sartsolver_tpu.resilience.chaos import FleetSchedule, chaos_main
+from sartsolver_tpu.resilience.supervisor import (
+    DEFAULT_ROTATE_BYTES,
+    FleetController,
+    rotate_events,
+)
+
+# the bounded CI seed pair (make fleet): one plain worker-kill failover
+# seed and one that also SIGKILLs the controller mid-handoff
+FLEET_SEEDS = os.environ.get("SART_FLEET_SEEDS", "5,8")
+
+
+def _req(rid, tenant="default", handoff=False):
+    return Request(id=rid, tenant=tenant, time_range="",
+                   deadline_s=None, submitted_unix=0.0, trace="",
+                   handoff=handoff)
+
+
+class _StubSession:
+    """Minimal session: pinned byte size + close() tracking."""
+
+    def __init__(self, key, nbytes=100):
+        self.key = key
+        self.nbytes = nbytes
+        self.closed = False
+
+    def close(self):
+        self.closed = True
+
+
+# ---------------------------------------------------------------------------
+# session cache
+# ---------------------------------------------------------------------------
+
+def test_session_cache_lru_budget_eviction():
+    obs_metrics.reset_registry()
+    built = []
+    cache = SessionCache(
+        lambda key: built.append(key) or _StubSession(key),
+        byte_budget=250,
+    )
+    a, b = cache.get("a"), cache.get("b")
+    assert cache.resident_bytes() == 200
+    cache.get("a")  # touch: "b" is now least-recently-attached
+    c = cache.get("c")  # 300 bytes > 250 budget: evict LRU ("b")
+    assert cache.keys() == ["a", "c"]
+    assert b.closed and not a.closed and not c.closed
+    assert built == ["a", "b", "c"]
+    reg = obs_metrics.get_registry().snapshot()
+    counters = {r["name"]: r["value"] for r in reg
+                if r["kind"] == "counter"}
+    assert counters["session_cache_hits_total"] == 1
+    assert counters["session_cache_misses_total"] == 3
+    assert counters["session_cache_evictions_total"] == 1
+    gauges = {r["name"]: r["value"] for r in reg if r["kind"] == "gauge"}
+    assert gauges["session_resident_bytes"] == 200.0
+
+
+def test_session_cache_oversized_entry_stays_resident():
+    """A single session larger than the budget must not thrash: it
+    stays resident alone instead of being evicted on every attach."""
+    obs_metrics.reset_registry()
+    cache = SessionCache(lambda key: _StubSession(key, nbytes=1000),
+                         byte_budget=250)
+    cache.get("big")
+    cache.get("big")
+    assert cache.keys() == ["big"]
+
+
+def test_session_cache_seed_prewarms_without_miss():
+    obs_metrics.reset_registry()
+    cache = SessionCache(lambda key: _StubSession(key), byte_budget=0)
+    warm = _StubSession("default")
+    cache.seed("default", warm)
+    assert cache.lease(_req("r1")) is warm
+    counters = {r["name"]: r["value"]
+                for r in obs_metrics.get_registry().snapshot()
+                if r["kind"] == "counter"}
+    assert counters["session_cache_hits_total"] == 1
+    assert "session_cache_misses_total" not in counters
+
+
+def test_session_cache_forced_eviction_hook(monkeypatch):
+    """SART_TEST_EVICT_EVERY=2: every 2nd lease pays a full rebuild of
+    the target entry — the eviction-correctness drill's churn source."""
+    monkeypatch.setenv("SART_TEST_EVICT_EVERY", "2")
+    obs_metrics.reset_registry()
+    builds = []
+    cache = SessionCache(
+        lambda key: builds.append(key) or _StubSession(key),
+        byte_budget=0,
+    )
+    events = []
+    cache._on_event = lambda kind, **data: events.append((kind, data))
+    for i in range(4):
+        cache.lease(_req(f"r{i}"))
+    # leases 2 and 4 evicted first: 3 builds of the default key total
+    assert builds == ["default"] * 3
+    evicts = [d for k, d in events if k == "session-evict"]
+    assert len(evicts) == 2
+    assert all(d["reason"] == "test-forced" for d in evicts)
+
+
+def test_session_cache_compile_reuse_counter():
+    obs_metrics.reset_registry()
+    cache = SessionCache(lambda key: _StubSession(key), byte_budget=0)
+    cache.get("a")
+    cache.evict("a")
+    cache.get("a")  # rebuilt with a previously-seen key
+    counters = {r["name"]: r["value"]
+                for r in obs_metrics.get_registry().snapshot()
+                if r["kind"] == "counter"}
+    assert counters["session_cache_compile_reuse_total"] == 1
+
+
+def test_session_key_pins_compiled_program_contract():
+    assert session_key(14, 16, "float64", (2, 1)) == "14x16:float64:2x1"
+    assert session_key(14, 16, "float64", None) == "14x16:float64:-"
+    assert (session_key(14, 16, "float64", (2, 1))
+            != session_key(14, 16, "float32", (2, 1)))
+
+
+def test_session_cache_shutdown_closes_all():
+    obs_metrics.reset_registry()
+    cache = SessionCache(lambda key: _StubSession(key), byte_budget=0)
+    sessions = [cache.get(k) for k in ("a", "b")]
+    cache.close()
+    assert len(cache) == 0
+    assert all(s.closed for s in sessions)
+
+
+# ---------------------------------------------------------------------------
+# routing table
+# ---------------------------------------------------------------------------
+
+def test_tenant_worker_stable_and_in_range():
+    # CRC32-based: stable across processes (a salted hash would scatter
+    # tenants on every controller restart)
+    assert routing_mod.tenant_worker("t0", 3) == \
+        routing_mod.tenant_worker("t0", 3)
+    assert routing_mod.tenant_worker("anything", 1) == 0
+    seen = {routing_mod.tenant_worker(f"t{i}", 3) for i in range(64)}
+    assert seen == {0, 1, 2}  # every shard reachable
+
+
+def test_routing_publish_read_resolve(tmp_path):
+    fleet = str(tmp_path)
+    rows = [{"index": k, "ingest_dir": f"/w{k}/ingest",
+             "http_port": 8600 + k, "state": "up"} for k in range(3)]
+    routing_mod.publish_routing(fleet, rows,
+                                responses_dir="/fleet/responses",
+                                ingest_dir="/fleet/ingest")
+    # readable via the dir OR the file path
+    table = routing_mod.read_routing(fleet)
+    assert table == routing_mod.read_routing(
+        routing_mod.routing_path(fleet))
+    assert table["size"] == 3
+    assert table["responses_dir"] == "/fleet/responses"
+    row = routing_mod.resolve_worker(table, "t5")
+    assert row["index"] == routing_mod.tenant_worker("t5", 3)
+    assert row["ingest_dir"] == f"/w{row['index']}/ingest"
+
+
+def test_routing_torn_or_alien_table_reads_none(tmp_path):
+    assert routing_mod.read_routing(str(tmp_path)) is None  # absent
+    path = routing_mod.routing_path(str(tmp_path))
+    with open(path, "w") as f:
+        f.write('{"version": 1, "workers": [')  # torn mid-write
+    assert routing_mod.read_routing(str(tmp_path)) is None
+    with open(path, "w") as f:
+        json.dump({"version": 99, "workers": []}, f)  # future schema
+    assert routing_mod.read_routing(str(tmp_path)) is None
+
+
+# ---------------------------------------------------------------------------
+# journal handoff story
+# ---------------------------------------------------------------------------
+
+def test_journal_handoff_excludes_from_pending(tmp_path):
+    j = RequestJournal(str(tmp_path / "journal.jsonl"))
+    j.accepted(_req("a", tenant="t1"))
+    j.accepted(_req("b", tenant="t2"))
+    j.handoff("a", 2, trace_id="tr")
+    completed, pending, handed = j.replay_full()
+    assert not completed
+    assert [r.id for r in pending] == ["b"]
+    assert handed["a"]["target"] == 2
+    assert handed["a"]["request"].tenant == "t1"
+    # plain replay() agrees (the single-worker view)
+    _, pending2 = j.replay()
+    assert [r.id for r in pending2] == ["b"]
+
+
+def test_journal_handoff_completed_wins(tmp_path):
+    """A completed marker anywhere beats the handoff story — the id is
+    done, nothing re-drives it."""
+    j = RequestJournal(str(tmp_path / "journal.jsonl"))
+    j.accepted(_req("a"))
+    j.handoff("a", 1)
+    j.completed(_req("a"), {"state": "done"})
+    completed, pending, handed = j.replay_full()
+    assert "a" in completed and not pending and not handed
+
+
+def test_journal_compaction_preserves_handoff_story(tmp_path):
+    """Dropping the handoff marker at compaction would resurrect the id
+    as pending on the dead worker's next replay — re-driving a request
+    the fleet already owns elsewhere."""
+    j = RequestJournal(str(tmp_path / "journal.jsonl"))
+    j.accepted(_req("gone", tenant="t9"))
+    j.handoff("gone", 1)
+    j.accepted(_req("keep"))
+    j.accepted(_req("done"))
+    j.completed(_req("done"), {"state": "done"})
+    assert j.compact() > 0
+    completed, pending, handed = j.replay_full()
+    assert not completed  # completed records dropped (watermark owns them)
+    assert [r.id for r in pending] == ["keep"]
+    assert handed["gone"]["target"] == 1
+    assert handed["gone"]["request"].tenant == "t9"
+
+
+# ---------------------------------------------------------------------------
+# event-log rotation
+# ---------------------------------------------------------------------------
+
+def test_rotate_events_keeps_newest_tail(tmp_path):
+    path = str(tmp_path / "fleet.jsonl")
+    lines = [json.dumps({"kind": "tick", "n": i}) + "\n"
+             for i in range(500)]
+    with open(path, "w") as f:
+        f.writelines(lines)
+    limit = 2048
+    assert rotate_events(path, limit) > 0
+    size = os.path.getsize(path)
+    assert 0 < size <= limit
+    kept = open(path).read().splitlines()
+    # the newest records survive, whole lines only
+    assert json.loads(kept[-1])["n"] == 499
+    assert all(json.loads(ln)["n"] >= 400 for ln in kept)
+    assert rotate_events(path, limit) == 0  # under limit: no-op
+    assert rotate_events(path, 0) == 0  # rotation disabled
+    assert DEFAULT_ROTATE_BYTES > 0
+
+
+# ---------------------------------------------------------------------------
+# admission tenant affinity
+# ---------------------------------------------------------------------------
+
+def test_admission_wrong_worker_shed_and_handoff_bypass():
+    obs_metrics.reset_registry()
+    tenant = "t-affinity"
+    home = routing_mod.tenant_worker(tenant, 3)
+    wrong = (home + 1) % 3
+    adm = AdmissionController(affinity=(wrong, 3))
+    assert adm.admit(_req("r1", tenant=tenant)) == \
+        req_mod.REASON_WRONG_WORKER
+    # the controller's failover re-drive bypasses affinity
+    assert adm.admit(_req("r1", tenant=tenant, handoff=True)) is None
+    # the home worker admits without any flag
+    adm_home = AdmissionController(affinity=(home, 3))
+    assert adm_home.admit(_req("r2", tenant=tenant)) is None
+
+
+def test_admission_affinity_index_out_of_range():
+    with pytest.raises(ValueError, match="out of range"):
+        AdmissionController(affinity=(3, 3))
+
+
+# ---------------------------------------------------------------------------
+# submit routing re-resolution
+# ---------------------------------------------------------------------------
+
+def _attempt(fleet_dir, tenant):
+    args = build_submit_parser().parse_args(
+        ["--engine_dir", fleet_dir, "--id", "req-1",
+         "--tenant", tenant, "--wait", "0"])
+    payload = json.dumps({"id": "req-1", "tenant": tenant})
+    return _submit_attempt(args, parse_request(payload), payload)
+
+
+def test_submit_reresolves_routing_per_attempt(tmp_path):
+    """Each submit attempt re-reads routing.json: after the tenant's
+    worker goes down, the SAME submission falls back to the controller
+    intake — the re-targeting `--retry` leans on."""
+    obs_metrics.reset_registry()
+    fleet = str(tmp_path)
+    tenant = "t-routed"
+    home = routing_mod.tenant_worker(tenant, 2)
+    w_ingest = [str(tmp_path / f"w{k}-ingest") for k in range(2)]
+    fallback = str(tmp_path / "fleet-ingest")
+    for d in w_ingest + [fallback]:
+        os.makedirs(d)
+    rows = [{"index": k, "ingest_dir": w_ingest[k], "state": "up"}
+            for k in range(2)]
+    routing_mod.publish_routing(fleet, rows, ingest_dir=fallback)
+    rec, code = _attempt(fleet, tenant)
+    assert code == 0 and rec["state"] == "submitted"
+    assert os.path.exists(os.path.join(w_ingest[home], "req-1.json"))
+    # the affinity worker dies; the controller republishes
+    rows[home]["state"] = "down"
+    routing_mod.publish_routing(fleet, rows, ingest_dir=fallback)
+    rec, code = _attempt(fleet, tenant)
+    assert code == 0
+    assert os.path.exists(os.path.join(fallback, "req-1.json"))
+
+
+def test_submit_without_routing_uses_direct_dirs(tmp_path):
+    """No routing.json: the classic single-worker addressing."""
+    obs_metrics.reset_registry()
+    os.makedirs(tmp_path / "ingest")
+    rec, code = _attempt(str(tmp_path), "anyone")
+    assert code == 0
+    assert os.path.exists(tmp_path / "ingest" / "req-1.json")
+
+
+# ---------------------------------------------------------------------------
+# fleet controller (direct API: on-disk journals, no processes)
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self, pid=4242):
+        self.pid = pid
+
+    def poll(self):
+        return None
+
+
+def _controller(tmp_path, size=3):
+    obs_metrics.reset_registry()
+    return FleetController([], fleet_dir=str(tmp_path / "fleet"),
+                           size=size)
+
+
+def _mark_up(fc, k):
+    fc.workers[k]["proc"] = _FakeProc(pid=5000 + k)
+    fc.workers[k]["state"] = "up"
+
+
+def test_fleet_failover_marker_first_then_restage(tmp_path, capsys):
+    fc = _controller(tmp_path)
+    _mark_up(fc, 1)
+    j0 = fc._journal(0)
+    j0.accepted(_req("a", tenant="t1"))
+    j0.accepted(_req("done", tenant="t1"))
+    j0.completed(_req("done"), {"state": "done"})
+    # a partial output from the dead worker's interrupted attempt
+    partial = os.path.join(fc.outputs_dir, "a.h5")
+    open(partial, "wb").write(b"torn")
+    fc._failover(0)
+    # handoff marker landed in the DEAD worker's journal, target=1
+    _, pending, handed = j0.replay_full()
+    assert not pending and handed["a"]["target"] == 1
+    # payload re-staged on the survivor with the affinity bypass set
+    staged = os.path.join(fc.workers[1]["dir"], "ingest", "a.json")
+    payload = json.load(open(staged))
+    assert payload["handoff"] is True and payload["tenant"] == "t1"
+    # the torn partial is gone (survivor writes it fresh)
+    assert not os.path.exists(partial)
+    # the completed request was NOT re-driven
+    assert not os.path.exists(
+        os.path.join(fc.workers[1]["dir"], "ingest", "done.json"))
+    # routing now shows w0 down
+    table = routing_mod.read_routing(fc.fleet_dir)
+    assert [r["state"] for r in table["workers"]] == ["down", "up",
+                                                      "down"]
+
+
+def test_fleet_failover_no_survivor_skips(tmp_path, capsys):
+    """Nobody alive to hand off to: the respawned worker replays its
+    own journal — the handoff marker must NOT be written."""
+    fc = _controller(tmp_path)
+    j0 = fc._journal(0)
+    j0.accepted(_req("a"))
+    fc._failover(0)
+    _, pending, handed = j0.replay_full()
+    assert [r.id for r in pending] == ["a"] and not handed
+    assert "handoff-skipped" in capsys.readouterr().err
+
+
+def test_fleet_recover_restages_interrupted_handoff(tmp_path):
+    """Controller crash between the handoff marker and the re-stage
+    publish: a fresh incarnation's _recover() finishes the job — and a
+    second pass is a no-op (needs_restage sees the staged copy)."""
+    fc = _controller(tmp_path)
+    j0 = fc._journal(0)
+    j0.accepted(_req("a", tenant="t1"))
+    j0.handoff("a", 2)  # marker durable, re-stage never happened
+    fc2 = FleetController([], fleet_dir=fc.fleet_dir, size=3)
+    fc2._recover()
+    staged = os.path.join(fc2.workers[2]["dir"], "ingest", "a.json")
+    assert json.load(open(staged))["handoff"] is True
+    before = os.path.getmtime(staged)
+    fc2._recover()  # idempotent: staged copy exists, no rewrite
+    assert os.path.getmtime(staged) == before
+
+
+def test_fleet_recover_skips_completed_anywhere(tmp_path):
+    """The survivor already completed the handed-off request before the
+    controller crashed: recovery must not resurrect it."""
+    fc = _controller(tmp_path)
+    fc._journal(0).accepted(_req("a"))
+    fc._journal(0).handoff("a", 1)
+    fc._journal(1).completed(_req("a", handoff=True), {"state": "done"})
+    fc2 = FleetController([], fleet_dir=fc.fleet_dir, size=3)
+    fc2._recover()
+    assert not os.path.exists(
+        os.path.join(fc2.workers[1]["dir"], "ingest", "a.json"))
+
+
+def test_fleet_intake_routes_by_affinity(tmp_path):
+    fc = _controller(tmp_path)
+    for k in range(3):
+        _mark_up(fc, k)
+    tenant = "t-intake"
+    home = routing_mod.tenant_worker(tenant, 3)
+    with open(os.path.join(fc.ingest_dir, "r1.json"), "w") as f:
+        json.dump({"id": "r1", "tenant": tenant}, f)
+    with open(os.path.join(fc.ingest_dir, "torn.json"), "w") as f:
+        f.write('{"id": "r2"')  # mid-write; picked up next pass
+    assert fc._pump_intake() == 1
+    routed = os.path.join(fc.workers[home]["dir"], "ingest", "r1.json")
+    payload = json.load(open(routed))
+    assert "handoff" not in payload  # affinity target: no bypass needed
+    assert not os.path.exists(os.path.join(fc.ingest_dir, "r1.json"))
+    assert os.path.exists(os.path.join(fc.ingest_dir, "torn.json"))
+
+
+def test_fleet_intake_falls_back_to_survivor(tmp_path):
+    fc = _controller(tmp_path)
+    tenant = "t-intake"
+    home = routing_mod.tenant_worker(tenant, 3)
+    survivor = (home + 1) % 3
+    _mark_up(fc, survivor)  # the affinity worker stays down
+    with open(os.path.join(fc.ingest_dir, "r1.json"), "w") as f:
+        json.dump({"id": "r1", "tenant": tenant}, f)
+    assert fc._pump_intake() == 1
+    routed = os.path.join(fc.workers[survivor]["dir"], "ingest",
+                          "r1.json")
+    assert json.load(open(routed))["handoff"] is True
+
+
+def test_fleet_intake_holds_when_fleet_dark(tmp_path):
+    """No worker alive: the request stays in the controller intake for
+    the next loop instead of being dropped."""
+    fc = _controller(tmp_path)
+    with open(os.path.join(fc.ingest_dir, "r1.json"), "w") as f:
+        json.dump({"id": "r1", "tenant": "t"}, f)
+    assert fc._pump_intake() == 0
+    assert os.path.exists(os.path.join(fc.ingest_dir, "r1.json"))
+
+
+def test_fleet_pick_survivor_prefers_least_backlog(tmp_path):
+    fc = _controller(tmp_path)
+    for k in (1, 2):
+        _mark_up(fc, k)
+    for i in range(3):
+        open(os.path.join(fc.workers[1]["dir"], "ingest",
+                          f"q{i}.json"), "w").close()
+    assert fc._pick_survivor(exclude=0) == 2
+    assert fc._pick_survivor(exclude=2) == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet chaos schedule + campaign
+# ---------------------------------------------------------------------------
+
+def test_fleet_schedule_deterministic():
+    for seed in range(8):
+        a, b = FleetSchedule(seed), FleetSchedule(seed)
+        assert a.describe() == b.describe()
+        assert a.evict_every == 2  # pinned: pigeonhole eviction guarantee
+        assert a.window in FleetSchedule.WINDOWS
+        assert a.occurrence in (1, 2)
+    kills = {FleetSchedule(s).kill_controller_in_handoff
+             for s in range(24)}
+    assert kills == {True, False}  # both flavors reachable in CI range
+
+
+def test_fleet_chaos_cli_rejects_bad_fleet_size(tmp_path):
+    assert chaos_main(["--engine_dir", str(tmp_path), "--fleet", "1",
+                       "--", "x.h5"]) == 1
+    assert chaos_main(["--engine_dir", str(tmp_path), "--fleet", "-2",
+                       "--", "x.h5"]) == 1
+
+
+def test_fleet_chaos_campaign_ci_seed_set(tmp_path, capsys):
+    """The ISSUE acceptance drill: M=3 real workers under a real
+    controller, seeded SIGKILL inside a journal commit window (seed 8
+    also kills the controller mid-handoff and relaunches it), forced
+    session evictions throughout — exactly-once, byte-identical,
+    counters continuous fleet-wide."""
+    world = str(tmp_path / "world")
+    os.makedirs(world)
+    paths, *_ = fx.write_world(world, n_frames=4)
+    report_path = str(tmp_path / "report.json")
+    rc = chaos_main([
+        "--engine_dir", str(tmp_path / "camp"), "--fleet", "3",
+        "--seeds", FLEET_SEEDS, "--slo_ms", "300000",
+        "--timeout", "280", "--report", report_path, "--",
+        "--use_cpu", "-m", "40", "-c", "1e-12", "--lanes", "2",
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    report = json.load(open(report_path))
+    assert report["verdict"] == "ok"
+    assert report["fleet"] == 3
+    assert len(report["passes"]) == len(FLEET_SEEDS.split(","))
+    for verdict in report["passes"]:
+        assert verdict["verdict"] == "ok"
+        assert verdict["kills_fired"] >= 1  # every seed really killed
+        assert verdict["evictions"] >= 1  # forced churn actually fired
+        assert verdict["requests"] == 8  # 2*M + 2, exactly once each
+        assert verdict["requests_total"] == {"completed": 8.0}
