@@ -278,6 +278,21 @@ def build_parser() -> argparse.ArgumentParser:
                           "while the run continues. Multihost runs "
                           "always fail fast (a per-process frame skip "
                           "would desynchronize the collective loop).")
+    res.add_argument("--solve_ckpt_stride", type=int, default=0,
+                     metavar="N",
+                     help="In-solve checkpointing (docs/RESILIENCE.md §11; "
+                          "continuous-batching path only): every N "
+                          "scheduler strides, append a CRC-checksummed "
+                          "snapshot of the full lane state — warm chain, "
+                          "momentum carries, divergence-ladder level, "
+                          "iteration counters, reorder buffer — to "
+                          "<output>.solveckpt (SART_SOLVE_CKPT_FILE "
+                          "overrides). --resume then restores the run "
+                          "mid-frame at the newest consistent checkpoint "
+                          "instead of re-running the initial guess and "
+                          "every prior sweep. 0 (default) disables: the "
+                          "run is byte-identical to a build without the "
+                          "layer.")
     tpu.add_argument("--multihost", action="store_true",
                      help="Multi-host run (one process per host, e.g. a TPU "
                           "pod slice): initialize the JAX multi-controller "
@@ -340,6 +355,16 @@ def _validate(args) -> None:
     if args.divergence_recovery < 0:
         fail("Argument divergence_recovery must be >= 0, "
              f"{args.divergence_recovery} given.")
+    if args.solve_ckpt_stride < 0:
+        fail(f"Argument solve_ckpt_stride must be >= 0, "
+             f"{args.solve_ckpt_stride} given.")
+    if args.solve_ckpt_stride and (args.batch_frames <= 1
+                                   or args.no_continuous_batching
+                                   or args.multihost):
+        fail("Argument solve_ckpt_stride snapshots the continuous-batching "
+             "scheduler's lane state; it needs --batch_frames > 1 without "
+             "--no_continuous_batching (multihost runs use the classic "
+             "grouped loop and cannot checkpoint mid-frame).")
     if (args.divergence_recovery and args.logarithmic
             and args.fused_sweep in ("on", "interpret")):
         fail("Argument divergence_recovery cannot combine --logarithmic "
@@ -499,9 +524,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     # the first boundary check then stops the run before any solve.
     shutdown.install()
 
-    if args.multihost:
-        from sartsolver_tpu.parallel import multihost as mh
+    # imported unconditionally (it is jax-only, already paid above): the
+    # pod fault-tolerance seams — identity, liveness, barriers, the
+    # PodBarrierTimeout exit mapping — also serve FAKE pods, where N
+    # single-process workers coordinate over SART_POD_BARRIER_DIR
+    # without --multihost (docs/RESILIENCE.md §11)
+    from sartsolver_tpu.parallel import multihost as mh
 
+    if args.multihost:
         try:
             mh.initialize()
         except RetriesExhausted as err:
@@ -511,6 +541,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"Unrecoverable after retries: {err}", file=sys.stderr)
             shutdown.uninstall()
             return EXIT_INFRASTRUCTURE
+    # Pod identity + liveness (docs/RESILIENCE.md §11): publish k/n into
+    # the env so jax-free consumers (the heartbeat's host= field, the
+    # site@i SART_FAULT qualifier) agree with the runtime, and start
+    # refreshing this host's file-mode liveness beacon from the beacon
+    # stream. Both are no-ops on plain single-process runs.
+    mh.export_pod_identity()
+    mh.install_pod_liveness()
 
     from sartsolver_tpu.config import (
         SDC_DETECTED, SartInputError, SolverOptions, parse_time_intervals,
@@ -1087,12 +1124,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         stop_state = {"interrupted": False}
 
         def stop_now() -> bool:
-            """Group-boundary stop poll. Multihost: a one-int host
-            allgather so every process stops at the SAME boundary
-            (the scheduler's signals land at different instants;
-            parallel/multihost.agree_stop)."""
+            """Group-boundary stop poll. Multihost (and file-mode fake
+            pods): a one-int agreement so every process stops at the SAME
+            boundary (the scheduler's signals land at different instants;
+            parallel/multihost.agree_stop). A fake-pod worker honoring
+            only its local flag would drain while its peers kept
+            arriving at stride barriers — a graceful preemption must
+            never present as a dead-peer timeout."""
             local = shutdown.stop_requested()
-            if args.multihost:
+            if args.multihost or mh.pod_identity()[1] > 1:
                 return mh.agree_stop(local)
             return local
 
@@ -1439,18 +1479,141 @@ def main(argv: Optional[List[str]] = None) -> int:
                               f"(continuous batch of {K} lanes; "
                               f"{iterations} iterations)")
 
+                # In-solve checkpointing + per-stride pod rendezvous
+                # (docs/RESILIENCE.md §11). File-mode pods barrier every
+                # stride (the fake-pod lockstep contract — and the chaos
+                # harness's dead-peer detection point); real pods already
+                # rendezvous inside the sharded dispatch collectives, so
+                # no extra per-stride sync is imposed there.
+                from sartsolver_tpu.resilience import podckpt
+                from sartsolver_tpu.sched.scheduler import (
+                    sched_held_ftimes,
+                )
+
+                pod_idx, pod_count = mh.pod_identity()
+                pod_markers = bool(
+                    _os.environ.get("SART_TEST_POD_MARKERS")
+                )
+                ckpt_base = (_os.environ.get("SART_SOLVE_CKPT_FILE")
+                             or f"{args.output_file}.solveckpt")
+                store = None
+                ckpt_sink = None
+                if args.solve_ckpt_stride:
+                    store = podckpt.SolveCheckpointStore(
+                        ckpt_base, pod_idx, pod_count
+                    )
+                    ckpt_sink = store.save
+                stride_barrier = None
+                if pod_count > 1 and _os.environ.get(
+                        "SART_POD_BARRIER_DIR"):
+                    def stride_barrier(serial: int) -> None:
+                        if pod_markers:
+                            # chaos-harness kill window: mid-stride
+                            sys.stderr.write(
+                                f"SART_POD_POINT stride serial={serial}\n"
+                            )
+                            sys.stderr.flush()
+                        mh.pod_barrier(f"stride.{serial}")
+
+                # Elastic resume: the newest checkpoint serial that is
+                # consistent across EVERY pod host AND not ahead of this
+                # output file (the killed run's writer may not have
+                # flushed the snapshot's rows — fall back a stride; a
+                # torn host file drops out of the intersection the same
+                # way). No usable checkpoint degrades to the plain
+                # --resume path: rows in the file are skipped and
+                # everything else recomputes.
+                restore = None
+                restore_serial = None
+                W = 0 if resume_state is None else len(resume_state.times)
+                if args.resume and store is not None:
+                    newest = podckpt.newest_consistent_serial(
+                        ckpt_base, pod_count
+                    )
+                    for serial in sorted(store.serials(), reverse=True):
+                        if newest is None or serial > newest:
+                            continue
+                        snap = store.load(serial)
+                        if (snap is None
+                                or int(snap.get("lanes", -1)) != K
+                                or int(snap["next_emit"]) > W):
+                            continue
+                        restore, restore_serial = snap, serial
+                        break
+                    if pod_count > 1 and _os.environ.get(
+                            "SART_POD_BARRIER_DIR"):
+                        # lockstep pins the PICK, not just the files: a
+                        # host whose writer lost its unflushed tail picks
+                        # an older serial than its peers, and divergent
+                        # picks desync every later stride barrier. Agree
+                        # on the minimum usable serial — next_emit is
+                        # monotone in serial, so the minimum satisfies
+                        # every host's next_emit <= rows-on-disk bound.
+                        # Any host with NO usable checkpoint (-1) drags
+                        # the whole pod to the plain-resume path.
+                        picks = mh.pod_barrier(
+                            "resume_pick",
+                            payload=(-1 if restore_serial is None
+                                     else int(restore_serial)),
+                        )
+                        agreed = min(
+                            (-1 if row is None else int(row)
+                             for row in picks),
+                            default=-1,
+                        )
+                        if agreed < 0:
+                            restore, restore_serial = None, None
+                        elif agreed != restore_serial:
+                            restore = store.load(agreed)
+                            restore_serial = (
+                                None if restore is None else agreed
+                            )
+                    if restore is not None:
+                        telem.registry.counter(
+                            "solve_ckpt_resumed_total"
+                        ).inc()
+                        note_event(
+                            f"resumed from solve checkpoint serial "
+                            f"{restore_serial} ({W} row(s) already "
+                            "written)"
+                        )
+                        if pod_markers:
+                            sys.stderr.write(
+                                f"SART_POD_POINT resume "
+                                f"serial={restore_serial}\n"
+                            )
+                            sys.stderr.flush()
+
                 batcher = ContinuousBatcher(
                     solver, lanes=K,
                     on_result=sched_result, on_failed=record_failed,
                     stop_check=stop_now, on_event=degrade_event,
                     isolate=isolate, integrity_policy=sdc_policy,
                     step_trace=bool(args.profile_dir),
+                    ckpt_stride=args.solve_ckpt_stride or None,
+                    ckpt_sink=ckpt_sink, stride_barrier=stride_barrier,
+                    restore=restore,
+                    restore_emitted=W if restore is not None else 0,
                 )
                 # ONE shared iterator: the OOM fallback must continue the
                 # same stream the batcher was draining, not re-iterate the
                 # prefetcher — a fresh FramePrefetcher generator would
                 # block forever on the already-consumed end sentinel
-                frames_iter = iter(frames)
+                if restore is not None:
+                    # frames the checkpoint holds in-flight (restored
+                    # lanes, awaiting-recompute slots, buffered results)
+                    # must not re-enter from the stream — they would be
+                    # solved twice and the reorder buffer would jam
+                    held = np.asarray(
+                        sched_held_ftimes(restore, W), np.float64
+                    )
+                    frames_iter = iter(
+                        item for item in frames
+                        if not (held.size and np.any(
+                            np.abs(held - item[1]) <= 1e-12))
+                    )
+                else:
+                    frames_iter = iter(frames)
                 stats = batcher.run(frames_iter)
                 if stats.interrupted:
                     stop_state["interrupted"] = True
@@ -1526,8 +1689,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                     if idx % stop_stride == 0 and stop_now():
                         # per-frame boundary stop (the serial loop's
                         # group size is 1): already-written frames are
-                        # flushed on exit, the rest resume later
+                        # flushed on exit, the rest resume later. The
+                        # AGREED boundary is pinned into every host's
+                        # summary: the signal lands at different
+                        # instants per host and the multihost poll is
+                        # strided, so a host's local view of "where the
+                        # stop happened" can be up to stop_stride-1
+                        # frames off the pod's — the summaries must all
+                        # name the one boundary the pod stopped at.
                         stop_state["interrupted"] = True
+                        note_event(
+                            f"stop agreed at frame boundary {idx}"
+                        )
                         break
                     if isinstance(item, FrameFailure):
                         record_failed(item.time, item.camera_times,
@@ -1638,6 +1811,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         if primary and (summary.n_failed or summary.had_retries()
                         or summary.events or interrupted or args.timing):
             print(summary.format())
+        # End-of-run pod rendezvous (file-mode pods): a worker that died
+        # after its last frame — or between the frame loop and here —
+        # must surface as PodBarrierTimeout naming the host, not leave
+        # the survivors' summaries silently unaccounted.
+        if _os.environ.get("SART_POD_BARRIER_DIR") \
+                and mh.pod_identity()[1] > 1:
+            mh.pod_barrier("finalize")
         # Telemetry artifact fan-out: every process reaches this point on
         # the completed path (interrupted runs included — the stop
         # boundary is agreed collectively), so the multi-host counter
@@ -1645,8 +1825,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         # configured (sink config must be pod-uniform, like the rest of
         # the command line) — is safe here and only here; exception
         # paths write a local-only artifact from the finally block
-        # below. With no sink configured this is a true no-op.
-        telem.finalize(summary, multihost=args.multihost, primary=primary)
+        # below. With no sink configured this is a true no-op. The
+        # allgather is deadline-bounded (the end-of-run collective is a
+        # pod rendezvous like any other).
+        telem.finalize(
+            summary, multihost=args.multihost, primary=primary,
+            allgather=(mh.deadline_allgather() if args.multihost
+                       else None),
+        )
         if interrupted:
             # graceful preemption stop (docs/RESILIENCE.md §5): the
             # in-flight group drained, the writer flushed, the voxel map
@@ -1677,6 +1863,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         # infrastructure exit, file resumable
         abort["reason"] = f"watchdog abort: {err}"
         print(f"Aborted by the hang watchdog: {err}", file=sys.stderr)
+        return EXIT_INFRASTRUCTURE
+    except mh.PodBarrierTimeout as err:
+        # a pod rendezvous gave up on a dead or wedged peer: every
+        # survivor converges to the same infrastructure exit within the
+        # barrier deadline, and the crash bundle (written in the finally
+        # below from abort["reason"]) names the missing host — the
+        # runbook's first question (docs/RESILIENCE.md §11)
+        abort["reason"] = f"pod barrier failure: {err}"
+        print(f"Aborted at a pod barrier: {err}", file=sys.stderr)
         return EXIT_INFRASTRUCTURE
     except OutputWriteError as err:
         # a solution-file flush failed mid-run; the file is resumable up

@@ -161,6 +161,9 @@ def status_snapshot(blocking: bool = True, **extra) -> dict:
         "schema": schema.SCHEMA_VERSION,
         "unix": round(time.time(), 3),
         "pid": os.getpid(),
+        # pod identity ("k/n", parallel/multihost.export_pod_identity):
+        # which host of a pod this snapshot/crash bundle describes
+        "host": os.environ.get("SART_POD_PROCESS"),
         "frames_done": int(watchdog.frames_done()),
         "last_beacon": {
             "phase": phase,

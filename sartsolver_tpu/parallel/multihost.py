@@ -18,13 +18,25 @@ multi-host; this module supplies the pieces that are host-topology-aware:
 - :func:`make_global` / :func:`fetch` — stage host data into a global
   sharded array and gather device results back, working identically in
   single- and multi-process runs.
+- :func:`pod_barrier` / :func:`agree_stop` — deadline-bounded pod
+  rendezvous with per-host liveness beacons: a dead or wedged peer is
+  *detected and agreed upon* (``PodBarrierTimeout`` naming the missing
+  host) instead of wedging every survivor inside a collective until
+  the watchdog's hard abort (docs/RESILIENCE.md §11). The same barrier
+  runs over a shared directory (``SART_POD_BARRIER_DIR``) for the
+  fake-pod chaos/test harness, where N single-process CLI workers model
+  a pod without multi-process XLA collectives.
 """
 
 from __future__ import annotations
 
 import functools
+import json
 import os
-from typing import Dict, List, Optional
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -548,14 +560,20 @@ def read_and_shard_rtm(
                 arrays.extend(bufs[j] for j, _ in sorted(cols))
         return arrays
 
-    if serialize and jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-
+    pod_index, pod_count = pod_identity()
+    if serialize and pod_count > 1:
+        # pod-aware turns: the same HDD-friendly round-robin, but each
+        # inter-turn rendezvous is the deadline-bounded pod barrier — a
+        # host that dies mid-ingest is detected here, not hung on
         arrays = []
-        for turn in range(jax.process_count()):
-            if turn == jax.process_index():
+        for turn in range(pod_count):
+            if turn == pod_index:
+                if os.environ.get("SART_TEST_POD_MARKERS"):
+                    # chaos-harness kill window: mid-RTM-ingest turn
+                    sys.stderr.write(f"SART_POD_POINT ingest turn={turn}\n")
+                    sys.stderr.flush()
                 arrays = read_my_blocks()
-            multihost_utils.sync_global_devices(f"sart_rtm_read_turn_{turn}")
+            pod_barrier(f"rtm_read_turn_{turn}")
     else:
         arrays = read_my_blocks()
 
@@ -705,6 +723,294 @@ def broadcast_resume_state(state, nvoxel: int, error: Optional[str] = None):
     return ResumeState(times, last)
 
 
+# ---------------------------------------------------------------------------
+# pod fault tolerance: identity, liveness, deadline-bounded barriers
+# ---------------------------------------------------------------------------
+
+# Beacon phase announced while waiting in a pod barrier: keeps the hang
+# watchdog quiet during a legitimately slow peer's turn (the barrier's
+# OWN deadline governs dead-peer detection — the killdrill contract is
+# "exit 3 via the barrier deadline, not the watchdog release valve") and
+# gives the heartbeat line a truthful "where is it".
+PHASE_POD_BARRIER = "pod.barrier"
+
+# Liveness-beacon refresh throttle (seconds): once per second is plenty
+# for deadlines measured in tens of seconds, and keeps the per-frame
+# beacon tap to at most 1 Hz of advisory file touches.
+_ALIVE_THROTTLE = 1.0
+
+_stop_seq = 0  # agree_stop barrier sequence (same cadence on every host)
+
+
+class PodBarrierTimeout(RuntimeError):
+    """A pod rendezvous point gave up waiting on one or more peers.
+
+    ``missing`` holds the pod indices that never arrived (empty when the
+    underlying jax collective wedged without per-host attribution). The
+    message is what lands in the crash bundle / abort reason — it names
+    the missing host(s), which is the runbook's first question."""
+
+    def __init__(self, name: str, missing, timeout: float):
+        self.name = name
+        self.missing = list(missing)
+        self.timeout = timeout
+        who = (", ".join(f"h{j}" for j in self.missing)
+               if self.missing else "unknown (collective wedged)")
+        super().__init__(
+            f"pod barrier {name!r} timed out after {timeout:g}s; "
+            f"missing host(s): {who}"
+        )
+
+
+def pod_identity() -> Tuple[int, int]:
+    """``(index, count)`` of this process within the pod.
+
+    ``SART_POD_PROCESS`` (``k/n``) wins when set — exported by
+    :func:`export_pod_identity` after runtime init so jax-free modules
+    (watchdog heartbeat, fault arming) agree with jax, and set directly
+    by the fake-pod harness where N single-process workers model a pod.
+    Otherwise the jax runtime's process index/count."""
+    raw = os.environ.get("SART_POD_PROCESS", "")
+    if raw:
+        try:
+            k, _sep, n = raw.partition("/")
+            return int(k), max(int(n) if n else 1, 1)
+        except ValueError:
+            pass  # malformed: fall through to the runtime's answer
+    return jax.process_index(), jax.process_count()
+
+
+def export_pod_identity() -> Tuple[int, int]:
+    """Publish this process's pod identity into the environment.
+
+    Called once after :func:`initialize`: jax-free consumers (the
+    heartbeat's ``host=`` field, ``faults.pod_index`` for ``site@i``
+    qualifiers) read the env, so it must be set before faults arm —
+    re-arming (``faults.reset``) here makes pod-qualified ``SART_FAULT``
+    entries correct even when something touched the registry earlier."""
+    index, count = pod_identity()
+    if count > 1 and not os.environ.get("SART_POD_PROCESS"):
+        os.environ["SART_POD_PROCESS"] = f"{index}/{count}"
+        from sartsolver_tpu.resilience import faults
+
+        faults.reset()
+    return index, count
+
+
+def barrier_timeout() -> float:
+    """Default pod-barrier deadline in seconds (``SART_POD_BARRIER_
+    TIMEOUT``, default 300 — generously above any legitimate rendezvous
+    gap except a serialized ingest turn, which passes its own). 0
+    disables the deadline (wait forever: the pre-barrier behavior)."""
+    raw = os.environ.get("SART_POD_BARRIER_TIMEOUT", "300")
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        print(f"sartsolve: ignoring malformed SART_POD_BARRIER_TIMEOUT="
+              f"{raw!r} (using 300)", file=sys.stderr)
+        return 300.0
+
+
+def _timeout_raise(name: str, missing, timeout: float) -> None:
+    from sartsolver_tpu.obs import metrics
+
+    metrics.get_registry().counter("pod_barrier_timeouts_total").inc()
+    raise PodBarrierTimeout(name, missing, timeout)
+
+
+def _touch_alive(bdir: str, index: int) -> None:
+    from sartsolver_tpu.utils import atomicio
+
+    try:
+        atomicio.write_atomic(
+            os.path.join(bdir, f"alive.h{index}"),
+            f"{time.time():.3f}\n", fsync=False,
+        )
+    except OSError:
+        pass  # liveness is advisory; the arrival file is authoritative
+
+
+def _alive_age(bdir: str, j: int) -> Optional[float]:
+    """Seconds since host ``j`` last refreshed its liveness beacon, or
+    None when it never wrote one (never started, or already dead)."""
+    try:
+        return max(time.time() - os.path.getmtime(
+            os.path.join(bdir, f"alive.h{j}")
+        ), 0.0)
+    except OSError:
+        return None
+
+
+def install_pod_liveness() -> None:
+    """Refresh this host's liveness beacon file from the watchdog beacon
+    stream (throttled to :data:`_ALIVE_THROTTLE`). File-mode pods only;
+    a real jax pod's liveness is the collective itself."""
+    bdir = os.environ.get("SART_POD_BARRIER_DIR")
+    if not bdir:
+        return
+    index, count = pod_identity()
+    if count <= 1:
+        return
+    from sartsolver_tpu.resilience import watchdog
+
+    last = [0.0]
+
+    def tap(_phase: str, _serial: int, now: float, _ident: int) -> None:
+        if now - last[0] >= _ALIVE_THROTTLE:
+            last[0] = now
+            _touch_alive(bdir, index)
+
+    _touch_alive(bdir, index)
+    watchdog.add_beacon_tap("pod.liveness", tap)
+
+
+def _file_barrier(bdir: str, name: str, index: int, count: int,
+                  payload, timeout: float) -> list:
+    """Directory-backed barrier: arrive (atomic per-host file carrying
+    ``payload``), then wait for every peer's arrival file.
+
+    Dead-peer detection: once the deadline passes, a missing peer whose
+    liveness beacon is at least a deadline stale (or absent) is declared
+    dead. A missing peer whose beacon stays fresh (alive but slow —
+    mid-compile, long ingest turn) extends the wait, hard-capped at 4x
+    the deadline so two hosts wedged in *different* barriers still
+    converge to exit-3 instead of waiting on each other forever."""
+    from sartsolver_tpu.resilience import watchdog
+    from sartsolver_tpu.utils import atomicio
+
+    os.makedirs(bdir, exist_ok=True)
+    safe = name.replace(os.sep, "_")
+    atomicio.write_atomic(
+        os.path.join(bdir, f"{safe}.h{index}.json"),
+        json.dumps(payload), fsync=False,
+    )
+    _touch_alive(bdir, index)
+    start = time.monotonic()
+    last_note = start
+    while True:
+        missing = [
+            j for j in range(count)
+            if j != index and not os.path.exists(
+                os.path.join(bdir, f"{safe}.h{j}.json")
+            )
+        ]
+        if not missing:
+            break
+        now = time.monotonic()
+        if now - last_note >= _ALIVE_THROTTLE:
+            last_note = now
+            _touch_alive(bdir, index)
+            watchdog.beacon(PHASE_POD_BARRIER)
+        if timeout > 0 and now - start >= timeout:
+            dead = [
+                j for j in missing
+                if (_alive_age(bdir, j) or float("inf")) >= timeout
+            ]
+            if dead or now - start >= 4 * timeout:
+                _timeout_raise(name, dead or missing, timeout)
+        time.sleep(0.05)
+    rows: list = []
+    for j in range(count):
+        if j == index:
+            rows.append(payload)
+            continue
+        try:
+            with open(os.path.join(bdir, f"{safe}.h{j}.json")) as f:
+                rows.append(json.loads(f.read()))
+        except (OSError, ValueError):
+            rows.append(None)  # arrival seen but payload torn: benign
+    return rows
+
+
+def _deadline_collective(name: str, fn, timeout: float):
+    """Run a jax collective with a deadline: the collective blocks in C
+    (the watchdog's async interrupt cannot reach it), so it runs in a
+    bounded daemon thread — on timeout the survivors raise
+    :class:`PodBarrierTimeout` (per-host attribution unavailable at this
+    layer; the barrier name still localizes the rendezvous)."""
+    if timeout <= 0:
+        return fn()
+    result: dict = {}
+    done = threading.Event()
+
+    def run() -> None:
+        try:
+            result["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 - re-raised below
+            result["err"] = err
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"sart-pod-{name}", daemon=True)
+    t.start()
+    done.wait(timeout)
+    if not done.is_set():
+        _timeout_raise(name, [], timeout)
+    if "err" in result:
+        raise result["err"]
+    return result.get("value")
+
+
+def pod_barrier(name: str, payload=None,
+                timeout: Optional[float] = None) -> list:
+    """Deadline-bounded pod rendezvous; returns every host's ``payload``
+    (index-ordered; None rows where a payload is unavailable).
+
+    Single-process pods return ``[payload]`` with no I/O. File-mode pods
+    (``SART_POD_BARRIER_DIR``) run the directory barrier — which doubles
+    as a tiny allgather. Real jax pods synchronize via
+    ``sync_global_devices`` under :func:`_deadline_collective`; payloads
+    are not exchanged there (use a dedicated collective for data).
+    Barrier names must be unique per rendezvous instance within a run
+    incarnation (stride/sequence numbers do this).
+
+    Named fault site ``pod.barrier``: a ``hang``/``error`` fault here
+    drills exactly the wedged-rendezvous path."""
+    index, count = pod_identity()
+    if count <= 1:
+        return [payload]
+    from sartsolver_tpu.resilience import faults
+
+    faults.fire(faults.SITE_POD_BARRIER)
+    if timeout is None:
+        timeout = barrier_timeout()
+    bdir = os.environ.get("SART_POD_BARRIER_DIR")
+    if bdir:
+        return _file_barrier(bdir, name, index, count, payload, timeout)
+    if jax.process_count() <= 1:
+        # pod identity claims peers but no coordination seam exists
+        # (SART_POD_PROCESS set without a barrier dir): degrade to local
+        return [payload if j == index else None for j in range(count)]
+    from jax.experimental import multihost_utils as mhu
+
+    _deadline_collective(
+        name, lambda: mhu.sync_global_devices(f"sart_pod_{name}"), timeout
+    )
+    return [None] * count
+
+
+def deadline_allgather():
+    """An obs-finalize ``allgather`` bounded by the pod barrier deadline
+    (None on single-process runs — obs/run.py then skips aggregation).
+    The end-of-run metrics allgather is a pod rendezvous like any other:
+    a host that died after its last frame must not wedge the survivors'
+    artifact write."""
+    if jax.process_count() == 1:
+        return None
+    from jax.experimental import multihost_utils as mhu
+
+    timeout = barrier_timeout()
+
+    def gather(buf):
+        return _deadline_collective(
+            "metrics_allgather",
+            lambda: np.asarray(mhu.process_allgather(buf)),
+            timeout,
+        )
+
+    return gather
+
+
 def agree_stop(local_stop: bool) -> bool:
     """Unanimous-boundary stop agreement for graceful preemption.
 
@@ -713,17 +1019,33 @@ def agree_stop(local_stop: bool) -> bool:
     only its *own* flag it could stop one frame group before or after its
     peers, leaving the others wedged inside a collective
     (resilience/shutdown.py). The CLI therefore polls this at every
-    group boundary: a one-int host allgather (main thread, same cadence
-    on every process — the frame streams are identical by construction),
+    group boundary: a one-int exchange (main thread, same cadence on
+    every process — the frame streams are identical by construction),
     any process's flag stops them all at the SAME boundary. Single
-    process: the local flag, no collective."""
-    if jax.process_count() == 1:
+    process: the local flag, no collective. The exchange is deadline-
+    bounded (:func:`pod_barrier` file mode / :func:`_deadline_collective`
+    over the allgather), so a peer that died between boundaries surfaces
+    as :class:`PodBarrierTimeout` instead of a wedge."""
+    global _stop_seq
+    index, count = pod_identity()
+    if count <= 1:
+        return bool(local_stop)
+    if os.environ.get("SART_POD_BARRIER_DIR"):
+        _stop_seq += 1
+        rows = pod_barrier(f"agree_stop.{_stop_seq}",
+                           payload=1 if local_stop else 0)
+        return any(bool(r) for r in rows if r is not None)
+    if jax.process_count() <= 1:
         return bool(local_stop)
     from jax.experimental import multihost_utils as mhu
 
-    flags = np.asarray(mhu.process_allgather(
-        np.asarray([1 if local_stop else 0], np.int32)
-    ))
+    flags = _deadline_collective(
+        "agree_stop",
+        lambda: np.asarray(mhu.process_allgather(
+            np.asarray([1 if local_stop else 0], np.int32)
+        )),
+        barrier_timeout(),
+    )
     return bool(flags.any())
 
 

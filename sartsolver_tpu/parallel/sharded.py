@@ -1502,6 +1502,115 @@ class DistributedSARTSolver:
         lane_state.norms = norms
         lane_state._repack()
 
+    def _sched_ckpt_sig(self) -> str:
+        """Configuration signature stored in solve checkpoints: a resume
+        under different solver/mesh knobs would restore lane state whose
+        meaning changed (dtype, momentum carries, subset stacking, padded
+        shapes) — the restore refuses instead of corrupting."""
+        opts = self.opts
+        return "|".join(str(v) for v in (
+            opts.dtype, opts.rtm_dtype, opts.momentum,
+            int(opts.logarithmic), opts.os_subsets, opts.schedule_stride,
+            self.padded_npixel, self.padded_nvoxel,
+        ))
+
+    def export_sched_lanes(self, lane_state: SchedLaneState) -> dict:
+        """Host snapshot of the full lane state for the in-solve pod
+        checkpoint (resilience/podckpt.py): every ``SchedState``
+        component materialized host-side bit-exactly, plus the per-lane
+        fp64 norms the device cannot carry. Addressable-shards only
+        (``np.asarray``) — exactly the scheduler path's domain: the
+        continuous batcher is single-process per pod worker, and the
+        real-multihost frame loop is the classic (non-sched) path."""
+        st = lane_state.state
+        return {
+            "sig": self._sched_ckpt_sig(),
+            "lanes": int(lane_state.lanes),
+            "norms": np.asarray(lane_state.norms, np.float64),
+            "state": {
+                name: (None if getattr(st, name) is None
+                       else np.asarray(getattr(st, name)))
+                for name in SchedState._fields
+            },
+        }
+
+    def restore_sched_lanes(self, exported: dict,
+                            kill_lanes=()) -> SchedLaneState:
+        """Re-stage an :meth:`export_sched_lanes` snapshot as live lane
+        state — the resume-side half of the in-solve checkpoint.
+
+        Staging mirrors :meth:`sched_lanes` exactly (same ``_stage``
+        calls, same specs, same dtypes — the exported arrays carry the
+        device dtypes bit-exactly), so the restored state keys the SAME
+        compiled stride program: the one-compiled-program contract holds
+        across a resume. ``kill_lanes`` are reset to the inert-lane
+        values before staging — lanes whose occupant the killed run
+        already retired *and wrote* (re-running them would duplicate
+        output rows). Raises ValueError when the snapshot's
+        configuration signature does not match this solver."""
+        if exported.get("sig") != self._sched_ckpt_sig():
+            raise ValueError(
+                "Solve checkpoint does not match this solver "
+                f"configuration (checkpoint {exported.get('sig')!r}, "
+                f"solver {self._sched_ckpt_sig()!r})."
+            )
+        B = int(exported["lanes"])
+        st = {k: (None if v is None else np.asarray(v))
+              for k, v in exported["state"].items()}
+        norms = np.array(exported["norms"], np.float64, copy=True)
+        for b in kill_lanes:
+            st["g"][b] = -1.0
+            st["msq"][b] = 1
+            st["f"][b] = 1
+            st["fitted"][b] = 0
+            st["conv"][b] = 0
+            st["it"][b] = 0
+            st["done"][b] = True
+            st["status"][b] = MAX_ITERATIONS_EXCEEDED
+            st["iters"][b] = 0
+            st["ascale"][b] = 1
+            st["recov"][b] = 0
+            if st["obs"] is not None:
+                st["obs"][b] = 0
+            if st["f_prev"] is not None:
+                st["f_prev"][b] = 1
+            if st["fitted_prev"] is not None:
+                st["fitted_prev"][b] = 0
+            if st["tk"] is not None:
+                st["tk"][b] = 1
+            norms[b] = 1.0
+        pix = P(None, PIXEL_AXIS)
+        vox = P(None, VOXEL_AXIS)
+        rep = P()
+        state = SchedState(
+            g=_stage(st["g"], self.mesh, pix),
+            msq=_stage(st["msq"], self.mesh, rep),
+            f=_stage(st["f"], self.mesh, vox),
+            fitted=_stage(st["fitted"], self.mesh, pix),
+            conv=_stage(st["conv"], self.mesh, rep),
+            it=_stage(st["it"], self.mesh, rep),
+            done=_stage(st["done"], self.mesh, rep),
+            status=_stage(st["status"], self.mesh, rep),
+            iters=_stage(st["iters"], self.mesh, rep),
+            ascale=_stage(st["ascale"], self.mesh, rep),
+            recov=_stage(st["recov"], self.mesh, rep),
+            obs=(None if st["obs"] is None else _stage(
+                st["obs"], self.mesh,
+                P(None, None, VOXEL_AXIS) if self.opts.os_subsets > 1
+                else vox,
+            )),
+            f_prev=(None if st["f_prev"] is None
+                    else _stage(st["f_prev"], self.mesh, vox)),
+            fitted_prev=(None if st["fitted_prev"] is None
+                         else _stage(st["fitted_prev"], self.mesh, pix)),
+            tk=(None if st["tk"] is None
+                else _stage(st["tk"], self.mesh, rep)),
+        )
+        lane_state = SchedLaneState(self, state, B)
+        lane_state.norms = norms
+        lane_state._repack()
+        return lane_state
+
     def solve(self, measurement, f0=None, *, local: bool = False) -> SolveResult:
         """Solve one frame — the B=1 case of :meth:`solve_batch`."""
         if local:

@@ -35,6 +35,16 @@ Usage::
 Everything after ``--`` is the serve worker's own flag set (solver
 flags + input files). Exit codes: 0 all invariants hold on every seed;
 1 flag/usage error; 2 an invariant was violated (the report names it).
+
+``--fleet M`` swaps in the fleet campaign (docs/SERVING.md §10), and
+``--pod N`` the pod fault-tolerance campaign (docs/RESILIENCE.md §11):
+N lockstep fake-pod solver workers with in-solve checkpointing, one
+host SIGKILLed inside a seeded stride-barrier or mid-checkpoint
+window, survivors asserted to abort via the coordinated barrier
+deadline (exit 3, crash bundle naming the dead host), then a pod-wide
+``--resume`` judged on byte-identity against the undisturbed pass and
+on stride-progress monotonicity — a resumed pod never repeats a
+checkpointed stride.
 """
 
 from __future__ import annotations
@@ -757,6 +767,413 @@ class FleetCampaign(ChaosCampaign):
 
 
 # ---------------------------------------------------------------------------
+# pod campaign (docs/RESILIENCE.md §11)
+# ---------------------------------------------------------------------------
+
+# fake-pod kill windows, announced on the VICTIM's stderr: "stride" is
+# the pod rendezvous (SART_TEST_POD_MARKERS, printed before the barrier
+# arrival lands — a kill there leaves the peers waiting forever) and
+# "ckpt" is the held-open pre-durability window inside a solve
+# checkpoint append (SART_TEST_SOLVE_CKPT_DELAY — a kill there dies
+# with the record NOT durable, so the pod must fall back one stride).
+_POD_STRIDE_RE = re.compile(r"SART_POD_POINT stride serial=(\d+)")
+_POD_CKPT_RE = re.compile(r"SART_SOLVE_CKPT_POINT pre-append serial=(\d+)")
+_POD_RESUME_RE = re.compile(r"SART_POD_POINT resume serial=(\d+)")
+
+POD_CKPT_STRIDE = 2
+
+
+class PodSchedule:
+    """One seed's pod campaign: which host dies, in which window."""
+
+    WINDOWS = ("stride", "ckpt")
+
+    def __init__(self, seed: int, *, size: int = 2):
+        self.seed = int(seed)
+        self.size = max(2, int(size))
+        rng = np.random.default_rng([0x5A4A, self.seed])
+        self.victim = int(rng.integers(0, self.size))
+        self.window = self.WINDOWS[int(rng.integers(0,
+                                                    len(self.WINDOWS)))]
+        # occurrence counts WINDOW announcements on the victim: stride
+        # markers land every stride, ckpt markers every POD_CKPT_STRIDE
+        # strides — both draws stay well inside even a short run
+        if self.window == "stride":
+            self.occurrence = int(rng.integers(2, 5))
+        else:
+            self.occurrence = int(rng.integers(1, 3))
+
+    def describe(self) -> dict:
+        return {"seed": self.seed, "victim": f"h{self.victim}",
+                "window": f"{self.window}#{self.occurrence}"}
+
+
+class PodCampaign:
+    """Reference pass (N undisturbed lockstep fake-pod workers) + seed
+    passes: SIGKILL one host inside a seeded commit window, assert the
+    survivors abort via the coordinated barrier deadline with a crash
+    bundle naming the dead host, then ``--resume`` the whole pod and
+    judge byte-identity + stride-progress monotonicity (a resumed pod
+    never repeats a checkpointed stride) + checkpoint-counter truth.
+
+    The fake pod is N single-process solver CLIs in lockstep over the
+    same frame stream: ``SART_POD_PROCESS=k/n`` identity, file barriers
+    under a fresh ``SART_POD_BARRIER_DIR`` per pass (stale arrival
+    files from a previous incarnation would satisfy a rendezvous
+    instantly — pods MUST start on an empty barrier dir), and one
+    shared ``SART_SOLVE_CKPT_FILE`` base so the pod-wide consistency
+    intersection sees every host's records."""
+
+    def __init__(self, *, root: str, solve_args: List[str], size: int,
+                 timeout: float, verbose=print):
+        self.root = root
+        self.solve_args = list(solve_args)
+        self.size = max(2, int(size))
+        self.timeout = float(timeout)
+        self.say = verbose
+        self.reference: Optional[Dict[str, "np.ndarray"]] = None
+
+    # ---- process plumbing ------------------------------------------------
+
+    def _pod_env(self, index: int, barrier_dir: str,
+                 extra: Optional[dict] = None) -> dict:
+        env = dict(os.environ)
+        for key in ("SART_FAULT", "SART_TEST_POD_MARKERS",
+                    "SART_TEST_SOLVE_CKPT_DELAY", "SART_SOLVE_CKPT_FILE"):
+            env.pop(key, None)
+        env["PYTHONUNBUFFERED"] = "1"  # the kill plan watches live lines
+        env["SART_POD_PROCESS"] = f"{index}/{self.size}"
+        env["SART_POD_BARRIER_DIR"] = barrier_dir
+        # short deadline: the drill asserts the barrier (not the hang
+        # watchdog) detects the dead peer, and CI should not idle long
+        env.setdefault("SART_POD_BARRIER_TIMEOUT", "30")
+        env.update(extra or {})
+        return env
+
+    def _solve_cmd(self, outfile: str, *extra: str) -> List[str]:
+        return [sys.executable, "-m", "sartsolver_tpu.cli",
+                "-o", outfile, *self.solve_args, *extra]
+
+    def _outputs(self, pass_dir: str) -> List[str]:
+        return [os.path.join(pass_dir, f"out_h{k}.h5")
+                for k in range(self.size)]
+
+    @staticmethod
+    def _barrier_dir(pass_dir: str, name: str) -> str:
+        path = os.path.join(pass_dir, name)
+        os.makedirs(path, exist_ok=True)
+        if os.listdir(path):  # pragma: no cover - reused campaign root
+            raise CampaignError(
+                f"pod barrier dir {path} is not empty — stale arrival "
+                "files would satisfy rendezvous instantly"
+            )
+        return path
+
+    # ---- reference pass --------------------------------------------------
+
+    def run_reference(self) -> None:
+        ref_dir = os.path.join(self.root, "podref")
+        os.makedirs(ref_dir, exist_ok=True)
+        bdir = self._barrier_dir(ref_dir, "barriers")
+        outs = self._outputs(ref_dir)
+        self.say(f"chaos: pod reference pass ({self.size} hosts) in "
+                 f"{ref_dir}")
+        procs = [
+            subprocess.Popen(self._solve_cmd(outs[k]),
+                             env=self._pod_env(k, bdir),
+                             stdout=subprocess.DEVNULL,
+                             stderr=subprocess.PIPE, text=True)
+            for k in range(self.size)
+        ]
+        errs = self._drain(procs)
+        for k, proc in enumerate(procs):
+            if proc.returncode != 0:
+                raise CampaignError(
+                    f"pod reference host h{k} exited {proc.returncode}:"
+                    f"\n{errs[k][-4000:]}"
+                )
+        datasets = [_solution_datasets(out) for out in outs]
+        # lockstep sanity: every host solved the identical stream —
+        # the per-host outputs must already agree with each other
+        for k in range(1, self.size):
+            for key in sorted(datasets[0]):
+                if not np.array_equal(datasets[0][key], datasets[k][key]):
+                    raise CampaignError(
+                        f"pod reference hosts h0/h{k} disagree on "
+                        f"solution/{key} — lockstep is broken before "
+                        "any fault was injected"
+                    )
+        self.reference = datasets[0]
+
+    def _drain(self, procs: List[subprocess.Popen]) -> List[str]:
+        """communicate() every worker under one wall-clock guard."""
+        guards = [threading.Timer(self.timeout, p.kill) for p in procs]
+        for g in guards:
+            g.start()
+        try:
+            return [p.communicate()[1] or "" for p in procs]
+        finally:
+            for g in guards:
+                g.cancel()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait(timeout=30)
+
+    # ---- seed pass -------------------------------------------------------
+
+    def run_pod_seed(self, schedule: PodSchedule) -> dict:
+        pass_dir = os.path.join(self.root, f"pod{schedule.seed}")
+        os.makedirs(pass_dir, exist_ok=True)
+        outs = self._outputs(pass_dir)
+        ckpt_base = os.path.join(pass_dir, "pod.solveckpt")
+        chaos_env = {
+            "SART_TEST_POD_MARKERS": "1",
+            "SART_TEST_SOLVE_CKPT_DELAY": "0.4",
+            "SART_SOLVE_CKPT_FILE": ckpt_base,
+        }
+        self.say(f"chaos: pod seed {schedule.seed} "
+                 f"{schedule.describe()}")
+
+        # -- kill pass: one host dies inside the seeded window ------------
+        bdir = self._barrier_dir(pass_dir, "barriers_kill")
+        procs = [
+            subprocess.Popen(
+                self._solve_cmd(outs[k], "--solve_ckpt_stride",
+                                str(POD_CKPT_STRIDE)),
+                env=self._pod_env(k, bdir, chaos_env),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True)
+            for k in range(self.size)
+        ]
+        victim = procs[schedule.victim]
+        want_re = (_POD_STRIDE_RE if schedule.window == "stride"
+                   else _POD_CKPT_RE)
+        victim_lines: List[str] = []
+        killed_serial: List[int] = []
+
+        def watch_victim() -> None:
+            seen = 0
+            for line in victim.stderr:
+                victim_lines.append(line)
+                m = want_re.search(line)
+                if not m:
+                    continue
+                seen += 1
+                if seen < schedule.occurrence:
+                    continue
+                killed_serial.append(int(m.group(1)))
+                victim.kill()
+                break
+            try:  # drain so the dying child never blocks on the pipe
+                victim.stderr.read()
+            except (OSError, ValueError):
+                pass
+
+        watcher = threading.Thread(target=watch_victim, daemon=True)
+        watcher.start()
+        survivors = [p for k, p in enumerate(procs)
+                     if k != schedule.victim]
+        errs = self._drain(survivors)
+        watcher.join(timeout=60)
+        victim.wait(timeout=60)
+        if victim.returncode != -signal.SIGKILL:
+            raise CampaignError(
+                f"pod seed {schedule.seed}: victim h{schedule.victim} "
+                f"exited {victim.returncode} before the kill landed in "
+                f"window {schedule.window}#{schedule.occurrence} — a "
+                "clean exit 0 here usually means the workload is too "
+                "short to reach this seed's window; give the campaign "
+                "more frames:\n"
+                f"{''.join(victim_lines)[-4000:]}"
+            )
+        self.say(f"chaos: pod seed {schedule.seed} SIGKILL "
+                 f"h{schedule.victim} in window {schedule.window}"
+                 f"#{schedule.occurrence} (serial "
+                 f"{killed_serial[0] if killed_serial else '?'})")
+        # every survivor must abort via the coordinated barrier deadline
+        # — exit 3, stderr naming the barrier and the dead host — and
+        # leave a crash bundle whose reason names the missing host
+        for k, (proc, err) in enumerate(zip(survivors, errs)):
+            host = k if k < schedule.victim else k + 1
+            if proc.returncode != EXIT_INFRASTRUCTURE_POD:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: survivor h{host} exited "
+                    f"{proc.returncode}, expected "
+                    f"{EXIT_INFRASTRUCTURE_POD} (the barrier-deadline "
+                    f"abort):\n{err[-4000:]}"
+                )
+            if "pod barrier" not in err \
+                    or f"h{schedule.victim}" not in err:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: survivor h{host} abort "
+                    f"does not name the pod barrier and the dead host "
+                    f"h{schedule.victim}:\n{err[-4000:]}"
+                )
+            bundle_path = f"{outs[host]}.crash.json"
+            try:
+                with open(bundle_path) as f:
+                    bundle = json.load(f)
+            except (OSError, ValueError) as exc:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: survivor h{host} left "
+                    f"no readable crash bundle at {bundle_path}: {exc}"
+                )
+            if f"h{schedule.victim}" not in str(bundle.get("reason")):
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: crash bundle reason "
+                    f"{bundle.get('reason')!r} does not name the dead "
+                    f"host h{schedule.victim}"
+                )
+            if bundle.get("status", {}).get("host") != \
+                    f"{host}/{self.size}":
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: crash bundle host tag "
+                    f"{bundle.get('status', {}).get('host')!r} is not "
+                    f"{host}/{self.size}"
+                )
+
+        # -- resume pass: the whole pod relaunches with --resume ----------
+        bdir = self._barrier_dir(pass_dir, "barriers_resume")
+        arts = [os.path.join(pass_dir, f"resume_h{k}.jsonl")
+                for k in range(self.size)]
+        procs = [
+            subprocess.Popen(
+                self._solve_cmd(outs[k], "--solve_ckpt_stride",
+                                str(POD_CKPT_STRIDE), "--resume",
+                                "--metrics_out", arts[k]),
+                env=self._pod_env(k, bdir, chaos_env),
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True)
+            for k in range(self.size)
+        ]
+        errs = self._drain(procs)
+        for k, proc in enumerate(procs):
+            if proc.returncode != 0:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: resume host h{k} exited "
+                    f"{proc.returncode}:\n{errs[k][-4000:]}"
+                )
+        verdict = self._judge_pod(schedule, outs, arts, errs)
+        verdict["killed_serial"] = (killed_serial[0] if killed_serial
+                                    else None)
+        return verdict
+
+    # ---- pod invariants --------------------------------------------------
+
+    def _judge_pod(self, schedule: PodSchedule, outs: List[str],
+                   arts: List[str], errs: List[str]) -> dict:
+        # 1. byte-identical outputs: every host's resumed file equals
+        # the undisturbed reference
+        for k, out in enumerate(outs):
+            got = _solution_datasets(out)
+            if sorted(got) != sorted(self.reference):
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: h{k} dataset set differs"
+                )
+            for key in sorted(self.reference):
+                if not np.array_equal(got[key], self.reference[key]):
+                    raise CampaignError(
+                        f"pod seed {schedule.seed}: h{k} solution/{key} "
+                        "not byte-identical to the undisturbed run"
+                    )
+        # 2. elastic resume really resumed: every host restored the SAME
+        # checkpoint serial (divergent picks would have desynced the
+        # stride barriers), and for a mid-checkpoint kill that serial is
+        # strictly OLDER than the torn append (the one-stride fallback)
+        resumed: List[int] = []
+        for k, err in enumerate(errs):
+            m = _POD_RESUME_RE.search(err)
+            if not m:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: h{k} did not resume "
+                    f"from a solve checkpoint:\n{err[-4000:]}"
+                )
+            resumed.append(int(m.group(1)))
+        if len(set(resumed)) != 1:
+            raise CampaignError(
+                f"pod seed {schedule.seed}: hosts resumed from "
+                f"divergent serials {resumed}"
+            )
+        # 3. progress monotonicity: a resumed pod never repeats a
+        # checkpointed stride — every post-resume stride serial is
+        # strictly newer than the restored one, strictly increasing
+        post_serials: List[List[int]] = []
+        for k, err in enumerate(errs):
+            serials = [int(m.group(1))
+                       for m in _POD_STRIDE_RE.finditer(err)]
+            post_serials.append(serials)
+            if not serials:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: h{k} resumed without "
+                    "completing a single stride"
+                )
+            if serials[0] <= resumed[0] \
+                    or serials != sorted(set(serials)):
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: h{k} stride serials "
+                    f"{serials} repeat or precede the restored "
+                    f"checkpoint {resumed[0]} — a completed stride "
+                    "was re-run"
+                )
+        # 4. counter truth: each host's metrics artifact validates and
+        # accounts exactly one checkpoint resume plus the checkpoints
+        # the resumed leg itself wrote
+        from sartsolver_tpu.obs.cli import metrics_main
+
+        for k, art in enumerate(arts):
+            if metrics_main(["--check", art]) != 0:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: h{k} metrics artifact "
+                    f"{art} fails sartsolve metrics --check"
+                )
+            counters: Dict[str, float] = {}
+            with open(art) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("type") == "metric" \
+                            and rec.get("kind") == "counter":
+                        counters[rec["name"]] = float(rec.get("value", 0))
+            if counters.get("solve_ckpt_resumed_total") != 1:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: h{k} "
+                    f"solve_ckpt_resumed_total="
+                    f"{counters.get('solve_ckpt_resumed_total')}, "
+                    "expected exactly 1"
+                )
+            # a checkpoint is owed only when the resumed leg completed
+            # a checkpoint-aligned stride — a short tail can finish
+            # before the next multiple of the stride, legitimately
+            # writing none
+            aligned = [s for s in post_serials[k]
+                       if s % POD_CKPT_STRIDE == 0]
+            if aligned and counters.get(
+                    "solve_ckpt_written_total", 0) < 1:
+                raise CampaignError(
+                    f"pod seed {schedule.seed}: h{k} completed "
+                    f"checkpoint-aligned stride(s) {aligned} but wrote "
+                    "no solve checkpoints"
+                )
+        return {
+            **schedule.describe(),
+            "resumed_serial": resumed[0],
+            "hosts": self.size,
+            "verdict": "ok",
+        }
+
+
+# the solver CLI's documented infrastructure-abort code (cli.py); named
+# here so the drill reads as intent, not magic
+EXIT_INFRASTRUCTURE_POD = 3
+
+
+# ---------------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------------
 
@@ -789,6 +1206,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "handoff, and forced session evictions — "
                         "judged fleet-wide (docs/SERVING.md §10). "
                         "0 = single supervised engine (default).")
+    p.add_argument("--pod", type=int, default=0, metavar="N",
+                   help="Run pod campaigns instead: each seed runs N "
+                        "lockstep fake-pod solver workers with in-solve "
+                        "checkpointing, SIGKILLs one host inside a "
+                        "seeded stride-barrier or mid-checkpoint "
+                        "window, asserts the survivors abort via the "
+                        "coordinated barrier deadline naming the dead "
+                        "host, then --resume's the pod and judges "
+                        "byte-identity + stride-progress monotonicity "
+                        "(docs/RESILIENCE.md §11). Everything after -- "
+                        "is the solver's own flag set (needs "
+                        "--batch_frames > 1). 0 = serve campaign "
+                        "(default).")
     p.add_argument("--slo_ms", type=float, default=None,
                    help="Arm the engine SLO pair and assert its burn "
                         "accounting is continuous across restarts.")
@@ -830,6 +1260,51 @@ def chaos_main(argv: Optional[List[str]] = None) -> int:
         print("sartsolve chaos: --fleet needs >= 2 workers (or 0 for "
               "the single-engine campaign).", file=sys.stderr)
         return 1
+    if args.pod < 0 or args.pod == 1:
+        print("sartsolve chaos: --pod needs >= 2 hosts (or 0 for the "
+              "serve campaigns).", file=sys.stderr)
+        return 1
+    if args.pod and args.fleet:
+        print("sartsolve chaos: --pod and --fleet are separate "
+              "campaigns; pick one.", file=sys.stderr)
+        return 1
+    if args.pod:
+        campaign = PodCampaign(
+            root=args.engine_dir, solve_args=serve_args,
+            size=args.pod, timeout=args.timeout,
+        )
+        report = {"seeds": seeds, "pod": args.pod, "passes": []}
+        try:
+            campaign.run_reference()
+            for seed in seeds:
+                verdict = campaign.run_pod_seed(
+                    PodSchedule(seed, size=args.pod)
+                )
+                report["passes"].append(verdict)
+                print(f"chaos: pod seed {seed} OK — killed "
+                      f"{verdict['victim']} in {verdict['window']}, "
+                      f"survivors exited {EXIT_INFRASTRUCTURE_POD} at "
+                      "the barrier deadline, pod resumed from serial "
+                      f"{verdict['resumed_serial']} without repeating "
+                      "a stride, outputs byte-identical")
+        except CampaignError as err:
+            report["verdict"] = "FAILED"
+            report["error"] = str(err)
+            print(f"chaos: INVARIANT VIOLATED — {err}", file=sys.stderr)
+            if args.report:
+                with open(args.report, "w") as f:
+                    json.dump(report, f, indent=2)
+            return 2
+        except subprocess.TimeoutExpired:
+            print(f"chaos: campaign pass exceeded --timeout "
+                  f"{args.timeout:g}s.", file=sys.stderr)
+            return 2
+        report["verdict"] = "ok"
+        print(json.dumps({"chaos": report}))
+        if args.report:
+            with open(args.report, "w") as f:
+                json.dump(report, f, indent=2)
+        return 0
     if args.fleet:
         # >= 2*size requests with DISTINCT tenants: affinity spreads
         # them across shards, and pigeonhole guarantees some worker
@@ -898,6 +1373,7 @@ def chaos_main(argv: Optional[List[str]] = None) -> int:
     return 0
 
 
-__all__ = ["ChaosCampaign", "FleetCampaign", "CampaignError",
-           "FaultSchedule", "FleetSchedule", "chaos_main",
-           "line_window", "FAULT_POOL", "KILL_WINDOWS"]
+__all__ = ["ChaosCampaign", "FleetCampaign", "PodCampaign",
+           "CampaignError", "FaultSchedule", "FleetSchedule",
+           "PodSchedule", "chaos_main", "line_window", "FAULT_POOL",
+           "KILL_WINDOWS", "POD_CKPT_STRIDE"]

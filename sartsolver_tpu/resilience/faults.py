@@ -16,7 +16,12 @@ Arming faults:
   exact same encounters every run. ``count`` caps the number of trips
   (default unlimited); ``prob=1`` with a count gives fully deterministic
   "fail the first N encounters" faults, which is what the test matrix
-  uses.
+  uses. A site may carry a pod qualifier — ``site@i:kind:prob`` — and
+  then arms only on the pod process whose index is ``i``
+  (``SART_POD_PROCESS``, exported by parallel/multihost.py; a
+  single-process run is index 0). One spec, distributed across an
+  entire pod, therefore kills/hangs exactly one chosen host — the
+  pod-aware drill the coordinated failure barriers need.
 - Programmatic: :func:`inject` / :func:`clear_faults`, or the
   :func:`injected` context manager.
 
@@ -88,12 +93,16 @@ SITE_REQUEST_PARSE = "request.parse"    # engine/request.py: payload parse
 SITE_JOURNAL_APPEND = "journal.append"  # engine/journal.py: record append
 SITE_SESSION_ATTACH = "session.attach"  # engine/session.py: frame-stream attach
 SITE_STATE_CHECKPOINT = "state.checkpoint"  # engine/state.py: soft-state save
+# Pod fault-tolerance seams (docs/RESILIENCE.md §11): the in-solve pod
+# checkpoint append and the deadline-bounded pod rendezvous barrier.
+SITE_SOLVE_CHECKPOINT = "solve.checkpoint"  # resilience/podckpt.py: ckpt append
+SITE_POD_BARRIER = "pod.barrier"        # parallel/multihost.py: pod rendezvous
 
 FAULT_SITES = frozenset({
     SITE_FRAME_READ, SITE_RTM_INGEST, SITE_PREFETCH, SITE_DEVICE_PUT,
     SITE_SOLVE, SITE_FLUSH, SITE_MULTIHOST_INIT, SITE_DEVICE_BUFFER,
     SITE_REQUEST_PARSE, SITE_JOURNAL_APPEND, SITE_SESSION_ATTACH,
-    SITE_STATE_CHECKPOINT,
+    SITE_STATE_CHECKPOINT, SITE_SOLVE_CHECKPOINT, SITE_POD_BARRIER,
 })
 
 FAULT_KINDS = ("io", "error", "nan", "hang", "oom", "corrupt")
@@ -145,15 +154,35 @@ _faults: Optional[Dict[str, _Fault]] = None
 _lock = named_lock("resilience.faults")
 
 
+def pod_index() -> int:
+    """This process's pod index (0 on a single-process run).
+
+    Reads ``SART_POD_PROCESS`` (``k/n`` or bare ``k``) — exported by
+    ``parallel/multihost.py`` after runtime init and by the fake-pod
+    chaos harness — so this module stays jax-free. Malformed values read
+    as 0 (a drill env typo must not crash production arming)."""
+    raw = os.environ.get("SART_POD_PROCESS", "")
+    if not raw:
+        return 0
+    try:
+        return int(raw.split("/", 1)[0])
+    except ValueError:
+        return 0
+
+
 def parse_fault_spec(spec: str) -> Dict[str, _Fault]:
     """Parse a ``SART_FAULT`` spec string into armed faults.
 
-    Grammar: comma-separated ``site:kind:prob[:count]`` entries. Raises
-    ``ValueError`` on unknown sites/kinds or malformed numbers — an armed
-    fault that never fires because of a typo would make the whole matrix
-    vacuous.
+    Grammar: comma-separated ``site[@i]:kind:prob[:count]`` entries.
+    Raises ``ValueError`` on unknown sites/kinds or malformed numbers —
+    an armed fault that never fires because of a typo would make the
+    whole matrix vacuous. A ``@i`` pod qualifier restricts the entry to
+    pod process ``i`` (:func:`pod_index`): entries for other hosts are
+    validated (typos still fail loudly on every host) but not armed,
+    and the armed fault is keyed by the bare site name.
     """
     seed = int(os.environ.get("SART_FAULT_SEED", "0"))
+    here = pod_index()
     out: Dict[str, _Fault] = {}
     for entry in spec.split(","):
         entry = entry.strip()
@@ -163,9 +192,23 @@ def parse_fault_spec(spec: str) -> Dict[str, _Fault]:
         if len(parts) not in (3, 4):
             raise ValueError(
                 f"Malformed SART_FAULT entry {entry!r}; expected "
-                "site:kind:prob[:count]."
+                "site[@i]:kind:prob[:count]."
             )
         site, kind, prob_s = parts[0], parts[1], parts[2]
+        target: Optional[int] = None
+        if "@" in site:
+            site, _at, idx_s = site.partition("@")
+            try:
+                target = int(idx_s)
+            except ValueError:
+                raise ValueError(
+                    f"Malformed pod qualifier in SART_FAULT entry "
+                    f"{entry!r}; expected site@<process_index>."
+                ) from None
+            if target < 0:
+                raise ValueError(
+                    f"Pod qualifier must be >= 0, got {target}."
+                )
         if site not in FAULT_SITES:
             raise ValueError(
                 f"Unknown fault site {site!r}; valid: "
@@ -182,6 +225,8 @@ def parse_fault_spec(spec: str) -> Dict[str, _Fault]:
         count = int(parts[3]) if len(parts) == 4 else None
         if count is not None and count < 1:
             raise ValueError(f"Fault count must be >= 1, got {count}.")
+        if target is not None and target != here:
+            continue  # validated, but armed only on the qualified host
         if site in out:
             # one fault per site: a drill spec listing a site twice would
             # silently lose the first entry — loud beats last-wins

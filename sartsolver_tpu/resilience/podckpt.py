@@ -1,0 +1,278 @@
+"""In-solve pod checkpoints: per-host, CRC-checksummed stride snapshots.
+
+The engine checkpoint (engine/state.py) keeps *serving* soft state
+continuous across restarts; this module does the same for *solver*
+state mid-run. At scheduler stride boundaries the continuous batcher
+exports everything the stride loop carries — the device-resident
+``SchedState`` lanes (warm chain, momentum carries ``f_prev``/``tk``,
+divergence-ladder ``recov``, iteration counters), the host-side lane
+bookkeeping and the reorder buffer — and this store appends it as one
+versioned, CRC-checksummed record. A later ``--resume`` restores the
+run at the last stride instead of re-running the Eq. 4 guess and every
+prior sweep (docs/RESILIENCE.md §11).
+
+File format deliberately mirrors engine/state.py: append-only JSONL,
+one self-delimited record per checkpoint::
+
+    {"v": 1, "serial": N, "unix": ..., "crc": CRC32(state-json), "state": {...}}
+
+with the CRC computed over the canonical (``sort_keys``) serialization
+of the ``state`` payload, so a torn tail or a flipped byte silently
+falls back to the previous record. Differences from the engine store:
+
+- **Per-host files.** Each pod process writes
+  ``<base>.h<k>of<n>.jsonl`` (plain ``<base>`` when the pod has one
+  process). A checkpoint serial is *consistent* only when every host
+  file holds a valid record for it — :func:`newest_consistent_serial`
+  is the pod-wide resume point, and a host that died mid-append
+  automatically drops the pod back one stride (the journal torn-tail
+  semantic, applied pod-wide).
+- **Caller-supplied serials.** The stride counter is the serial, so
+  "never repeats a completed stride" is checkable from the files alone.
+- **Array payloads.** ndarrays are embedded as base64 raw bytes with
+  dtype+shape (:func:`encode_state`) — bit-exact round trip, which is
+  what makes a resumed solve byte-identical to an undisturbed one.
+
+Appends go through the shared retry policy under the named fault site
+``solve.checkpoint``; like the engine checkpoint, *permanent* failure
+degrades loudly (the run continues, resume falls back further) instead
+of aborting — checkpoints are an availability optimization, the output
+file remains the correctness backbone.
+
+Deterministic crash window for the pod chaos harness: with
+``SART_TEST_SOLVE_CKPT_DELAY`` set, every append announces
+``SART_SOLVE_CKPT_POINT pre-append serial=N`` on stderr and holds the
+pre-durability window open so a SIGKILL lands mid-checkpoint.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import sys
+import time
+import zlib
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from sartsolver_tpu.resilience import faults
+from sartsolver_tpu.resilience.retry import retry_call
+from sartsolver_tpu.utils import atomicio
+
+SOLVE_CKPT_VERSION = 1
+
+# Valid records kept per host file: the newest (the resume point), one
+# fallback stride (the torn-tail contract needs it), plus one of slack
+# so a compaction racing a reader never narrows the fallback window.
+KEEP_RECORDS = 3
+
+
+def _crc(state_json: str) -> int:
+    return zlib.crc32(state_json.encode("utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# array <-> JSON-safe payload
+# ---------------------------------------------------------------------------
+
+def encode_state(obj):
+    """Recursively convert a state tree into a JSON-safe tree.
+
+    ndarrays become ``{"__nd__": dtype, "shape": [...], "b64": ...}``
+    (raw little-endian bytes, so float64 round-trips bit-exactly —
+    resume byte-identity depends on it); numpy scalars become their
+    Python equivalents; dicts/lists/tuples recurse (tuples come back as
+    lists). Keys must be strings already — JSON would coerce silently.
+    """
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        if arr.dtype.byteorder == ">":  # pragma: no cover - BE hosts only
+            arr = arr.astype(arr.dtype.newbyteorder("<"))
+        # extension dtypes (ml_dtypes bfloat16 etc.) have a .str that
+        # does not round-trip through np.dtype(); their registered NAME
+        # does — raw bytes either way, so the restore stays bit-exact
+        dt = arr.dtype.str
+        try:
+            if np.dtype(dt) != arr.dtype:
+                dt = arr.dtype.name
+        except TypeError:
+            dt = arr.dtype.name
+        return {
+            "__nd__": dt,
+            "shape": list(arr.shape),
+            "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+        }
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {k: encode_state(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [encode_state(v) for v in obj]
+    return obj
+
+
+def decode_state(obj):
+    """Inverse of :func:`encode_state` (tuples come back as lists)."""
+    if isinstance(obj, dict):
+        if "__nd__" in obj:
+            raw = base64.b64decode(obj["b64"])
+            return np.frombuffer(raw, dtype=np.dtype(obj["__nd__"])).reshape(
+                obj["shape"]
+            ).copy()  # writable: restore paths mutate lane bookkeeping
+        return {k: decode_state(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [decode_state(v) for v in obj]
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# per-host store
+# ---------------------------------------------------------------------------
+
+def host_path(base: str, index: int, count: int) -> str:
+    """This host's checkpoint file. Single-process pods use ``base``
+    verbatim — the common CLI case stays one tidy sidecar file."""
+    if count <= 1:
+        return base
+    return f"{base}.h{index}of{count}.jsonl"
+
+
+class SolveCheckpointStore:
+    """Append-only per-host solve checkpoint with torn-tail fallback."""
+
+    def __init__(self, base: str, index: int = 0, count: int = 1):
+        self.base = base
+        self.index = index
+        self.count = count
+        self.path = host_path(base, index, count)
+
+    # ---- write -----------------------------------------------------------
+
+    def save(self, serial: int, state: dict) -> None:
+        """Durably append the stride-``serial`` checkpoint (flush+fsync
+        through the shared retry policy, fault site ``solve.checkpoint``).
+        The caller owns the serial: pass the stride counter, identical
+        on every host of the pod."""
+        state_json = json.dumps(encode_state(state), sort_keys=True)
+        rec = {"v": SOLVE_CKPT_VERSION, "serial": int(serial),
+               "unix": round(time.time(), 3), "crc": _crc(state_json)}
+        # payload embedded as the already-serialized string so the CRC
+        # covers exactly the bytes the loader re-serializes to verify
+        line = (json.dumps(rec)[:-1] + ', "state": ' + state_json + "}\n")
+        delay = os.environ.get("SART_TEST_SOLVE_CKPT_DELAY")
+        if delay:
+            # chaos-harness crash window: a SIGKILL in here dies with the
+            # record NOT durable — the pod resumes one stride earlier
+            sys.stderr.write(
+                f"SART_SOLVE_CKPT_POINT pre-append serial={int(serial)}\n"
+            )
+            sys.stderr.flush()
+            time.sleep(float(delay))
+
+        def write() -> None:
+            faults.fire(faults.SITE_SOLVE_CHECKPOINT)
+            atomicio.append_line(self.path, line)
+
+        retry_call(write, site=faults.SITE_SOLVE_CHECKPOINT,
+                   retry_on=(OSError,))
+        from sartsolver_tpu.obs import metrics
+
+        metrics.get_registry().counter("solve_ckpt_written_total").inc()
+        self._maybe_compact()
+
+    # ---- read ------------------------------------------------------------
+
+    def _valid_records(self) -> Dict[int, Tuple[dict, dict]]:
+        """serial -> (record, ENCODED state) for every valid record in
+        this host's file (later duplicates win)."""
+        out: Dict[int, Tuple[dict, dict]] = {}
+        if not os.path.exists(self.path):
+            return out
+        try:
+            with open(self.path) as f:
+                lines = f.readlines()
+        except OSError:
+            return out
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn append
+            if not isinstance(rec, dict) \
+                    or rec.get("v") != SOLVE_CKPT_VERSION:
+                continue
+            state = rec.get("state")
+            if not isinstance(state, dict):
+                continue
+            if _crc(json.dumps(state, sort_keys=True)) != rec.get("crc"):
+                continue  # corrupt record: fall back
+            out[int(rec.get("serial", 0))] = (rec, state)
+        return out
+
+    def serials(self):
+        """Sorted valid serials in this host's file."""
+        return sorted(self._valid_records())
+
+    def load(self, serial: int) -> Optional[dict]:
+        """The decoded state payload for ``serial``, or None."""
+        rec = self._valid_records().get(int(serial))
+        return None if rec is None else decode_state(rec[1])
+
+    # ---- rotation --------------------------------------------------------
+
+    def _maybe_compact(self) -> None:
+        """Keep the newest :data:`KEEP_RECORDS` valid records (atomic
+        rewrite). Solve checkpoints are large (lane iterates), so the
+        file is compacted on every save once it exceeds the keep count —
+        write amplification is bounded at ``KEEP_RECORDS + 1`` line
+        writes per retained record, and disk stays O(lanes), not
+        O(strides)."""
+        recs = self._valid_records()
+        if len(recs) <= KEEP_RECORDS:
+            return
+        keep = sorted(recs)[-KEEP_RECORDS:]
+        lines = []
+        for serial in keep:
+            rec, state = recs[serial]
+            state_json = json.dumps(state, sort_keys=True)
+            header = {k: rec[k] for k in ("v", "serial", "unix", "crc")}
+            lines.append(
+                json.dumps(header)[:-1] + ', "state": ' + state_json + "}\n"
+            )
+        try:
+            atomicio.write_atomic(self.path, "".join(lines))
+        except OSError:
+            pass  # compaction is advisory; the next save retries
+
+
+# ---------------------------------------------------------------------------
+# pod-wide consistency
+# ---------------------------------------------------------------------------
+
+def newest_consistent_serial(base: str, count: int) -> Optional[int]:
+    """The newest serial valid in EVERY host file, or None.
+
+    This is the pod resume point: a host killed mid-append (torn tail)
+    or before its append (no record) simply drops out of the newest
+    serial's intersection, and the pod falls back one stride — no
+    repair step, no coordinator."""
+    common: Optional[set] = None
+    for index in range(max(count, 1)):
+        store = SolveCheckpointStore(base, index, count)
+        serials = set(store.serials())
+        common = serials if common is None else (common & serials)
+        if not common:
+            return None
+    return max(common) if common else None
+
+
+__all__ = [
+    "SolveCheckpointStore", "SOLVE_CKPT_VERSION", "KEEP_RECORDS",
+    "encode_state", "decode_state", "host_path",
+    "newest_consistent_serial",
+]

@@ -305,6 +305,14 @@ def _write_heartbeat(path: str) -> None:
     try:
         sched = sched_status()
         extra = ""
+        # pod identity (parallel/multihost.export_pod_identity): on a
+        # multi-process run every host writes an otherwise identical
+        # line — host=k/n lets an external supervisor attribute a
+        # stalled pod to the wedged host. Env-read keeps this jax-free;
+        # absent (single-process), the line is byte-unchanged.
+        host = os.environ.get("SART_POD_PROCESS")
+        if host:
+            extra += f" host={host}"
         if sched:
             occ = sched.get("occupancy")
             if occ is not None:

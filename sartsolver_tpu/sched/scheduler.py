@@ -164,9 +164,28 @@ class ContinuousBatcher:
         refill_quantum: Optional[int] = None,
         integrity_policy=None,
         step_trace: bool = False,
+        ckpt_stride: Optional[int] = None,
+        ckpt_sink: Optional[Callable[[int, dict], None]] = None,
+        stride_barrier: Optional[Callable[[int], None]] = None,
+        restore: Optional[dict] = None,
+        restore_emitted: int = 0,
     ):
         if lanes < 1:
             raise ValueError("Lane count must be positive.")
+        # In-solve checkpointing (docs/RESILIENCE.md §11): every
+        # ``ckpt_stride`` strides the full run state — lane SchedState,
+        # host bookkeeping, reorder buffer — is snapshotted and handed
+        # to ``ckpt_sink(serial, snapshot)``. ``restore`` re-enters a
+        # prior snapshot; ``restore_emitted`` is the number of rows the
+        # output file already holds (the killed run kept writing past
+        # the snapshot — anything written is dropped from the restored
+        # state, never re-emitted). ``stride_barrier(serial)`` is the
+        # per-stride pod rendezvous hook (None: single-host, no-op).
+        self._ckpt_stride = int(ckpt_stride) if ckpt_stride else None
+        self._ckpt_sink = ckpt_sink
+        self._stride_barrier = stride_barrier
+        self._restore = restore
+        self._restore_emitted = int(restore_emitted)
         # resilience.integrity.SdcEscalation (or None): a lane retiring
         # with SDC_DETECTED is re-queued once (recompute), then failed as
         # an ordered row; the policy's terminal accounting may raise
@@ -285,13 +304,17 @@ class ContinuousBatcher:
         stats = self._stats = SchedRunStats()
         self._emit_buf = {}
         self._next_emit = 0
-        lane_state = solver.sched_lanes(B)
         it = iter(items)
         exhausted = False
-        free = deque(range(B))
-        occupied = self._occupied = {}  # lane index -> _Slot
         self._sdc_retry = deque()  # slots awaiting their SDC recompute
-        seq = 0
+        if self._restore is not None:
+            lane_state, free, seq = self._apply_restore(stats, B)
+            occupied = self._occupied
+        else:
+            lane_state = solver.sched_lanes(B)
+            free = deque(range(B))
+            occupied = self._occupied = {}  # lane index -> _Slot
+            seq = 0
         t_last = time.perf_counter()
         # request-scoped tracing (serving engine): resolved once per run
         # — None (the CLI default) keeps the stride loop span-free
@@ -355,6 +378,7 @@ class ContinuousBatcher:
                 # (exit 4) would make a supervisor requeue a finished job
                 stats.interrupted = True
             refills = intake()
+            self._seq = seq  # mirrored for the stride-boundary snapshot
             if not occupied and not refills:
                 self._emit_ready()  # trailing FrameFailure rows
                 break
@@ -535,8 +559,178 @@ class ContinuousBatcher:
                 )
                 free.append(lane)
             self._emit_ready()
+            # stride boundary: checkpoint first (a host killed after the
+            # barrier passes has its record durable; one killed inside
+            # the append falls back a stride — the torn-tail contract),
+            # then the pod rendezvous
+            if (self._ckpt_sink is not None and self._ckpt_stride
+                    and stats.strides % self._ckpt_stride == 0):
+                self._ckpt_sink(stats.strides,
+                                self._snapshot(lane_state, stats.strides))
+            if self._stride_barrier is not None:
+                self._stride_barrier(stats.strides)
         self._finalize()
         return stats
+
+    # ---- in-solve checkpointing (docs/RESILIENCE.md §11) -----------------
+
+    @staticmethod
+    def _slot_entry(slot, lane=None) -> dict:
+        ent = {"seq": int(slot.seq), "ftime": slot.ftime,
+               "cam_times": slot.cam_times,
+               "it_prev": int(slot.it_prev),
+               "sdc_retries": int(slot.sdc_retries),
+               "frame": np.asarray(slot.frame)}
+        if lane is not None:
+            ent["lane"] = int(lane)
+        return ent
+
+    def _snapshot(self, lane_state, serial: int) -> dict:
+        """The run state a resume needs, as one checkpoint payload.
+
+        Captured at a stride boundary, where the host holds everything:
+        occupied/awaiting-recompute slots (with their raw frames — a
+        restored lane may still OOM into the classic-loop requeue),
+        the reorder buffer (result entries MATERIALIZED via their
+        idempotent fetchers — the lane buffers they slice are
+        overwritten by later strides), the ordering counters, the stats
+        counters (so serials stay monotonic across incarnations), and
+        the solver's exported lane state. CLI-path only: serving-engine
+        deadlines/trace ids are not carried (the engine's durability is
+        the request journal, not this checkpoint)."""
+        stats = self._stats
+        emit = []
+        for seq_i, (kind, payload, frame) in self._emit_buf.items():
+            if kind == "failed":
+                ftime, cam_times, err = payload
+                emit.append({"seq": int(seq_i), "kind": "failed",
+                             "ftime": ftime, "cam_times": cam_times,
+                             "error": str(err)})
+            else:
+                ftime, cam_times, status, iters, conv, fetcher, ms = payload
+                emit.append({
+                    "seq": int(seq_i), "kind": "result", "ftime": ftime,
+                    "cam_times": cam_times, "status": int(status),
+                    "iters": int(iters), "conv": float(conv),
+                    "row": np.asarray(fetcher()), "ms": float(ms),
+                    "frame": None if frame is None else np.asarray(frame),
+                })
+        return {
+            "serial": int(serial),
+            "lanes": int(self._lanes),
+            "seq": int(self._seq),
+            "next_emit": int(self._next_emit),
+            "stats": {
+                "frames": stats.frames, "solved": stats.solved,
+                "failed": stats.failed, "backfilled": stats.backfilled,
+                "strides": stats.strides, "loop_steps": stats.loop_steps,
+                "useful_iters": stats.useful_iters,
+                "deadline_shed": stats.deadline_shed,
+                "capacity": stats._capacity,
+            },
+            "occupied": [self._slot_entry(slot, lane)
+                         for lane, slot in self._occupied.items()],
+            "sdc_retry": [self._slot_entry(slot)
+                          for slot in self._sdc_retry],
+            "emit": emit,
+            "solver": self._solver.export_sched_lanes(lane_state),
+        }
+
+    def _apply_restore(self, stats, B: int):
+        """Re-enter a :meth:`_snapshot` payload: returns
+        ``(lane_state, free, seq)`` and seeds the emit buffer, occupied
+        map, SDC-retry queue and stats counters.
+
+        ``self._restore_emitted`` (W) reconciles the snapshot with the
+        output file the killed run kept appending to: rows the file
+        already holds are the run's frame-order prefix (the reorder
+        buffer guarantees it), so every restored entry with seq < W is
+        dropped — its lane reset to inert via ``kill_lanes`` — and
+        emission resumes at W. The CLI guarantees W >= the snapshot's
+        next_emit by flushing the writer before each checkpoint append
+        and by falling back a stride otherwise."""
+        snap = self._restore
+        W = self._restore_emitted
+        if int(snap.get("lanes", B)) != B:
+            raise ValueError(
+                f"Solve checkpoint has {snap.get('lanes')} lanes; this "
+                f"run was started with {B} — resume with the same "
+                "--schedule_lanes."
+            )
+        if int(snap["next_emit"]) > W:
+            raise ValueError(
+                f"Solve checkpoint is ahead of the output file "
+                f"({snap['next_emit']} emitted vs {W} rows written) — "
+                "pick an earlier checkpoint."
+            )
+        st = snap["stats"]
+        stats.frames = int(st["frames"])
+        stats.solved = int(st["solved"])
+        stats.failed = int(st["failed"])
+        stats.backfilled = int(st["backfilled"])
+        stats.strides = int(st["strides"])
+        stats.loop_steps = int(st["loop_steps"])
+        stats.useful_iters = int(st["useful_iters"])
+        stats.deadline_shed = int(st["deadline_shed"])
+        stats._capacity = int(st["capacity"])
+        occupied = self._occupied = {}
+        kill_lanes = []
+        for ent in snap["occupied"]:
+            lane = int(ent["lane"])
+            if int(ent["seq"]) < W:
+                # retired AND written by the killed run post-checkpoint
+                kill_lanes.append(lane)
+                stats.frames += 1
+                stats.solved += 1
+                continue
+            slot = _Slot(int(ent["seq"]), np.asarray(ent["frame"]),
+                         ent["ftime"], ent["cam_times"])
+            slot.it_prev = int(ent["it_prev"])
+            slot.sdc_retries = int(ent["sdc_retries"])
+            occupied[lane] = slot
+        for ent in snap["sdc_retry"]:
+            if int(ent["seq"]) < W:
+                stats.frames += 1
+                stats.solved += 1
+                continue
+            slot = _Slot(int(ent["seq"]), np.asarray(ent["frame"]),
+                         ent["ftime"], ent["cam_times"])
+            slot.it_prev = int(ent["it_prev"])
+            slot.sdc_retries = int(ent["sdc_retries"])
+            self._sdc_retry.append(slot)
+        for ent in snap["emit"]:
+            seq_i = int(ent["seq"])
+            if ent["kind"] == "failed":
+                if seq_i < W:
+                    stats.frames += 1
+                    stats.failed += 1
+                    continue
+                self._emit_buf[seq_i] = (
+                    "failed",
+                    (ent["ftime"], ent["cam_times"],
+                     RuntimeError(ent["error"])),
+                    None,
+                )
+            else:
+                if seq_i < W:
+                    stats.frames += 1
+                    continue
+                row = np.asarray(ent["row"])
+                frame = ent.get("frame")
+                self._emit_buf[seq_i] = (
+                    "result",
+                    (ent["ftime"], ent["cam_times"], int(ent["status"]),
+                     int(ent["iters"]), float(ent["conv"]),
+                     (lambda r=row: r), float(ent["ms"])),
+                    None if frame is None else np.asarray(frame),
+                )
+        self._next_emit = max(int(snap["next_emit"]), W)
+        seq = max(int(snap["seq"]), W)
+        lane_state = self._solver.restore_sched_lanes(
+            snap["solver"], kill_lanes=kill_lanes
+        )
+        free = deque(b for b in range(B) if b not in occupied)
+        return lane_state, free, seq
 
     def _requeue(self, occupied) -> List:
         """Un-emitted frames in frame order for the classic-loop
@@ -576,3 +770,19 @@ class ContinuousBatcher:
 
     def _finalize(self) -> None:
         self._occ_gauge.set(round(self._stats.occupancy, 6))
+
+
+def sched_held_ftimes(snapshot: dict, emitted: int) -> List:
+    """Frame times a restored run serves from checkpoint state (in-flight
+    lanes, awaiting-recompute slots, buffered out-of-order results) —
+    the resume path must skip these in the fresh frame stream on top of
+    the already-written filter, or they would be solved twice. Entries
+    below ``emitted`` are dropped at restore (already written), so they
+    are not held either."""
+    W = int(emitted)
+    held = []
+    for key in ("occupied", "sdc_retry", "emit"):
+        for ent in snapshot.get(key, ()):
+            if int(ent["seq"]) >= W:
+                held.append(ent["ftime"])
+    return held

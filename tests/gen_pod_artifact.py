@@ -1,0 +1,127 @@
+"""Generate real --metrics_out artifacts for the pod fault-tolerance
+counters (docs/RESILIENCE.md §11).
+
+Used by ``make bench-smoke``: both artifacts come from the actual CLI,
+not hand-built records —
+
+* ``argv[2]`` (resume artifact): a run is SIGKILLed inside the held-open
+  checkpoint-append window, then resumed to completion; the completed
+  run's artifact must account ``solve_ckpt_written_total`` and
+  ``solve_ckpt_resumed_total``.
+* ``argv[3]`` (barrier artifact): a lone fake-pod host (its peer never
+  launches) is released by the pod-barrier deadline and exits
+  EXIT_INFRASTRUCTURE(3); the abort path still finalizes the artifact,
+  which must account ``pod_barrier_timeouts_total``.
+
+World files land under ``argv[1]``. Exits non-zero when either pass
+misbehaves (wrong exit code, kill window never reached).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_repo = os.path.dirname(_here)
+sys.path.insert(0, _here)  # fixtures.py
+
+import fixtures as fx  # noqa: E402
+
+N_FRAMES = 10
+
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for key in [k for k in env if k.startswith(("SART_POD", "SART_FAULT",
+                                                "SART_TEST", "SART_SOLVE"))]:
+        env.pop(key)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = _repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(extra or {})
+    return env
+
+
+def _cmd(paths, outfile, *extra):
+    return [
+        sys.executable, "-m", "sartsolver_tpu.cli", "-o", outfile,
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "40", "-c", "1e-12",
+        "-l", paths["laplacian"], "-b", "0.001",
+        "--max_cached_solutions", "1", "--no_guess",
+        "--batch_frames", "4",
+        *extra,
+    ]
+
+
+def _kill_at_marker(cmd, env, marker, timeout=300):
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.PIPE, text=True)
+    watchdog = threading.Timer(timeout, proc.kill)
+    watchdog.start()
+    try:
+        for line in proc.stderr:
+            if line.strip() == marker:
+                proc.kill()
+                break
+        else:
+            raise SystemExit(f"gen_pod_artifact: run ended before "
+                             f"marker {marker!r}")
+        proc.stderr.read()
+    finally:
+        watchdog.cancel()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait(timeout=60)
+    if proc.returncode != -signal.SIGKILL:
+        raise SystemExit(f"gen_pod_artifact: kill pass exited "
+                         f"{proc.returncode}, expected SIGKILL")
+
+
+def run(world_dir: str, resume_artifact: str, barrier_artifact: str) -> int:
+    import pathlib
+
+    paths, *_ = fx.write_world(pathlib.Path(world_dir),
+                               with_laplacian=True, n_frames=N_FRAMES)
+
+    # pass 1: kill inside the serial-2 append window (stride 1 makes
+    # serial 1 durable first), then resume with the JSONL sink armed
+    out = os.path.join(world_dir, "pod_metrics.h5")
+    kill_env = _env({"SART_TEST_POD_MARKERS": "1",
+                     "SART_TEST_SOLVE_CKPT_DELAY": "0.6"})
+    _kill_at_marker(_cmd(paths, out, "--solve_ckpt_stride", "1"),
+                    kill_env, "SART_SOLVE_CKPT_POINT pre-append serial=2")
+    done = subprocess.run(
+        _cmd(paths, out, "--solve_ckpt_stride", "1", "--resume",
+             "--metrics_out", resume_artifact),
+        env=kill_env, timeout=600, stdout=subprocess.DEVNULL)
+    if done.returncode != 0:
+        raise SystemExit(f"gen_pod_artifact: resume pass exited "
+                         f"{done.returncode}")
+
+    # pass 2: a lone fake-pod host whose peer never arrives — the
+    # barrier deadline must release it with exit 3, and the abort path
+    # must still finalize the artifact
+    bdir = os.path.join(world_dir, "lone_barrier")
+    os.makedirs(bdir)
+    lone = subprocess.run(
+        _cmd(paths, os.path.join(world_dir, "pod_lone.h5"),
+             "--solve_ckpt_stride", "2", "--metrics_out",
+             barrier_artifact),
+        env=_env({"SART_POD_PROCESS": "0/2",
+                  "SART_POD_BARRIER_DIR": bdir,
+                  "SART_POD_BARRIER_TIMEOUT": "2"}),
+        timeout=600, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    if lone.returncode != 3:
+        raise SystemExit(f"gen_pod_artifact: lone-host pass exited "
+                         f"{lone.returncode}, expected 3")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run(sys.argv[1], sys.argv[2], sys.argv[3]))

@@ -20,6 +20,7 @@ uninterrupted run; a second signal must abort immediately (death by the
 signal).
 """
 
+import json
 import os
 import signal
 import socket
@@ -424,5 +425,185 @@ def test_two_process_kill_then_resume(drill_world):
             p.wait(timeout=60)
     assert procs[0].returncode == -signal.SIGKILL
 
+    _run_mp_pair(paths, out, "--resume")
+    _assert_files_equal(_read_solution(out), want)
+
+# ---------------------------------------------------------------------------
+# Pod legs (docs/RESILIENCE.md §11): a dead pod peer must release the
+# survivors through the barrier DEADLINE (exit 3, bundle naming the
+# missing host) — never hang them — and a whole-pod --resume must land
+# byte-identical. One leg drives the fake-pod file seam mid-stride, one
+# drives the real 2-process runtime mid-RTM-ingest-turn.
+# ---------------------------------------------------------------------------
+
+
+def _pod_cmd(paths, outfile, *extra):
+    # the in-solve checkpoint path rides the continuous-batching
+    # scheduler, which needs --batch_frames > 1 (and therefore
+    # --no_guess); otherwise the same deterministic fixed-iteration
+    # profile as _cli_cmd
+    return [
+        sys.executable, "-m", "sartsolver_tpu.cli", "-o", outfile,
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "40", "-c", "1e-12",
+        "-l", paths["laplacian"], "-b", "0.001",
+        "--max_cached_solutions", "1", "--no_guess",
+        "--batch_frames", "4",
+        *extra,
+    ]
+
+
+def _pod_env(k, n, bdir, ckpt_base):
+    env = _env()
+    for key in [k for k in env if k.startswith(("SART_POD", "SART_FAULT",
+                                                "SART_TEST", "SART_SOLVE"))]:
+        env.pop(key)
+    env["SART_POD_PROCESS"] = f"{k}/{n}"
+    env["SART_POD_BARRIER_DIR"] = bdir
+    env["SART_POD_BARRIER_TIMEOUT"] = "10"
+    env["SART_TEST_POD_MARKERS"] = "1"
+    # ONE shared checkpoint base: per-host output files would otherwise
+    # derive per-host default sidecars and the cross-host consistency
+    # intersection would always be empty
+    env["SART_SOLVE_CKPT_FILE"] = ckpt_base
+    return env
+
+
+def test_pod_kill_mid_stride_survivor_exits_then_resumes(drill_world,
+                                                         tmp_path):
+    """Fake-pod leg: SIGKILL one of two lockstep hosts the moment it
+    announces stride serial 2. The survivor exits EXIT_INFRASTRUCTURE(3)
+    at the next barrier deadline with a crash bundle naming the dead
+    host; a whole-pod --resume on a FRESH barrier dir restores the
+    in-solve checkpoint and finishes byte-identical to a solo run."""
+    import threading
+
+    paths, _, _, _ = drill_world
+    td = str(tmp_path)
+    # the pod flag set differs from the module reference (--batch_frames
+    # scheduler path), so the byte-identity oracle is a solo run with
+    # exactly these flags — fake-pod lockstep computes the same series
+    solo = os.path.join(td, "pod_solo.h5")
+    subprocess.run(_pod_cmd(paths, solo), env=_env(), check=True,
+                   timeout=600, stdout=subprocess.DEVNULL)
+    want = _read_solution(solo)
+
+    ckpt_base = os.path.join(td, "pod.solveckpt")
+    bdir = os.path.join(td, "barrier_kill")
+    os.makedirs(bdir)
+    outs = [os.path.join(td, f"pod_h{k}.h5") for k in range(2)]
+
+    def cmd(k, *x):
+        return _pod_cmd(paths, outs[k], "--solve_ckpt_stride", "2", *x)
+
+    procs = [
+        subprocess.Popen(cmd(k), env=_pod_env(k, 2, bdir, ckpt_base),
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.PIPE, text=True)
+        for k in range(2)
+    ]
+    victim = procs[1]
+
+    def watch_victim():
+        for line in victim.stderr:
+            if line.strip() == "SART_POD_POINT stride serial=2":
+                victim.kill()
+                break
+        victim.stderr.close()
+
+    watcher = threading.Thread(target=watch_victim)
+    watcher.start()
+    try:
+        err0 = procs[0].communicate(timeout=300)[1]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    watcher.join(timeout=60)
+    victim.wait(timeout=60)
+    assert victim.returncode == -signal.SIGKILL
+    # released by the barrier DEADLINE — exit 3, not a hang, not the
+    # watchdog release valve (which would exit 2)
+    assert procs[0].returncode == 3, err0[-4000:]
+    assert "Aborted at a pod barrier" in err0, err0[-4000:]
+    assert "h1" in err0, err0[-4000:]
+    with open(outs[0] + ".crash.json") as f:
+        bundle = json.load(f)
+    assert "h1" in bundle["reason"], bundle["reason"]
+    assert bundle["status"]["host"] == "0/2"
+
+    # elastic resume: fresh EMPTY barrier dir — stale arrival files from
+    # the killed incarnation would satisfy its rendezvous instantly
+    bdir2 = os.path.join(td, "barrier_resume")
+    os.makedirs(bdir2)
+    procs = [
+        subprocess.Popen(cmd(k, "--resume"),
+                         env=_pod_env(k, 2, bdir2, ckpt_base),
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.PIPE, text=True)
+        for k in range(2)
+    ]
+    errs = [p.communicate(timeout=300)[1] for p in procs]
+    assert all(p.returncode == 0 for p in procs), (
+        "\n".join(e[-2000:] for e in errs))
+    for k in range(2):
+        assert "SART_POD_POINT resume serial=" in errs[k], (
+            f"h{k} did not restore a solve checkpoint\n" + errs[k][-2000:])
+        _assert_files_equal(_read_solution(outs[k]), want)
+
+
+def test_pod_mp_kill_mid_ingest_turn_survivor_exits_then_resumes(
+        drill_world, tmp_path):
+    """Real-runtime pod leg: a 2-process run serializes RTM ingest
+    host-by-host; SIGKILL rank 1 inside ITS read turn. Rank 0 must be
+    released by the ``rtm_read_turn`` barrier deadline (exit 3, output
+    naming h1), and a fresh 2-process --resume lands byte-identical."""
+    if not mp_support.multiprocess_collectives_supported():
+        pytest.skip(mp_support.SKIP_REASON)
+    paths, _, _, _ = drill_world
+    td = str(tmp_path)
+    ref_out = os.path.join(td, "mp_pod_ref.h5")
+    _run_mp_pair(paths, ref_out)
+    want = _read_solution(ref_out)
+
+    out = os.path.join(td, "mp_pod_killed.h5")
+    bdir = os.path.join(td, "mp_barrier")
+    os.makedirs(bdir)
+    env = _mp_env()
+    env["SART_POD_BARRIER_DIR"] = bdir
+    env["SART_POD_BARRIER_TIMEOUT"] = "10"
+    env["SART_TEST_POD_MARKERS"] = "1"
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            _mp_cmd(rank, port, out, paths), env=env,
+            stdout=subprocess.PIPE if rank == 0 else subprocess.DEVNULL,
+            stderr=(subprocess.STDOUT if rank == 0 else subprocess.PIPE),
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    try:
+        for line in procs[1].stderr:
+            if line.strip() == "SART_POD_POINT ingest turn=1":
+                procs[1].kill()
+                break
+        else:
+            raise AssertionError("rank 1 exited before its ingest turn")
+        out0 = procs[0].communicate(timeout=120)[0]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.wait(timeout=60)
+    assert procs[1].returncode == -signal.SIGKILL
+    assert procs[0].returncode == 3, out0[-4000:]
+    assert "pod barrier" in out0, out0[-4000:]
+    assert "h1" in out0, out0[-4000:]
+
+    # the kill landed pre-solve: no output rows yet — --resume on the
+    # (possibly absent) file degrades to a fresh run, same bytes
     _run_mp_pair(paths, out, "--resume")
     _assert_files_equal(_read_solution(out), want)

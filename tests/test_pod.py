@@ -1,0 +1,600 @@
+"""Pod fault-tolerance matrix (docs/RESILIENCE.md §11; `make pod`).
+
+Units: solve-checkpoint encode/decode round trips (bit-exact arrays,
+extension dtypes), store compaction and torn-tail/CRC fallback, the
+pod-wide consistency intersection, file-mode barrier payload exchange,
+dead-peer detection with per-host attribution, liveness-extended waits,
+the stop-agreement exchange, and the pod-qualified `site@i` fault
+grammar.
+
+End-to-end: checkpoint-off byte-identity (the tentpole's zero-cost
+contract), a single-process SIGKILL inside the held-open pre-append
+window resumed from the previous durable stride, and the seeded
+`sartsolve chaos --pod 2` campaign on the bounded CI seed pair — one
+mid-checkpoint kill (torn record: the pod falls back one stride) and
+one mid-stride-barrier kill — judged on survivor exit-3 attribution,
+byte-identity and stride-progress monotonicity.
+
+Plus the drift guard: the fault-site table documented in
+docs/RESILIENCE.md §1 must list exactly `faults.FAULT_SITES`.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.obs import metrics
+from sartsolver_tpu.parallel import multihost as mh
+from sartsolver_tpu.resilience import faults, podckpt
+from sartsolver_tpu.resilience.chaos import PodSchedule, chaos_main
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+_DOCS = os.path.join(_REPO, "docs")
+
+# the bounded CI seed pair (make pod): seed 0 kills inside the held-open
+# checkpoint append (torn record -> one-stride fallback), seed 3 at a
+# stride rendezvous; SART_POD_SEEDS widens it
+POD_SEEDS = os.environ.get("SART_POD_SEEDS", "0,3")
+
+N_FRAMES = 10
+
+
+# ---------------------------------------------------------------------------
+# checkpoint payload round trip
+# ---------------------------------------------------------------------------
+
+def test_encode_decode_roundtrip_bit_exact():
+    rng = np.random.default_rng(7)
+    state = {
+        "f": rng.standard_normal((3, 5)),                   # float64
+        "w": rng.standard_normal((4,)).astype(np.float32),
+        "iters": np.arange(6, dtype=np.int32).reshape(2, 3),
+        "mask": np.array([True, False, True]),
+        "scalar": np.float64(0.1 + 0.2),
+        "count": np.int64(41),
+        "nested": {"lanes": [np.arange(3), {"tk": 1.25}], "tag": "s"},
+        "empty": np.zeros((0, 2)),
+        "plain": [1, "two", None, 3.5],
+    }
+    back = podckpt.decode_state(
+        json.loads(json.dumps(podckpt.encode_state(state)))
+    )
+    for key in ("f", "w", "iters", "mask", "empty"):
+        assert back[key].dtype == state[key].dtype
+        assert np.array_equal(back[key], state[key])
+    assert back["scalar"] == state["scalar"]  # exact: raw repr via item()
+    assert back["count"] == 41
+    assert np.array_equal(back["nested"]["lanes"][0], np.arange(3))
+    assert back["nested"]["lanes"][1]["tk"] == 1.25
+    assert back["plain"] == [1, "two", None, 3.5]
+
+
+def test_encode_decode_extension_dtype():
+    """bfloat16 (an ml_dtypes extension dtype whose .str does not
+    round-trip through np.dtype) survives via its registered name."""
+    jnp = pytest.importorskip("jax.numpy")
+    arr = np.asarray([1.5, -2.25, 3.0], dtype=jnp.bfloat16)
+    back = podckpt.decode_state(
+        json.loads(json.dumps(podckpt.encode_state(arr)))
+    )
+    assert back.dtype == arr.dtype
+    assert np.array_equal(back.view(np.uint16), arr.view(np.uint16))
+
+
+def test_decoded_arrays_writable():
+    back = podckpt.decode_state(podckpt.encode_state(np.arange(4)))
+    back[0] = 99  # restore paths mutate lane bookkeeping in place
+    assert back[0] == 99
+
+
+# ---------------------------------------------------------------------------
+# per-host store: save/load, compaction, torn tail, CRC
+# ---------------------------------------------------------------------------
+
+def _state(serial):
+    return {"serial_echo": serial, "f": np.full((2, 2), float(serial))}
+
+
+def test_store_save_load_and_compaction(tmp_path):
+    store = podckpt.SolveCheckpointStore(str(tmp_path / "ck"))
+    for serial in range(1, 7):
+        store.save(serial, _state(serial))
+    # compacted on every save: only the newest KEEP_RECORDS survive
+    assert store.serials() == [4, 5, 6]
+    with open(store.path) as f:
+        assert len([ln for ln in f if ln.strip()]) == podckpt.KEEP_RECORDS
+    snap = store.load(5)
+    assert snap["serial_echo"] == 5
+    assert np.array_equal(snap["f"], np.full((2, 2), 5.0))
+    assert store.load(1) is None  # rotated out
+
+
+def test_store_torn_tail_falls_back(tmp_path):
+    store = podckpt.SolveCheckpointStore(str(tmp_path / "ck"))
+    store.save(1, _state(1))
+    store.save(2, _state(2))
+    with open(store.path, "a") as f:
+        f.write('{"v": 1, "serial": 3, "crc": 123, "state": {"tr')
+    assert store.serials() == [1, 2]  # torn append invisible
+    assert store.load(3) is None
+
+
+@pytest.mark.parametrize("step", [1, 7, 23])
+def test_store_torn_tail_property(tmp_path, step):
+    """Truncating the file at ANY byte inside the last record always
+    falls back to the previous serial — no cut point yields a wrong or
+    extra record (the journal torn-tail semantic)."""
+    base = str(tmp_path / "ck")
+    store = podckpt.SolveCheckpointStore(base)
+    store.save(1, _state(1))
+    store.save(2, _state(2))
+    with open(store.path, "rb") as f:
+        blob = f.read()
+    second = blob.index(b"\n") + 1  # first byte of record 2
+    for cut in range(second, len(blob), step):
+        with open(store.path, "wb") as f:
+            f.write(blob[:cut])
+        got = store.serials()
+        if cut == len(blob) - 1:  # only the newline missing: still valid
+            assert got in ([1], [1, 2])
+        else:
+            assert got == [1], (cut, got)
+    with open(store.path, "wb") as f:
+        f.write(blob)
+    assert store.serials() == [1, 2]
+
+
+def test_store_crc_rejects_tampered_state(tmp_path):
+    store = podckpt.SolveCheckpointStore(str(tmp_path / "ck"))
+    store.save(1, _state(1))
+    store.save(2, _state(2))
+    with open(store.path) as f:
+        lines = f.readlines()
+    # flip a byte of record 2's payload: the header CRC no longer matches
+    lines[-1] = lines[-1].replace(
+        '"state": {', '"state": {"__rot__": 1, ', 1
+    )
+    with open(store.path, "w") as f:
+        f.writelines(lines)
+    assert store.serials() == [1]
+
+
+def test_host_path_layout():
+    assert podckpt.host_path("base", 0, 1) == "base"
+    assert podckpt.host_path("base", 1, 4) == "base.h1of4.jsonl"
+
+
+def test_newest_consistent_serial(tmp_path):
+    base = str(tmp_path / "pod.ck")
+    h0 = podckpt.SolveCheckpointStore(base, 0, 2)
+    h1 = podckpt.SolveCheckpointStore(base, 1, 2)
+    for serial in (1, 2, 3):
+        h0.save(serial, _state(serial))
+    h1.save(1, _state(1))
+    h1.save(2, _state(2))
+    # h1 died before appending serial 3: the pod falls back one stride
+    assert podckpt.newest_consistent_serial(base, 2) == 2
+    # a torn tail on h1's newest drops it from the intersection too
+    with open(h1.path, "rb+") as f:
+        blob = f.read()
+        f.seek(0)
+        f.truncate()
+        f.write(blob[:-10])
+    assert podckpt.newest_consistent_serial(base, 2) == 1
+    # a host with no file at all: nothing is consistent
+    assert podckpt.newest_consistent_serial(base, 3) is None
+    # single-process pods read the plain base path
+    solo = podckpt.SolveCheckpointStore(base)
+    solo.save(9, _state(9))
+    assert podckpt.newest_consistent_serial(base, 1) == 9
+
+
+def test_store_counts_writes():
+    before = metrics.get_registry().counter("solve_ckpt_written_total").value
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        podckpt.SolveCheckpointStore(os.path.join(td, "ck")).save(
+            1, _state(1)
+        )
+    after = metrics.get_registry().counter("solve_ckpt_written_total").value
+    assert after == before + 1
+
+
+# ---------------------------------------------------------------------------
+# pod identity + file-mode barriers
+# ---------------------------------------------------------------------------
+
+def test_pod_identity_env_forms(monkeypatch):
+    monkeypatch.setenv("SART_POD_PROCESS", "1/3")
+    assert mh.pod_identity() == (1, 3)
+    monkeypatch.setenv("SART_POD_PROCESS", "2")  # bare index: count 1
+    assert mh.pod_identity() == (2, 1)
+    monkeypatch.setenv("SART_POD_PROCESS", "x/y")  # malformed: runtime
+    assert mh.pod_identity() == (0, 1)
+    monkeypatch.delenv("SART_POD_PROCESS")
+    assert mh.pod_identity() == (0, 1)
+
+
+def test_barrier_timeout_env(monkeypatch, capsys):
+    monkeypatch.delenv("SART_POD_BARRIER_TIMEOUT", raising=False)
+    assert mh.barrier_timeout() == 300.0
+    monkeypatch.setenv("SART_POD_BARRIER_TIMEOUT", "12.5")
+    assert mh.barrier_timeout() == 12.5
+    monkeypatch.setenv("SART_POD_BARRIER_TIMEOUT", "0")
+    assert mh.barrier_timeout() == 0.0  # deadline disabled
+    monkeypatch.setenv("SART_POD_BARRIER_TIMEOUT", "soon")
+    assert mh.barrier_timeout() == 300.0  # malformed: loud default
+    assert "SART_POD_BARRIER_TIMEOUT" in capsys.readouterr().err
+
+
+def test_file_barrier_exchanges_payloads(tmp_path):
+    bdir = str(tmp_path)
+    rows = [None, None]
+
+    def arrive(k):
+        rows[k] = mh._file_barrier(bdir, "b.one", k, 2, {"host": k}, 30)
+
+    threads = [threading.Thread(target=arrive, args=(k,))
+               for k in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert rows[0] == rows[1] == [{"host": 0}, {"host": 1}]
+
+
+def test_file_barrier_names_dead_host(tmp_path):
+    """A peer that never arrived and has no (or a stale) liveness beacon
+    is declared dead at the deadline, with per-host attribution, and the
+    timeout counter burns."""
+    before = metrics.get_registry().counter(
+        "pod_barrier_timeouts_total").value
+    start = time.monotonic()
+    with pytest.raises(mh.PodBarrierTimeout) as err:
+        mh._file_barrier(str(tmp_path), "b.dead", 0, 3, None, 0.6)
+    elapsed = time.monotonic() - start
+    assert err.value.missing == [1, 2]
+    assert "h1, h2" in str(err.value)
+    assert "b.dead" in str(err.value)
+    assert 0.5 <= elapsed < 5.0  # the deadline, not the 4x hard cap
+    after = metrics.get_registry().counter(
+        "pod_barrier_timeouts_total").value
+    assert after == before + 1
+
+
+def test_file_barrier_fresh_beacon_extends_wait(tmp_path):
+    """A missing peer whose liveness beacon stays fresh (alive but slow)
+    extends the wait past the deadline; once the beacon goes stale the
+    barrier still gives up — bounded by the 4x hard cap."""
+    bdir = str(tmp_path)
+    stop = time.monotonic() + 0.9
+
+    def beacon():
+        while time.monotonic() < stop:
+            mh._touch_alive(bdir, 1)
+            time.sleep(0.1)
+
+    t = threading.Thread(target=beacon, daemon=True)
+    t.start()
+    start = time.monotonic()
+    with pytest.raises(mh.PodBarrierTimeout) as err:
+        mh._file_barrier(bdir, "b.slow", 0, 2, None, 0.4)
+    elapsed = time.monotonic() - start
+    t.join(timeout=10)
+    assert err.value.missing == [1]
+    assert elapsed >= 0.8  # waited past the 0.4s deadline on liveness
+    assert elapsed < 4 * 0.4 + 2.0
+
+
+def test_file_barrier_torn_payload_is_none_row(tmp_path):
+    bdir = str(tmp_path)
+    with open(os.path.join(bdir, "b.torn.h1.json"), "w") as f:
+        f.write('{"half')  # peer arrived, payload torn: benign
+    rows = mh._file_barrier(bdir, "b.torn", 0, 2, {"ok": 1}, 5)
+    assert rows == [{"ok": 1}, None]
+
+
+def test_pod_barrier_single_process_no_io(monkeypatch):
+    monkeypatch.delenv("SART_POD_PROCESS", raising=False)
+    assert mh.pod_barrier("solo", payload=7) == [7]
+
+
+def test_pod_barrier_no_seam_degrades_local(monkeypatch):
+    """Identity claims peers but no coordination seam exists (env typo:
+    SART_POD_PROCESS without a barrier dir on a single-process runtime):
+    degrade to a local answer instead of wedging."""
+    monkeypatch.setenv("SART_POD_PROCESS", "0/2")
+    monkeypatch.delenv("SART_POD_BARRIER_DIR", raising=False)
+    assert mh.pod_barrier("degraded", payload=5) == [5, None]
+
+
+def test_agree_stop_file_mode(monkeypatch, tmp_path):
+    bdir = str(tmp_path)
+    monkeypatch.setenv("SART_POD_PROCESS", "0/2")
+    monkeypatch.setenv("SART_POD_BARRIER_DIR", bdir)
+    monkeypatch.setenv("SART_POD_BARRIER_TIMEOUT", "10")
+    monkeypatch.setattr(mh, "_stop_seq", 0)
+    # peer h1 votes stop at the first boundary exchange
+    with open(os.path.join(bdir, "agree_stop.1.h1.json"), "w") as f:
+        f.write("1")
+    assert mh.agree_stop(False) is True
+    # next boundary: neither stops — sequence numbering keeps the
+    # exchanges distinct within one incarnation
+    with open(os.path.join(bdir, "agree_stop.2.h1.json"), "w") as f:
+        f.write("0")
+    assert mh.agree_stop(False) is False
+
+
+# ---------------------------------------------------------------------------
+# pod-qualified fault grammar
+# ---------------------------------------------------------------------------
+
+def test_fault_pod_qualifier_arms_only_target(monkeypatch):
+    monkeypatch.setenv("SART_POD_PROCESS", "1/2")
+    armed = faults.parse_fault_spec("io.flush@1:io:1")
+    assert set(armed) == {"io.flush"}  # keyed by the bare site
+    assert faults.parse_fault_spec("io.flush@0:io:1") == {}
+    monkeypatch.delenv("SART_POD_PROCESS")
+    assert set(faults.parse_fault_spec("io.flush@0:io:1")) == {"io.flush"}
+
+
+def test_fault_pod_qualifier_validates_on_every_host(monkeypatch):
+    monkeypatch.setenv("SART_POD_PROCESS", "0/2")
+    # a typo'd entry for ANOTHER host still fails loudly here
+    with pytest.raises(ValueError, match="Unknown fault site"):
+        faults.parse_fault_spec("io.flsh@1:io:1")
+    with pytest.raises(ValueError, match="pod qualifier"):
+        faults.parse_fault_spec("io.flush@x:io:1")
+    with pytest.raises(ValueError, match=">= 0"):
+        faults.parse_fault_spec("io.flush@-1:io:1")
+
+
+def test_pod_schedule_deterministic():
+    for seed in range(8):
+        a, b = PodSchedule(seed), PodSchedule(seed)
+        assert a.describe() == b.describe()
+        assert a.victim in (0, 1)
+        assert a.window in PodSchedule.WINDOWS
+    # both kill windows are reachable across a small seed range
+    assert {PodSchedule(s).window for s in range(8)} == {"stride", "ckpt"}
+
+
+# ---------------------------------------------------------------------------
+# documentation drift guard
+# ---------------------------------------------------------------------------
+
+def test_resilience_doc_site_table_matches_registry():
+    """docs/RESILIENCE.md §1's site table is the operator's SART_FAULT
+    reference — it must list exactly the registry's sites (PRs keep
+    adding seams; this is the drift alarm)."""
+    text = open(os.path.join(_DOCS, "RESILIENCE.md")).read()
+    section = text.split("## 1. Fault injection")[1].split("\n## ")[0]
+    documented = set(re.findall(r"^\| `([a-z0-9_.]+)` \|", section,
+                                flags=re.M))
+    assert documented == set(faults.FAULT_SITES), (
+        f"undocumented sites: {sorted(set(faults.FAULT_SITES) - documented)}; "
+        f"stale doc rows: {sorted(documented - set(faults.FAULT_SITES))}"
+    )
+
+
+def test_manual_documents_pod_surface():
+    """The MANUAL's flag/env tables carry the pod fault-tolerance
+    surface: the checkpoint flag, the barrier deadline, and the
+    pod-qualified SART_FAULT grammar."""
+    text = open(os.path.join(_DOCS, "MANUAL.md")).read()
+    for needle in ("--solve_ckpt_stride", "SART_POD_BARRIER_TIMEOUT",
+                   "site[@i]", "SART_SOLVE_CKPT_FILE",
+                   "SART_POD_BARRIER_DIR"):
+        assert needle in text, f"MANUAL.md lost {needle!r}"
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: subprocess drills
+# ---------------------------------------------------------------------------
+
+def _env(extra=None):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    for key in ("SART_FAULT", "SART_POD_PROCESS", "SART_POD_BARRIER_DIR",
+                "SART_TEST_POD_MARKERS", "SART_TEST_SOLVE_CKPT_DELAY",
+                "SART_SOLVE_CKPT_FILE"):
+        env.pop(key, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update(extra or {})
+    return env
+
+
+def _cli_cmd(paths, outfile, *extra):
+    # the scheduler path (--batch_frames > 1 + --no_guess) is the
+    # checkpointable one; fixed iterations keep every run bit-stable
+    return [
+        sys.executable, "-m", "sartsolver_tpu.cli", "-o", outfile,
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "40", "-c", "1e-12",
+        "-l", paths["laplacian"], "-b", "0.001",
+        "--max_cached_solutions", "1", "--no_guess",
+        "--batch_frames", "4",
+        *extra,
+    ]
+
+
+def _read_solution(path):
+    with h5py.File(path, "r") as f:
+        data = {k: np.array(f["solution"][k]) for k in f["solution"]}
+        data["completed"] = int(f["solution"].attrs["completed"])
+    return data
+
+
+def _assert_identical(got, want, what):
+    assert got["completed"] == want["completed"] == N_FRAMES, what
+    for key in sorted(want):
+        if key == "completed":
+            continue
+        assert np.array_equal(got[key], want[key]), f"{what}:{key}"
+
+
+@pytest.fixture(scope="module")
+def pod_world(tmp_path_factory):
+    """Synthetic world + an undisturbed checkpoint-OFF reference run
+    (which also warms the persistent compile cache for every drill)."""
+    td = tmp_path_factory.mktemp("pod_world")
+    paths, *_ = fx.write_world(td, with_laplacian=True, n_frames=N_FRAMES)
+    ref = str(td / "reference.h5")
+    proc = subprocess.run(_cli_cmd(paths, ref), env=_env(),
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    return paths, _read_solution(ref), td
+
+
+def test_checkpoint_off_byte_identity(pod_world):
+    """--solve_ckpt_stride is host-side only: the solution file of a
+    checkpointing run equals the checkpoint-off reference byte for byte,
+    and the sidecar lands where SART_SOLVE_CKPT_FILE points."""
+    paths, want, td = pod_world
+    out = str(td / "ckpt_on.h5")
+    sidecar = str(td / "custom.solveckpt")
+    proc = subprocess.run(
+        _cli_cmd(paths, out, "--solve_ckpt_stride", "2"),
+        env=_env({"SART_SOLVE_CKPT_FILE": sidecar}),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    _assert_identical(_read_solution(out), want, "ckpt-on")
+    store = podckpt.SolveCheckpointStore(sidecar)
+    assert store.serials(), "no solve checkpoints were written"
+    assert len(store.serials()) <= podckpt.KEEP_RECORDS
+
+
+def test_solve_ckpt_stride_validation(pod_world):
+    paths, _want, td = pod_world
+    out = str(td / "invalid.h5")
+    # checkpointing rides the continuous-batching scheduler only
+    proc = subprocess.run(
+        _cli_cmd(paths, out, "--solve_ckpt_stride", "2",
+                 "--no_continuous_batching"),
+        env=_env(), capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1
+    assert "solve_ckpt_stride" in proc.stderr
+
+
+def test_solo_kill_in_ckpt_window_then_resume(pod_world):
+    """Single-process leg: SIGKILL inside the held-open pre-append
+    window of stride serial 2 — the record is NOT durable, --resume
+    restores serial 1 (the previous durable stride), completes
+    byte-identically, and the artifact accounts exactly one resume."""
+    paths, want, td = pod_world
+    out = str(td / "solo_killed.h5")
+    env = _env({"SART_TEST_SOLVE_CKPT_DELAY": "0.6",
+                "SART_TEST_POD_MARKERS": "1"})
+    # stride 1: serial 1 is durable before the serial-2 append the kill
+    # lands in — the resume must restore 1, the one-append fallback
+    proc = subprocess.Popen(
+        _cli_cmd(paths, out, "--solve_ckpt_stride", "1"), env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+    )
+    guard = threading.Timer(300, proc.kill)
+    guard.start()
+    try:
+        for line in proc.stderr:
+            if line.strip() == "SART_SOLVE_CKPT_POINT pre-append serial=2":
+                proc.kill()
+                break
+        else:
+            raise AssertionError("run ended before the serial-2 append")
+        proc.stderr.read()
+    finally:
+        guard.cancel()
+        proc.wait(timeout=60)
+    assert proc.returncode == -signal.SIGKILL
+
+    art = str(td / "solo_resume.jsonl")
+    done = subprocess.run(
+        _cli_cmd(paths, out, "--solve_ckpt_stride", "1", "--resume",
+                 "--metrics_out", art),
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert done.returncode == 0, done.stderr[-4000:]
+    _assert_identical(_read_solution(out), want, "solo-resume")
+    resumed = re.findall(r"SART_POD_POINT resume serial=(\d+)",
+                         done.stderr)
+    assert resumed == ["1"], done.stderr[-2000:]
+    # stride markers are pod-only (the stride_barrier closure needs a
+    # pod seam); single-process progress shows up in the sidecar store:
+    # the resumed run must have appended strides PAST the restored one.
+    store = podckpt.SolveCheckpointStore(out + ".solveckpt", 0, 1)
+    assert store.serials() and max(store.serials()) > 1
+
+    from sartsolver_tpu.obs.cli import metrics_main
+
+    assert metrics_main(["--check", art]) == 0
+    counters = {}
+    with open(art) as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec.get("type") == "metric" and rec.get("kind") == "counter":
+                counters[rec["name"]] = rec["value"]
+    assert counters.get("solve_ckpt_resumed_total") == 1
+    assert counters.get("solve_ckpt_written_total", 0) >= 1
+
+
+def test_pod_chaos_ci_seed_pair(pod_world, tmp_path, capsys,
+                                monkeypatch):
+    """`sartsolve chaos --pod 2` on the CI seed pair: seeded SIGKILL of
+    one fake-pod host mid-checkpoint (seed 0) and mid-stride (seed 3),
+    survivors exit 3 via the coordinated barrier deadline naming the
+    dead host, the pod resumes from the newest consistent checkpoint
+    without repeating a stride, outputs byte-identical."""
+    paths, _want, _td = pod_world
+    # short deadline: the campaign's worker env copies ours (setdefault)
+    monkeypatch.setenv("SART_POD_BARRIER_TIMEOUT", "10")
+    report_path = str(tmp_path / "report.json")
+    rc = chaos_main([
+        "--engine_dir", str(tmp_path / "camp"),
+        "--pod", "2", "--seeds", POD_SEEDS, "--timeout", "280",
+        "--report", report_path, "--",
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+        "--use_cpu", "-m", "40", "-c", "1e-12",
+        "-l", paths["laplacian"], "-b", "0.001",
+        "--max_cached_solutions", "1", "--no_guess",
+        "--batch_frames", "4",
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    report = json.load(open(report_path))
+    assert report["verdict"] == "ok"
+    assert len(report["passes"]) == len(POD_SEEDS.split(","))
+    for verdict in report["passes"]:
+        assert verdict["verdict"] == "ok"
+        assert verdict["hosts"] == 2
+        assert verdict["resumed_serial"] >= 1
+        if verdict["window"].startswith("ckpt"):
+            # killed mid-append: that serial never became durable
+            assert verdict["resumed_serial"] < verdict["killed_serial"]
+
+
+def test_pod_chaos_cli_usage_errors(capsys):
+    assert chaos_main(["--engine_dir", "/tmp/x", "--pod", "1",
+                       "--", "f.h5"]) == 1
+    assert "--pod" in capsys.readouterr().err
+    assert chaos_main(["--engine_dir", "/tmp/x", "--pod", "2",
+                       "--fleet", "2", "--", "f.h5"]) == 1
+    assert "pick one" in capsys.readouterr().err
