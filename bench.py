@@ -944,6 +944,91 @@ def _worker_main() -> int:
             )
         return out
 
+    def run_operator(timed_reps: int) -> dict:
+        """Matrix-free implicit operator vs dense on the SAME system
+        (ISSUE 19, docs/PERFORMANCE.md §11): a fixed mid-size two-camera
+        geometry (400x512, independent of the sweep env so rounds stay
+        comparable) solved by the geometry-driven implicit backend and by
+        a dense solver on the matrix it materializes. Records iter/s for
+        both, the session-attach wall-ms (solver construction — what a
+        `submit --geometry` request pays to become resident) and the
+        resident-byte footprints (the O(npixel) ray table vs the O(P*V)
+        matrix), parity-asserted at the shared fused-parity tolerance;
+        `sartsolve metrics --diff` tracks detail.operator run-over-run
+        in `make bench-smoke`."""
+        from sartsolver_tpu.operators import ImplicitOperator
+        from sartsolver_tpu.operators.geometry import parse_geometry
+        from sartsolver_tpu.parallel.mesh import make_mesh
+        from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+        from sartsolver_tpu.utils.fused_parity import PARITY_RTOL
+
+        rec = parse_geometry({
+            "format": "sart-geometry", "version": 1,
+            "grid": {"shape": [8, 8, 8], "origin": [0.0, 0.0, 0.0],
+                     "spacing": [1.0, 1.0, 1.0]},
+            "cameras": [
+                {"name": "camA", "rows": 16, "cols": 16,
+                 "position": [-12.0, 4.2, 4.4],
+                 "target": [4.0, 4.0, 4.0],
+                 "up": [0.0, 0.0, 1.0], "pitch": 0.45},
+                {"name": "camB", "rows": 12, "cols": 12,
+                 "position": [4.4, -12.0, 3.8],
+                 "target": [4.0, 4.0, 4.0],
+                 "up": [0.0, 0.0, 1.0], "pitch": 0.55},
+            ],
+        })
+        op = ImplicitOperator(rec)
+        H_geo = op.materialize().astype(np.float64)
+        rng_o = np.random.default_rng(19)
+        g_o = H_geo @ rng_o.uniform(0.5, 1.5, rec.nvoxel)
+        opts = SolverOptions(max_iterations=min(iters, 50),
+                             conv_tolerance=0.0, fused_sweep="off")
+
+        def measure(build):
+            t_b = time.perf_counter()
+            solver = build()
+            attach_s = time.perf_counter() - t_b
+            try:
+                res = solver.solve(g_o)  # compile + warm
+                sol = np.asarray(res.solution)
+                n_done = max(int(res.iterations), 1)
+                best = float("inf")
+                for _ in range(timed_reps):
+                    t_rep = time.perf_counter()
+                    res = solver.solve(g_o)
+                    sol = np.asarray(res.solution)
+                    best = min(best, time.perf_counter() - t_rep)
+                return n_done / best, sol[:rec.nvoxel], attach_s
+            finally:
+                solver.close()
+
+        imp_rate, imp_sol, imp_attach = measure(
+            lambda: DistributedSARTSolver(operator=op, opts=opts,
+                                          mesh=make_mesh(1, 1)))
+        den_rate, den_sol, den_attach = measure(
+            lambda: DistributedSARTSolver(H_geo.astype(np.float32),
+                                          opts=opts, mesh=make_mesh(1, 1)))
+        d = float(np.max(np.abs(imp_sol - den_sol)))
+        scale = float(np.max(np.abs(den_sol)))
+        parity = bool(d <= PARITY_RTOL * max(scale, 1.0))
+        out = {
+            "npixel": rec.npixel, "nvoxel": rec.nvoxel,
+            "iter_s_implicit": round(imp_rate, 2),
+            "iter_s_dense": round(den_rate, 2),
+            "attach_ms_implicit": round(imp_attach * 1e3, 1),
+            "attach_ms_dense": round(den_attach * 1e3, 1),
+            "resident_bytes_implicit": op.resident_nbytes(),
+            "resident_bytes_dense": rec.npixel * rec.nvoxel * 4,
+            "parity_max_abs_diff": round(d, 9),
+            "parity": parity,
+        }
+        if not parity:
+            out["error"] = (
+                f"implicit-vs-dense parity FAILED: max|d|={d:.3e} vs "
+                f"scale {scale:.3e}"
+            )
+        return out
+
     def run_probe() -> dict:
         """~0.35 s fixed-shape bandwidth probe (VERDICT r4 next #5): a
         50-step power iteration over the staged fp32 matrix using the
@@ -1109,6 +1194,8 @@ def _worker_main() -> int:
                 data = run_tts(item["log"])
             elif item["kind"] == "sparse":
                 data = run_sparse(item["occ"], item["reps"])
+            elif item["kind"] == "operator":
+                data = run_operator(item["reps"])
             elif item["kind"] == "probe":
                 data = run_probe()
             else:
@@ -1437,6 +1524,15 @@ def main() -> int:
                "reps": 2, "deadline": budget_s + 240,
                "timeout": cfg_timeout}
               for p in (25, 50, 100)]
+    # matrix-free operator section (ISSUE 19, docs/PERFORMANCE.md §11):
+    # the geometry-driven implicit backend vs a dense solver on the
+    # matrix it materializes — iter/s, session-attach wall-ms, resident
+    # bytes, parity-asserted; detail.operator.iter_s_implicit is tracked
+    # run-over-run by `sartsolve metrics --diff` in `make bench-smoke`.
+    # Runs in quick mode too (plain XLA — no TPU needed).
+    items.append({"kind": "operator", "id": "operator:implicit",
+                  "reps": 2, "deadline": budget_s + 240,
+                  "timeout": cfg_timeout})
     # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
@@ -1531,6 +1627,12 @@ def main() -> int:
         # 13); `sartsolve metrics --diff` gates
         # detail.sparse.occ50.iter_speedup run-over-run
         detail["sparse"] = sparse
+    oper = results.get("operator:implicit")
+    if oper is not None:
+        # implicit-vs-dense operator backend (ISSUE 19, docs
+        # PERFORMANCE.md §11); `sartsolve metrics --diff` tracks
+        # detail.operator.iter_s_implicit run-over-run
+        detail["operator"] = oper
     probes = {end: results[f"probe:{end}"] for end in ("start", "end")
               if f"probe:{end}" in results}
     if probes:

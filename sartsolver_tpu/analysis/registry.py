@@ -93,6 +93,7 @@ AUDIT_P, AUDIT_V = 128, 1024
 # "auditor sees every entry" compose without a hard import cycle.
 ENTRY_MODULES = (
     "sartsolver_tpu.models.sart",
+    "sartsolver_tpu.operators.implicit",
     "sartsolver_tpu.ops.fused_sweep",
     "sartsolver_tpu.parallel.sharded",
     "sartsolver_tpu.resilience.degrade",

@@ -810,8 +810,9 @@ class DenseRtmContraction(Rule):
     """SL007 — a dense matrix product against the RTM (``rtm @ x``,
     ``jnp.matmul(problem.rtm, ...)``, ``lax.dot_general`` on an
     rtm-named operand) outside the operator layer
-    (``ops/fused_sweep.py`` / ``ops/projection.py``): new code must
-    route contractions through the projection operators or the fused/
+    (``ops/fused_sweep.py`` / ``ops/projection.py`` / the
+    ``sartsolver_tpu/operators/`` package): new code must route
+    contractions through the projection operators or the fused/
     panel-sweep primitives — a raw dot bypasses the block-sparse
     tile-skip (and the fused-sweep dispatch entirely), so the sparse
     path silently degrades to dense the moment such a call lands on a
@@ -820,14 +821,20 @@ class DenseRtmContraction(Rule):
     id = "SL007"
     severity = "error"
     title = "dense RTM contraction outside the operator layer"
-    hint = ("route the product through ops/projection.py "
-            "(forward_project/back_project) or the fused/panel sweep "
+    hint = ("route the product through a ProjectionOperator "
+            "(sartsolver_tpu/operators/), ops/projection.py "
+            "(forward_project/back_project), or the fused/panel sweep "
             "primitives (ops/fused_sweep.py) so sparse/fused dispatch "
             "applies; annotate deliberate exceptions with "
             "sart-lint: disable=SL007 and a why")
 
     # the operator layer itself: the one home for raw RTM contractions
     _ALLOWED_SUFFIXES = ("ops/fused_sweep.py", "ops/projection.py")
+    # the pluggable operator package is the operator layer too: every
+    # backend's forward/back IS the contraction the rest of the tree
+    # must route through (matched by containment — the package has many
+    # modules and will grow more)
+    _ALLOWED_DIRS = ("sartsolver_tpu/operators/",)
     _MATMUL_FNS = ("matmul", "dot", "dot_general", "einsum", "tensordot",
                    "vdot")
     _RTM_NAME_RE = re.compile(r"(^|_)rtm($|_)", re.IGNORECASE)
@@ -863,6 +870,8 @@ class DenseRtmContraction(Rule):
     def run(self, model: ModuleModel) -> Iterator[Finding]:
         path = model.path.replace("\\", "/")
         if any(path.endswith(sfx) for sfx in self._ALLOWED_SUFFIXES):
+            return
+        if any(d in path for d in self._ALLOWED_DIRS):
             return
         for node in ast.walk(model.tree):
             if isinstance(node, ast.BinOp) and isinstance(

@@ -126,6 +126,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="List of ray transfer matrix and camera image hdf5 files.")
 
     tpu = p.add_argument_group("tpu options")
+    tpu.add_argument("--geometry", default=None, metavar="FILE",
+                     help="Matrix-free implicit operator: derive the "
+                          "projections H f / H^T w on the fly from the "
+                          "versioned geometry record FILE "
+                          "(docs/FORMATS.md §geometry) instead of "
+                          "reading ray-transfer matrix files — inputs "
+                          "are image files only, and device memory "
+                          "holds the ray table instead of the RTM "
+                          "(docs/PERFORMANCE.md §11). Single-process, "
+                          "pixel-sharded meshes only; incompatible "
+                          "with --laplacian_file and rtm_dtype=int8.")
     tpu.add_argument("--pixel_shards", type=int, default=None,
                      help="Number of mesh shards along the pixel axis "
                           "(default: auto — all visible devices, unless the "
@@ -335,7 +346,21 @@ def _validate(args) -> None:
         fail("Argument max_cached_frames must be positive.")
     if args.max_cached_solutions <= 0:
         fail("Argument max_cached_solutions must be positive.")
-    if len(args.input_files) < 2:
+    if getattr(args, "geometry", None):
+        # matrix-free mode: the geometry record replaces the RTM files,
+        # so a single image file is a complete input set
+        if len(args.input_files) < 1:
+            fail("At least one image input file is required with "
+                 "--geometry, 0 given.")
+        if getattr(args, "multihost", False):
+            fail("Argument geometry is single-process: the implicit "
+                 "operator's rays are staged whole per host; drop "
+                 "--multihost or materialize the matrix.")
+        if getattr(args, "laplacian_file", None):
+            fail("Argument geometry cannot be combined with "
+                 "--laplacian_file: beta_laplace smoothing needs the "
+                 "materialized operator.")
+    elif len(args.input_files) < 2:
         fail("At least two input file, one with RTM and one with image, are "
              f"required, {len(args.input_files)} given.")
     if args.pixel_shards is not None and args.pixel_shards < 1:
@@ -640,21 +665,48 @@ def main(argv: Optional[List[str]] = None) -> int:
         time_intervals = parse_time_intervals(args.time_range)
 
         # ---- pre-flight validation gate (main.cpp:30-59) -----------------
+        geometry_record = None
+        if getattr(args, "geometry", None):
+            from sartsolver_tpu.operators.geometry import load_geometry
+
+            geometry_record = load_geometry(args.geometry)
         matrix_files, image_files = hf.categorize_input_files(args.input_files)
         rtm_name = args.raytransfer_name
-        hf.check_group_attribute_consistency(matrix_files, f"rtm/{rtm_name}", ["wavelength"])
-        hf.check_group_attribute_consistency(matrix_files, "rtm/voxel_map", ["nx", "ny", "nz"])
-        sorted_matrix_files = hf.sort_rtm_files(matrix_files)
-        hf.check_rtm_frame_consistency(sorted_matrix_files)
-        hf.check_rtm_voxel_consistency(sorted_matrix_files)
-        hf.check_group_attribute_consistency(image_files, "image", ["wavelength"])
-        sorted_image_files = hf.sort_image_files(image_files)
-        camera_names = list(sorted_image_files)
-        hf.check_rtm_image_consistency(
-            sorted_matrix_files, sorted_image_files, rtm_name, args.wavelength_threshold
-        )
-        npixel, nvoxel = hf.get_total_rtm_size(sorted_matrix_files)
-        rtm_frame_masks = hf.read_rtm_frame_masks(sorted_matrix_files)
+        if geometry_record is not None:
+            # matrix-free gate: image files only, cameras exactly the
+            # geometry record's (same set equality the RTM gate checks)
+            if matrix_files:
+                raise SartInputError(
+                    "--geometry replaces the ray-transfer matrix files; "
+                    f"drop {', '.join(matrix_files)} from the inputs "
+                    "(image files only)."
+                )
+            hf.check_group_attribute_consistency(image_files, "image", ["wavelength"])
+            sorted_image_files = hf.sort_image_files(image_files)
+            camera_names = list(sorted_image_files)
+            cams = set(geometry_record.camera_names)
+            if cams != set(camera_names):
+                raise SartInputError(
+                    "Geometry/image mismatch: geometry cameras "
+                    f"{sorted(cams)} vs image files {camera_names}."
+                )
+            sorted_matrix_files = {}
+            npixel, nvoxel = geometry_record.npixel, geometry_record.nvoxel
+            rtm_frame_masks = geometry_record.frame_masks()
+        else:
+            hf.check_group_attribute_consistency(matrix_files, f"rtm/{rtm_name}", ["wavelength"])
+            hf.check_group_attribute_consistency(matrix_files, "rtm/voxel_map", ["nx", "ny", "nz"])
+            sorted_matrix_files = hf.sort_rtm_files(matrix_files)
+            hf.check_rtm_frame_consistency(sorted_matrix_files)
+            hf.check_rtm_voxel_consistency(sorted_matrix_files)
+            hf.check_group_attribute_consistency(image_files, "image", ["wavelength"])
+            sorted_image_files = hf.sort_image_files(image_files)
+            camera_names = list(sorted_image_files)
+            hf.check_rtm_image_consistency(
+                sorted_matrix_files, sorted_image_files, rtm_name, args.wavelength_threshold
+            )
+            npixel, nvoxel = hf.get_total_rtm_size(sorted_matrix_files)
+            rtm_frame_masks = hf.read_rtm_frame_masks(sorted_matrix_files)
 
         # Resume compatibility is checkable from metadata alone — fail now,
         # before the (potentially tens-of-GB) RTM ingest, not after. In a
@@ -789,7 +841,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the auto mesh choice so a broken kernel demotes the auto mesh to
         # the row-block layout instead of picking voxel-major for nothing.
         kernel_demoted = False
-        if not args.use_cpu:
+        if not args.use_cpu and geometry_record is None:
             from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
 
             resolved = resolve_fused_auto(
@@ -799,11 +851,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             opts = resolved
 
         if not explicit_mesh:
-            from sartsolver_tpu.parallel.mesh import choose_mesh_shape
+            if geometry_record is not None:
+                # the implicit operator shards rays along pixels only
+                # (its back-projection psums over the one pixel axis) —
+                # pixel-major is the only eligible auto layout
+                n_pix, n_vox = len(devices), 1
+            else:
+                from sartsolver_tpu.parallel.mesh import choose_mesh_shape
 
-            n_pix, n_vox = choose_mesh_shape(
-                len(devices), npixel, nvoxel, opts, args.batch_frames
-            )
+                n_pix, n_vox = choose_mesh_shape(
+                    len(devices), npixel, nvoxel, opts, args.batch_frames
+                )
         if kernel_demoted:
             # the self-test guards only the Pallas KERNEL; the demotion to
             # 'off' correctly drove choose_mesh_shape to the row-block
@@ -823,7 +881,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                       "this backend; using the two-matmul path.",
                       file=sys.stderr)
 
-        if not args.use_cpu and opts.rtm_dtype == "int8":
+        if (not args.use_cpu and opts.rtm_dtype == "int8"
+                and geometry_record is None):
             # preflight BEFORE the (possibly tens-of-GB, two-pass) ingest:
             # everything here is knowable from sizes + flags. Pixel-sharded
             # meshes are no longer refused — the panel-psum scan fuses
@@ -967,39 +1026,63 @@ def main(argv: Optional[List[str]] = None) -> int:
             sparse_tile_stats_or_decline,
         )
 
-        tile_stats = sparse_tile_stats_or_decline(
-            opts, mesh, npixel, nvoxel, n_vox
-        )
-        with obs_trace.span("ingest.rtm", npixel=npixel, nvoxel=nvoxel):
-            if opts.rtm_dtype == "int8":
-                # two-pass ingest: quantize fp32 chunks host-side into
-                # int8 device buffers, so peak device footprint is
-                # 1 byte/element — a matrix that only fits as int8 loads
-                # (multihost.py)
-                from sartsolver_tpu.parallel.multihost import (
-                    read_and_quantize_rtm,
-                )
+        if geometry_record is not None:
+            # matrix-free path: no RTM ingest at all — the operator's
+            # whole device state is the [npixel, 6] ray table
+            from sartsolver_tpu.operators.implicit import ImplicitOperator
 
-                rtm, rtm_scale = read_and_quantize_rtm(
-                    sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
-                    ingest_stats=ingest_stats, tile_stats=tile_stats,
+            operator = ImplicitOperator(geometry_record)
+            tile_occ = None
+            ingest_stats = None
+            with obs_trace.span("ingest.geometry", npixel=npixel,
+                                nvoxel=nvoxel):
+                solver = DistributedSARTSolver(
+                    operator=operator, opts=opts, mesh=mesh
                 )
-            else:
-                rtm = read_and_shard_rtm(
-                    sorted_matrix_files, rtm_name, npixel, nvoxel, mesh,
-                    dtype=opts.rtm_dtype or opts.dtype,
-                    serialize=args.multihost and not args.parallel_read,
-                    ingest_stats=ingest_stats, tile_stats=tile_stats,
+            print(
+                f"implicit: ray table resident "
+                f"({operator.resident_nbytes()} bytes; a materialized "
+                f"RTM would stage "
+                f"{npixel * nvoxel * np.dtype(np.float32).itemsize})"
+            )
+        else:
+            tile_stats = sparse_tile_stats_or_decline(
+                opts, mesh, npixel, nvoxel, n_vox
+            )
+            with obs_trace.span("ingest.rtm", npixel=npixel,
+                                nvoxel=nvoxel):
+                if opts.rtm_dtype == "int8":
+                    # two-pass ingest: quantize fp32 chunks host-side
+                    # into int8 device buffers, so peak device footprint
+                    # is 1 byte/element — a matrix that only fits as
+                    # int8 loads (multihost.py)
+                    from sartsolver_tpu.parallel.multihost import (
+                        read_and_quantize_rtm,
+                    )
+
+                    rtm, rtm_scale = read_and_quantize_rtm(
+                        sorted_matrix_files, rtm_name, npixel, nvoxel,
+                        mesh, ingest_stats=ingest_stats,
+                        tile_stats=tile_stats,
+                    )
+                else:
+                    rtm = read_and_shard_rtm(
+                        sorted_matrix_files, rtm_name, npixel, nvoxel,
+                        mesh, dtype=opts.rtm_dtype or opts.dtype,
+                        serialize=(args.multihost
+                                   and not args.parallel_read),
+                        ingest_stats=ingest_stats,
+                        tile_stats=tile_stats,
+                    )
+                tile_occ = (
+                    tile_stats.occupancy(opts.sparse_epsilon())
+                    if tile_stats is not None else None
                 )
-            tile_occ = (
-                tile_stats.occupancy(opts.sparse_epsilon())
-                if tile_stats is not None else None
-            )
-            solver = DistributedSARTSolver(
-                rtm, lap, opts=opts, mesh=mesh, npixel=npixel,
-                nvoxel=nvoxel, rtm_scale=rtm_scale,
-                tile_occupancy=tile_occ,
-            )
+                solver = DistributedSARTSolver(
+                    rtm, lap, opts=opts, mesh=mesh, npixel=npixel,
+                    nvoxel=nvoxel, rtm_scale=rtm_scale,
+                    tile_occupancy=tile_occ,
+                )
         if tile_occ is not None:
             # this is the INDEX, known at ingest; whether the sweep
             # engaged it is a trace-time decision — --timing's engaged=
@@ -1043,9 +1126,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     )
         _mark("ingest RTM + upload")
 
-        grid = make_voxel_grid(
-            next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
-        )
+        if geometry_record is not None:
+            from sartsolver_tpu.operators.geometry import GeometryVoxelGrid
+
+            grid = GeometryVoxelGrid(geometry_record)
+        else:
+            grid = make_voxel_grid(
+                next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
+            )
 
         written_times = (
             resume_state.times if resume_state is not None else np.empty(0)

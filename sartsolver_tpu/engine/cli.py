@@ -315,8 +315,39 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         # multi-session residency (docs/SERVING.md §10): the eagerly
         # built default session is seeded into a byte-budgeted cache so
         # flag/input errors still surface before the first request, and
-        # later keys warm through the same validated builder
-        cache = SessionCache(lambda key: ResidentSession.build(args))
+        # later keys warm through the same validated builder. A request
+        # carrying an inline geometry record routes to its own key —
+        # a matrix-free implicit session over the worker's image files
+        # (docs/SERVING.md §11) — costing its ray table, not a second
+        # RTM, under the same byte budget.
+        import hashlib
+
+        geo_records: dict = {}
+
+        def _session_key_for(req) -> str:
+            if req.geometry is None:
+                return "default"
+            digest = hashlib.sha1(json.dumps(
+                req.geometry, sort_keys=True).encode()).hexdigest()[:12]
+            key = f"geometry:{digest}"
+            geo_records[key] = req.geometry
+            return key
+
+        def _build_session(key: str) -> ResidentSession:
+            rec = geo_records.get(key)
+            if rec is None:
+                return ResidentSession.build(args)
+            from sartsolver_tpu.io import hdf5files as hf
+
+            # the geometry replaces the worker's matrix files; its
+            # cameras must match the worker's image files (checked by
+            # the geometry build — a mismatch fails THIS request)
+            geo_args = argparse.Namespace(**vars(args))
+            _, image_files = hf.categorize_input_files(args.input_files)
+            geo_args.input_files = image_files
+            return ResidentSession.build(geo_args, geometry=rec)
+
+        cache = SessionCache(_build_session, key_for=_session_key_for)
         cache.seed("default", session)
         admission = AdmissionController(
             max_queue=args.max_queue,
@@ -513,6 +544,13 @@ def build_submit_parser() -> argparse.ArgumentParser:
                         "either way it lands in the response, journal "
                         "markers and trace spans "
                         "(docs/OBSERVABILITY.md §10).")
+    p.add_argument("--geometry", default=None, metavar="FILE",
+                   help="Attach a matrix-free implicit operator: inline "
+                        "the geometry record FILE (docs/FORMATS.md "
+                        "§geometry) into the payload's 'geometry' field "
+                        "— the engine solves this request on a "
+                        "geometry-keyed session instead of its resident "
+                        "RTM (docs/SERVING.md §11).")
     p.add_argument("--wait", type=float, default=0.0, metavar="S",
                    help="Wait up to S seconds for the outcome response "
                         "(needs --engine_dir; 0 = do not wait).")
@@ -585,6 +623,25 @@ def submit_main(argv: Optional[List[str]] = None) -> int:
         if args.trace is not None:
             payload["trace"] = args.trace
         payload_text = json.dumps(payload)
+    if args.geometry is not None:
+        # validate + canonicalize the record HERE, client-side, then
+        # inline it: the payload is self-contained (the engine and its
+        # journal replay never need the client's file)
+        from sartsolver_tpu.config import SartInputError
+        from sartsolver_tpu.operators.geometry import load_geometry
+
+        try:
+            record = load_geometry(args.geometry)
+        except SartInputError as err:
+            print(err, file=sys.stderr)
+            return EXIT_INPUT_ERROR
+        try:
+            payload = json.loads(payload_text)
+        except ValueError:
+            payload = None
+        if isinstance(payload, dict):
+            payload["geometry"] = record.to_dict()
+            payload_text = json.dumps(payload)
     if args.trace is not None and args.request_file is not None:
         # propagate the caller's trace id into a file payload too; an
         # unparseable file falls through to the local validation below,

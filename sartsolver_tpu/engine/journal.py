@@ -222,6 +222,11 @@ class RequestJournal:
                         # join against the pre-crash ones
                         trace=str(raw.get("trace", "")),
                         handoff=bool(raw.get("handoff", False)),
+                        # the inline geometry record rides the journal so
+                        # replay rebuilds the identical implicit operator
+                        geometry=(raw["geometry"]
+                                  if isinstance(raw.get("geometry"), dict)
+                                  else None),
                     )
                     if rid not in accepted:
                         accepted[rid] = req

@@ -95,13 +95,20 @@ class Request:
     # the survivor's admission must accept it even though tenant
     # affinity would normally route the tenant elsewhere
     handoff: bool = False
+    # inline geometry record (docs/FORMATS.md §geometry): attaches the
+    # matrix-free implicit operator for THIS request's session instead
+    # of the worker's resident default. Carried inline (the full
+    # validated record, not a path) so journal replay after a crash
+    # rebuilds the identical operator from the journal alone. None =
+    # the worker's default session.
+    geometry: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return {
             "id": self.id, "tenant": self.tenant,
             "time_range": self.time_range, "deadline_s": self.deadline_s,
             "submitted_unix": self.submitted_unix, "trace": self.trace,
-            "handoff": self.handoff,
+            "handoff": self.handoff, "geometry": self.geometry,
         }
 
 
@@ -127,7 +134,7 @@ def parse_request(payload, *, default_deadline_s: Optional[float] = None
         )
     unknown = set(payload) - {
         "id", "tenant", "time_range", "deadline_s", "submitted_unix",
-        "trace", "handoff",
+        "trace", "handoff", "geometry",
     }
     if unknown:
         raise RequestError(
@@ -180,8 +187,21 @@ def parse_request(payload, *, default_deadline_s: Optional[float] = None
     handoff = payload.get("handoff", False)
     if not isinstance(handoff, bool):
         raise RequestError("Request field 'handoff' must be a boolean.")
+    geometry = payload.get("geometry")
+    if geometry is not None:
+        # full schema validation NOW, at the admission boundary: a bad
+        # record is the client's mistake (REASON_MALFORMED), never a
+        # session-build crash after acceptance
+        from sartsolver_tpu.operators.geometry import parse_geometry
+
+        try:
+            geometry = parse_geometry(geometry).to_dict()
+        except SartInputError as err:
+            raise RequestError(
+                f"Request field 'geometry': {err}"
+            ) from err
     return Request(
         id=req_id, tenant=tenant, time_range=time_range,
         deadline_s=deadline_s, submitted_unix=submitted, trace=trace_id,
-        handoff=handoff,
+        handoff=handoff, geometry=geometry,
     )

@@ -902,6 +902,31 @@ class EngineServer:
 
     # ---- the solve cycle -------------------------------------------------
 
+    def _pop_cycle_batch(self) -> List[Tuple[Request, float, float]]:
+        """Pop the next solve cycle off the queue (caller holds the
+        lock). A cycle runs ONE compiled batcher on ONE session, so in
+        cache mode every request in it must lease the same session key
+        (a request-attached geometry keys its own session): the cycle
+        takes the head's key-mates, up to ``max_cycle_requests``; other
+        keys keep their queue order for the next cycle. Under the
+        default single-key routing this is exactly the old FIFO slice."""
+        if not self._queue:
+            return []
+        if self._session_cache is None:
+            batch = self._queue[: self.max_cycle_requests]
+            del self._queue[: len(batch)]
+            return batch
+        head_key = self._session_cache.key_for(self._queue[0][0])
+        batch, rest = [], []
+        for item in self._queue:
+            if (len(batch) < self.max_cycle_requests
+                    and self._session_cache.key_for(item[0]) == head_key):
+                batch.append(item)
+            else:
+                rest.append(item)
+        self._queue[:] = rest
+        return batch
+
     def _solve_cycle(
         self, batch: List[Tuple[Request, float, float]]
     ) -> None:
@@ -1167,8 +1192,7 @@ class EngineServer:
                 # exactly the one whose responses/traces grow fastest
                 self._sweep_retention()
                 with self._lock:
-                    batch = self._queue[: self.max_cycle_requests]
-                    del self._queue[: len(batch)]
+                    batch = self._pop_cycle_batch()
                 if batch:
                     self._cycles += 1
                     self._solve_cycle(batch)

@@ -37,7 +37,8 @@ class ResidentSession:
                  camera_names: List[str], sorted_image_files,
                  rtm_frame_masks, npixel: int, nvoxel: int,
                  max_cached_frames: int = 100,
-                 mesh_shape: Optional[Tuple[int, int]] = None):
+                 mesh_shape: Optional[Tuple[int, int]] = None,
+                 operator=None):
         self.solver = solver
         self.grid = grid
         self.opts = opts
@@ -48,17 +49,35 @@ class ResidentSession:
         self.nvoxel = int(nvoxel)
         self.max_cached_frames = int(max_cached_frames)
         self.mesh_shape = tuple(mesh_shape) if mesh_shape else None
+        # the session's ProjectionOperator descriptor (operators/):
+        # byte accounting (session_nbytes) and cache keying delegate to
+        # it, so an implicit session is charged its ray table — not a
+        # phantom npixel x nvoxel matrix it never materialized
+        self.operator = operator
 
     # ---- construction ----------------------------------------------------
 
     @classmethod
-    def build(cls, args) -> "ResidentSession":
+    def build(cls, args, geometry=None) -> "ResidentSession":
         """Build the session from a parsed solve-flag namespace — the
         same pre-flight validation gate and striped ingest the one-shot
-        CLI runs (cli.py), minus the per-run frame loop."""
+        CLI runs (cli.py), minus the per-run frame loop.
+
+        ``geometry`` (a validated record dict / ``GeometryRecord``)
+        overrides the matrix path with the matrix-free implicit
+        operator — the per-request attach route. Without it,
+        ``args.geometry`` (the ``--geometry FILE`` flag) does the same
+        for the whole process."""
         import jax
 
         from sartsolver_tpu.io import hdf5files as hf
+
+        if geometry is None and getattr(args, "geometry", None):
+            from sartsolver_tpu.operators.geometry import load_geometry
+
+            geometry = load_geometry(args.geometry)
+        if geometry is not None:
+            return cls._build_geometry(args, geometry)
         from sartsolver_tpu.io.laplacian_io import read_laplacian
         from sartsolver_tpu.io.voxelgrid import make_voxel_grid
         from sartsolver_tpu.ops.fused_sweep import resolve_fused_auto
@@ -179,6 +198,21 @@ class ResidentSession:
         grid = make_voxel_grid(
             next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
         )
+        # shape-only operator descriptor for cache accounting: the host
+        # matrix is gone after staging, but the resident footprint and
+        # program-family key survive through it (a tile-skip session
+        # additionally charges its packed occupancy bitmap)
+        from sartsolver_tpu.operators import DenseOperator, TileSkipOperator
+
+        op_dtype = opts.rtm_dtype or opts.dtype
+        occ = getattr(solver, "_tile_occupancy", None)
+        operator = (
+            TileSkipOperator(None, occ, npixel=npixel, nvoxel=nvoxel,
+                             dtype=op_dtype)
+            if occ is not None
+            else DenseOperator(npixel=npixel, nvoxel=nvoxel,
+                               dtype=op_dtype)
+        )
         print(
             f"engine: session resident — mesh={n_pix}x{n_vox} "
             f"backend={jax.default_backend()} "
@@ -193,6 +227,120 @@ class ResidentSession:
             npixel=npixel, nvoxel=nvoxel,
             max_cached_frames=args.max_cached_frames,
             mesh_shape=(n_pix, n_vox),
+            operator=operator,
+        )
+
+    @classmethod
+    def _build_geometry(cls, args, geometry) -> "ResidentSession":
+        """Matrix-free session: the operator is derived from a geometry
+        record (docs/FORMATS.md §geometry), the input files are image
+        files ONLY, and the resident footprint is the ray table — not a
+        materialized RTM (docs/SERVING.md §11)."""
+        import jax
+
+        from sartsolver_tpu.config import SartInputError
+        from sartsolver_tpu.io import hdf5files as hf
+        from sartsolver_tpu.operators.geometry import (
+            GeometryRecord,
+            GeometryVoxelGrid,
+            parse_geometry,
+        )
+        from sartsolver_tpu.operators.implicit import ImplicitOperator
+        from sartsolver_tpu.parallel.mesh import make_mesh
+        from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+
+        record = (geometry if isinstance(geometry, GeometryRecord)
+                  else parse_geometry(geometry))
+        if getattr(args, "laplacian_file", None):
+            raise SartInputError(
+                "beta_laplace smoothing is not supported by the "
+                "implicit (matrix-free) operator; drop --laplacian_file "
+                "or materialize the matrix."
+            )
+        matrix_files, image_files = hf.categorize_input_files(
+            args.input_files
+        )
+        if matrix_files:
+            raise SartInputError(
+                "--geometry replaces the ray-transfer matrix files; "
+                f"drop {', '.join(matrix_files)} from the inputs (image "
+                "files only)."
+            )
+        if not image_files:
+            raise SartInputError(
+                "Geometry mode needs at least one image file."
+            )
+        hf.check_group_attribute_consistency(
+            image_files, "image", ["wavelength"]
+        )
+        sorted_image_files = hf.sort_image_files(image_files)
+        cams = set(record.camera_names)
+        imgs = set(sorted_image_files)
+        if cams != imgs:
+            missing = sorted(cams - imgs)
+            extra = sorted(imgs - cams)
+            parts = []
+            if missing:
+                parts.append(f"no image file for camera(s) "
+                             f"{', '.join(missing)}")
+            if extra:
+                parts.append(f"image file(s) for unknown camera(s) "
+                             f"{', '.join(extra)}")
+            raise SartInputError(
+                f"Geometry/image mismatch: {'; '.join(parts)}."
+            )
+
+        kw = dict(
+            logarithmic=args.logarithmic,
+            ray_density_threshold=args.ray_density_threshold,
+            ray_length_threshold=args.ray_length_threshold,
+            conv_tolerance=args.conv_tolerance,
+            beta_laplace=args.beta_laplace,
+            relaxation=args.relaxation,
+            relaxation_decay=args.relaxation_decay,
+            max_iterations=args.max_iterations,
+            divergence_recovery=args.divergence_recovery,
+            integrity=bool(args.integrity),
+            os_subsets=args.os_subsets,
+            momentum=args.momentum,
+            fused_sweep=args.fused_sweep,
+        )
+        if args.use_cpu:
+            opts = SolverOptions.cpu_parity(**kw)
+            jax.config.update("jax_enable_x64", True)
+            devices = jax.devices("cpu")
+        else:
+            opts = SolverOptions(
+                rtm_dtype=args.rtm_dtype,
+                sparse_rtm=getattr(args, "sparse_rtm", None) or "off",
+                **kw,
+            )
+            devices = jax.devices()
+        # pixel-sharded mesh only (the implicit operator's restriction;
+        # an explicit --voxel_shards > 1 gets the solver's polite error)
+        n_vox = args.voxel_shards or 1
+        n_pix = args.pixel_shards or max(len(devices) // n_vox, 1)
+        mesh = make_mesh(n_pix, n_vox, devices=devices[: n_pix * n_vox])
+        operator = ImplicitOperator(record)
+        solver = DistributedSARTSolver(
+            operator=operator, opts=opts, mesh=mesh
+        )
+        print(
+            f"engine: session resident — mesh={n_pix}x{n_vox} "
+            f"backend={jax.default_backend()} operator=implicit "
+            f"compute={opts.dtype} npixel={record.npixel} "
+            f"nvoxel={record.nvoxel} "
+            f"resident_bytes={operator.resident_nbytes()}"
+        )
+        return cls(
+            solver=solver, grid=GeometryVoxelGrid(record), opts=opts,
+            camera_names=list(sorted_image_files),
+            sorted_image_files=sorted_image_files,
+            rtm_frame_masks=record.frame_masks(),
+            npixel=record.npixel, nvoxel=record.nvoxel,
+            max_cached_frames=args.max_cached_frames,
+            mesh_shape=(n_pix, n_vox),
+            operator=operator,
         )
 
     # ---- per-request attachment ------------------------------------------
@@ -276,7 +424,17 @@ def session_key(npixel: int, nvoxel: int, dtype, mesh_shape) -> str:
 
 
 def key_of(session) -> str:
-    """:func:`session_key` for a built session object."""
+    """:func:`session_key` for a built session object. A session with a
+    :class:`~sartsolver_tpu.operators.base.ProjectionOperator` attached
+    keys on the operator's own ``cache_key()`` — two geometry sessions
+    with the same shapes but different ray tables must NOT share a
+    cache slot."""
+    operator = getattr(session, "operator", None)
+    if operator is not None and getattr(operator, "kind", "") != "dense":
+        mesh = "x".join(
+            str(int(m)) for m in (getattr(session, "mesh_shape", None)
+                                  or ()))
+        return f"{operator.cache_key()}:{mesh or '-'}"
     opts = getattr(session, "opts", None)
     dtype = getattr(opts, "rtm_dtype", None) or getattr(
         opts, "dtype", "unknown")
@@ -285,12 +443,17 @@ def key_of(session) -> str:
 
 
 def session_nbytes(session) -> int:
-    """Resident footprint estimate, dominated by the sharded RTM:
-    ``npixel * nvoxel * itemsize``. A session may pin its own number
-    via an ``nbytes`` attribute (test stubs do)."""
+    """Resident footprint estimate. Precedence: an explicit ``nbytes``
+    attribute (test stubs pin their own number) -> the attached
+    operator's ``resident_nbytes()`` (an implicit session is charged
+    its ray table, not a phantom matrix) -> the dense RTM estimate
+    ``npixel * nvoxel * itemsize``."""
     explicit = getattr(session, "nbytes", None)
     if explicit is not None:
         return int(explicit() if callable(explicit) else explicit)
+    operator = getattr(session, "operator", None)
+    if operator is not None:
+        return int(operator.resident_nbytes())
     opts = getattr(session, "opts", None)
     try:
         item = np.dtype(

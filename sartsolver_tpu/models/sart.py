@@ -62,6 +62,13 @@ from sartsolver_tpu.ops.laplacian import (
     sharded_penalty,
 )
 from sartsolver_tpu.ops.projection import back_project, forward_project
+from sartsolver_tpu.operators.implicit import (
+    ImplicitSpec,
+    implicit_back,
+    implicit_forward,
+    implicit_ray_stats,
+    implicit_subset_density,
+)
 
 
 class SARTProblem(NamedTuple):
@@ -464,6 +471,37 @@ def make_sparse_problem(
                         axis_name=axis_name), occ
 
 
+def make_implicit_problem(
+    rays,
+    spec: ImplicitSpec,
+    *,
+    opts: SolverOptions,
+    axis_name=None,
+) -> SARTProblem:
+    """Matrix-free analogue of :func:`make_problem`: stage the packed
+    ``[P_local, 6]`` ray table as the problem's ``rtm`` leaf and derive
+    rho/lambda from the SAME traced slab kernel the sweeps multiply by
+    (operators/implicit.py) — Eq. 6 masking is self-consistent with the
+    on-the-fly operator exactly as the dense stats are with the stored
+    matrix. The problem pytree STRUCTURE is identical to the dense one
+    (only the rtm leaf's shape differs), which is what lets the solver
+    cores stay one program family; the spec rides separately as the
+    ``operator_spec`` static argument.
+    """
+    dtype = jnp.dtype(opts.dtype)
+    if (opts.rtm_dtype or "") == "int8":
+        raise ValueError(
+            "rtm_dtype='int8' quantizes a stored matrix; the implicit "
+            "operator stores no matrix (its rays stay fp32). Drop "
+            "rtm_dtype or use a materialized RTM."
+        )
+    rays = jnp.asarray(rays, jnp.float32)
+    dens, length = implicit_ray_stats(
+        rays, spec, dtype=dtype, axis_name=axis_name
+    )
+    return SARTProblem(rays, dens, length, None)
+
+
 def solve_normalized(
     problem: SARTProblem,
     g: Array,
@@ -475,6 +513,7 @@ def solve_normalized(
     voxel_axis=None,
     use_guess: bool,
     tile_occupancy=None,
+    operator_spec=None,
 ) -> SolveResult:
     """Jit-compiled solver core on a pre-normalized measurement.
 
@@ -505,6 +544,7 @@ def solve_normalized(
         f0[None, :],
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
         use_guess=use_guess, tile_occupancy=tile_occupancy,
+        operator_spec=operator_spec,
     )
     return SolveResult(
         res.solution[0], res.status[0], res.iterations[0], res.convergence[0]
@@ -513,7 +553,7 @@ def solve_normalized(
 
 _SOLVER_STATIC_ARGS = (
     "opts", "axis_name", "voxel_axis", "use_guess", "return_fitted",
-    "_vmem_raised", "tile_occupancy",
+    "_vmem_raised", "tile_occupancy", "operator_spec",
 )
 
 
@@ -546,6 +586,7 @@ def solve_normalized_batch(
     return_fitted: bool = False,
     _vmem_raised: bool = False,
     tile_occupancy=None,
+    operator_spec=None,
 ) -> "SolveResult | Tuple[SolveResult, Array]":
     """Batched solver core: B independent frames in one while_loop.
 
@@ -573,7 +614,7 @@ def solve_normalized_batch(
     kwargs = dict(
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
         use_guess=use_guess, fitted0=fitted0, return_fitted=return_fitted,
-        tile_occupancy=tile_occupancy,
+        tile_occupancy=tile_occupancy, operator_spec=operator_spec,
     )
     if any(
         isinstance(leaf, jax.core.Tracer)
@@ -593,7 +634,8 @@ def solve_normalized_batch(
     rtm = problem.rtm
     options = None
     if (
-        jax.default_backend() == "tpu"  # the raised limit is a TPU-only flag
+        operator_spec is None  # the implicit projector never fuses
+        and jax.default_backend() == "tpu"  # raised limit: TPU-only flag
         and _resolve_fused(opts, axis_name, rtm, g.shape[0], vmem_raised=True)
         == "compiled"
     ):
@@ -624,6 +666,7 @@ def solve_chain_normalized(
     fitted0: Optional[Array] = None,
     _vmem_raised: bool = False,
     tile_occupancy=None,
+    operator_spec=None,
 ) -> Tuple[SolveResult, Array]:
     """K warm-chained frames in ONE device program.
 
@@ -657,7 +700,7 @@ def solve_chain_normalized(
         problem,
         opts=opts, axis_name=axis_name, voxel_axis=voxel_axis,
         return_fitted=True, _vmem_raised=_vmem_raised,
-        tile_occupancy=tile_occupancy,
+        tile_occupancy=tile_occupancy, operator_spec=operator_spec,
     )
     K = g.shape[0]
     if use_guess_first and fitted0 is not None:
@@ -723,17 +766,38 @@ class _SweepContext:
 
     def __init__(self, problem: SARTProblem, opts: SolverOptions,
                  axis_name, voxel_axis, B: int, _vmem_raised: bool,
-                 tile_occupancy=None):
+                 tile_occupancy=None, operator_spec=None):
         dtype = self.dtype = jnp.dtype(opts.dtype)
         rtm = self.rtm = problem.rtm
         self.opts = opts
         self.axis_name = axis_name
         self.voxel_axis = voxel_axis
-        nvoxel = self.nvoxel = rtm.shape[1]
+        # Matrix-free mode (operators/implicit.py): the problem's rtm
+        # leaf carries the packed [P_local, 6] ray table and the static
+        # spec names the grid — the voxel extent comes from the spec,
+        # never from the staged array. None = the dense contraction,
+        # traced exactly as before the operator layer existed.
+        self.implicit = operator_spec
+        if operator_spec is not None:
+            if rtm.ndim != 2 or rtm.shape[1] != 6:
+                raise ValueError(
+                    f"implicit operator_spec given but problem.rtm has "
+                    f"shape {tuple(rtm.shape)} — expected the packed "
+                    "[P_local, 6] ray table (make_implicit_problem)."
+                )
+            nvoxel = self.nvoxel = int(operator_spec.nvoxel)
+        else:
+            nvoxel = self.nvoxel = rtm.shape[1]
         self.eps = _tiny(opts.log_epsilon, dtype)
         self.beta = jnp.asarray(opts.beta_laplace, dtype)
         self.problem = problem
         self.has_pen = problem.laplacian is not None
+        if self.has_pen and operator_spec is not None:
+            raise ValueError(
+                "beta_laplace smoothing is not supported by the implicit "
+                "(matrix-free) operator; drop the Laplacian or use a "
+                "materialized RTM."
+            )
 
         self.vmask = problem.ray_density > opts.ray_density_threshold  # [V]
         self.safe_dens = jnp.where(self.vmask, problem.ray_density, 1)
@@ -752,6 +816,14 @@ class _SweepContext:
         # resident-RTM corruption / a bad MXU product the iteration it
         # happens. Python-gated: integrity=False traces byte-identically.
         self.integrity = bool(opts.integrity)
+        if self.integrity and operator_spec is not None:
+            raise ValueError(
+                "integrity=True (in-solve ABFT) is not supported by the "
+                "implicit operator: the checksummed identities certify a "
+                "STORED matrix against corruption, and the matrix-free "
+                "projector stores none. Disable integrity or use a "
+                "materialized RTM."
+            )
         if self.integrity:
             from sartsolver_tpu.resilience.integrity import abft_tolerance
 
@@ -808,18 +880,28 @@ class _SweepContext:
                     f"os_subsets={self.os} must divide the (per-shard, "
                     f"padded) pixel extent {P_local}."
                 )
-            # [P/os, os, V]; axis 1 is the subset index (rows t::os)
-            stacked = rtm.reshape(P_local // self.os, self.os, nvoxel)
-            if self.is_int8:
-                dens_sub = _psum(
-                    self.scale[None, :]
-                    * jnp.sum(stacked, axis=0, dtype=jnp.int32).astype(dtype),
-                    axis_name,
+            if operator_spec is not None:
+                # same interleave (subset t = ray rows t::os), column
+                # sums rebuilt panel-by-panel from the slab kernel
+                dens_sub = implicit_subset_density(
+                    rtm, operator_spec, self.os, dtype=dtype,
+                    axis_name=axis_name,
                 )
             else:
-                dens_sub = _psum(
-                    jnp.sum(stacked, axis=0, dtype=dtype), axis_name
-                )
+                # [P/os, os, V]; axis 1 is the subset index (rows t::os)
+                stacked = rtm.reshape(P_local // self.os, self.os, nvoxel)
+                if self.is_int8:
+                    dens_sub = _psum(
+                        self.scale[None, :]
+                        * jnp.sum(
+                            stacked, axis=0, dtype=jnp.int32
+                        ).astype(dtype),
+                        axis_name,
+                    )
+                else:
+                    dens_sub = _psum(
+                        jnp.sum(stacked, axis=0, dtype=dtype), axis_name
+                    )
             self.vmask_sub = (  # [os, V]
                 (dens_sub > opts.ray_density_threshold) & self.vmask[None, :]
             )
@@ -844,6 +926,17 @@ class _SweepContext:
         self._sparse_occ_panels = None
         self._sparse_bs = 0
         sparse_eps = opts.sparse_epsilon()
+        if sparse_eps is not None and operator_spec is not None:
+            # the tile index skips stored-matrix panels; the implicit
+            # projector stores none — auto declines, explicit raises
+            if opts.sparse_explicit():
+                raise ValueError(
+                    f"sparse_rtm='{opts.sparse_rtm}' requested but the "
+                    "operator is implicit (matrix-free): there is no "
+                    "stored matrix to tile-index. Use sparse_rtm='auto'/"
+                    "off or a materialized RTM."
+                )
+            sparse_eps = None
         if sparse_eps is not None:
             pv = opts.fused_panel_voxels
             bs = pv or pick_panel_voxels(
@@ -955,7 +1048,23 @@ class _SweepContext:
         # dots with the panel scan's int8 dequant idiom — so the fused
         # resolution is skipped there (SolverOptions rejects an explicit
         # 'on'/'interpret' with os_subsets > 1 at construction).
-        if self.os > 1:
+        if operator_spec is not None:
+            # The implicit projector IS a one-pass panel sweep: it
+            # rebuilds H panel-by-panel inside the loop, so the fused
+            # machinery (which reads a stored matrix) never engages.
+            # Auto composes silently; an explicit request fails loudly.
+            if opts.fused_sweep in ("on", "interpret"):
+                raise ValueError(
+                    f"fused_sweep='{opts.fused_sweep}' requested but the "
+                    "operator is implicit (matrix-free); the slab "
+                    "projector replaces the fused sweep. Use "
+                    "fused_sweep='auto'/'off'."
+                )
+            fused = self.fused = None
+            FUSED_ENGAGEMENT["last"] = (
+                "implicit-os" if self.os > 1 else "implicit"
+            )
+        elif self.os > 1:
             fused = self.fused = None
             FUSED_ENGAGEMENT["last"] = (
                 "os-subset-sparse" if self.sparse is not None
@@ -1050,12 +1159,24 @@ class _SweepContext:
             self.update_fn = update_fn
 
     def bp_any(self, w_):
+        """LOCAL ``H^T w`` on whatever operator the problem carries —
+        the single back-projection seam every core path routes through
+        (the caller psums over the pixel axis, identically for every
+        backend)."""
+        if self.implicit is not None:
+            return implicit_back(self.rtm, w_, self.implicit,
+                                 accum_dtype=self.dtype)
         if self.is_int8:
             return int8_back_project(self.rtm, self.scale, w_,
                                      accum_dtype=self.dtype)
         return back_project(self.rtm, w_, accum_dtype=self.dtype)
 
     def fp_any(self, f_):
+        """``H f`` on whatever operator the problem carries (pre-voxel-
+        psum under 2-D meshes) — the forward-projection seam."""
+        if self.implicit is not None:
+            return implicit_forward(self.rtm, f_, self.implicit,
+                                    accum_dtype=self.dtype)
         if self.is_int8:
             return int8_forward_project(self.rtm, self.scale, f_,
                                         accum_dtype=self.dtype)
@@ -1100,10 +1221,19 @@ class _SweepContext:
             g_t = os_subset_pixels(g, t, self.os)
             m_t = os_subset_pixels(meas_mask, t, self.os)
             il_t = os_subset_pixels(self.inv_length, t, self.os)[None, :]
-            obs_t = os_subset_back(
-                panel, jnp.where(m_t, g_t, 0) * il_t, scale,
-                axis_name=self.axis_name,
-            )
+            w_t = jnp.where(m_t, g_t, 0) * il_t
+            if self.implicit is not None:
+                # the subset's ray rows drive the same slab kernel —
+                # os_subset_rows slices [P, 6] as readily as [P, V]
+                obs_t = _psum(
+                    implicit_back(panel, w_t, self.implicit,
+                                  accum_dtype=self.dtype),
+                    self.axis_name,
+                )
+            else:
+                obs_t = os_subset_back(
+                    panel, w_t, scale, axis_name=self.axis_name,
+                )
             outs.append(jnp.where(self.vmask_sub[t][None, :], obs_t, 0))
         return jnp.stack(outs, axis=1)
 
@@ -1152,6 +1282,11 @@ class _SweepContext:
             )
 
         def subset_fwd(panel, x):
+            if self.implicit is not None:
+                # `panel` holds the subset's ray rows; the slab kernel
+                # projects any ray set
+                return implicit_forward(panel, x, self.implicit,
+                                        accum_dtype=dtype)
             if occ_sp is not None:
                 return sparse_os_forward(
                     panel, x, scale, occ_panels=occ_sp, panel_voxels=bs_sp
@@ -1159,6 +1294,12 @@ class _SweepContext:
             return os_subset_forward(panel, x, scale)
 
         def subset_back(panel, w_):
+            if self.implicit is not None:
+                return _psum(
+                    implicit_back(panel, w_, self.implicit,
+                                  accum_dtype=dtype),
+                    self.axis_name,
+                )
             if occ_sp is not None:
                 return sparse_os_back(
                     panel, w_, scale, occ_panels=occ_sp,
@@ -1232,8 +1373,9 @@ class _SweepContext:
                 panel_voxels=bs_sp,
             )
         else:
-            fitted_upd = forward_project(self.rtm, f_upd,
-                                         accum_dtype=dtype)
+            # dense two-matmul and implicit operators share the seam
+            # (trace-identical to the direct dense call)
+            fitted_upd = self.fp_any(f_upd)
         return f_upd, fitted_upd
 
     def extrapolate(self, f, f_prev, tk, mom_floor):
@@ -1385,8 +1527,7 @@ class _SweepContext:
                     w, f, aux + ([penalty] if self.has_pen else [])
                 )
                 return f_upd, fitted_upd, None
-            fit = _psum(back_project(self.rtm, w, accum_dtype=dtype),
-                        self.axis_name)
+            fit = _psum(self.bp_any(w), self.axis_name)
             bp_chk = None
             if self.integrity:
                 # checksum the RAW psummed product (before the vmask zeroes
@@ -1419,8 +1560,7 @@ class _SweepContext:
                 + ([penalty] if self.has_pen else [])
             )
             return f_upd, fitted_upd, None
-        bp = _psum(back_project(self.rtm, w, accum_dtype=dtype),
-                   self.axis_name)
+        bp = _psum(self.bp_any(w), self.axis_name)
         bp_chk = None
         if self.integrity:
             bp_chk = (jnp.sum(bp, axis=1),
@@ -1444,13 +1584,14 @@ def _solve_normalized_batch_impl(
     return_fitted: bool = False,
     _vmem_raised: bool = False,
     tile_occupancy=None,
+    operator_spec=None,
 ) -> "SolveResult | Tuple[SolveResult, Array]":
     dtype = jnp.dtype(opts.dtype)
-    rtm = problem.rtm
     B = g.shape[0]
 
     kit = _SweepContext(problem, opts, axis_name, voxel_axis, B,
-                        _vmem_raised, tile_occupancy=tile_occupancy)
+                        _vmem_raised, tile_occupancy=tile_occupancy,
+                        operator_spec=operator_spec)
     vmask, safe_dens = kit.vmask, kit.safe_dens
     bp_any, fp_any = kit.bp_any, kit.fp_any
     meas_mask = g >= 0  # [B, P]
@@ -1599,7 +1740,7 @@ def _solve_normalized_batch_impl(
                 done[:, None], fitted, _psum(fitted_upd, voxel_axis)
             )
         else:
-            fitted_new = _psum(forward_project(rtm, f_new, accum_dtype=dtype), voxel_axis)
+            fitted_new = _psum(kit.fp_any(f_new), voxel_axis)
         if opts.precise_convergence:
             fsq_local = _sumsq_precise(fitted_new, dtype)
         else:  # the reference CUDA path's fp32 dot (sartsolver_cuda.cpp:253)
@@ -1832,6 +1973,7 @@ def sched_step_normalized(
     use_guess: bool = True,
     _vmem_raised: bool = False,
     tile_occupancy=None,
+    operator_spec=None,
 ) -> SchedState:
     """One scheduler stride: backfill the ``refill`` lanes, then run at
     most ``opts.schedule_stride`` masked iterations.
@@ -1849,7 +1991,8 @@ def sched_step_normalized(
     dtype = jnp.dtype(opts.dtype)
     B = state.g.shape[0]
     kit = _SweepContext(problem, opts, axis_name, voxel_axis, B,
-                        _vmem_raised, tile_occupancy=tile_occupancy)
+                        _vmem_raised, tile_occupancy=tile_occupancy,
+                        operator_spec=operator_spec)
     recovery = int(opts.divergence_recovery)
     explode = float(opts.divergence_threshold)
     tol = jnp.asarray(opts.conv_tolerance, dtype)
@@ -1996,10 +2139,7 @@ def sched_step_normalized(
                 done[:, None], fitted, _psum(fitted_upd, voxel_axis)
             )
         else:
-            fitted_new = _psum(
-                forward_project(kit.rtm, f_new, accum_dtype=dtype),
-                voxel_axis,
-            )
+            fitted_new = _psum(kit.fp_any(f_new), voxel_axis)
         if opts.precise_convergence:
             fsq_local = _sumsq_precise(fitted_new, dtype)
         else:
@@ -2415,6 +2555,7 @@ def solve(
     *,
     opts: SolverOptions,
     tile_occupancy=None,
+    operator_spec=None,
 ) -> SolveResult:
     """Single-device solve on a full (unsharded) problem. The sharded
     equivalent lives in ``sartsolver_tpu.parallel.sharded``."""
@@ -2429,14 +2570,16 @@ def solve(
 
     g = jnp.asarray(g64, dtype)
     use_guess = f0 is None
+    nvoxel = (operator_spec.nvoxel if operator_spec is not None
+              else problem.rtm.shape[1])
     if use_guess:
-        f0 = jnp.zeros((problem.rtm.shape[1],), dtype)
+        f0 = jnp.zeros((nvoxel,), dtype)
     else:
         f0 = jnp.asarray(np.asarray(f0, np.float64) / norm, dtype)
 
     res = solve_normalized(
         problem, g, jnp.asarray(msq, dtype), f0,
         opts=opts, axis_name=None, use_guess=use_guess,
-        tile_occupancy=tile_occupancy,
+        tile_occupancy=tile_occupancy, operator_spec=operator_spec,
     )
     return SolveResult(res.solution * jnp.asarray(norm, dtype), res.status, res.iterations, res.convergence)

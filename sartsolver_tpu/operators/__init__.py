@@ -1,0 +1,47 @@
+"""Pluggable projection operators (docs/PERFORMANCE.md §11).
+
+The solver core consumes an abstract projection operator — forward
+``H f``, back-projection ``H^T w``, the rho/lambda ray statistics behind
+the Eq. 6 masks, resident-bytes accounting and a session-cache key —
+instead of assuming a materialized dense RTM. Three implementations:
+
+- :class:`DenseOperator` — the existing materialized-H path (byte-
+  identical to the pre-operator solver; the default everywhere).
+- :class:`TileSkipOperator` — the PR 13 block-sparse path: dense storage
+  plus the tile-occupancy index that lets the panel sweep skip all-zero
+  tiles.
+- :class:`ImplicitOperator` — NEW: a geometry-driven matrix-free
+  backend. Forward/back-projection are computed on the fly from a small
+  versioned geometry record (a parametric ray/grid line-integral
+  projector traced as plain XLA, chunked per voxel panel so it composes
+  with the panel psum plan and the scheduler's one-compiled-program
+  contract) — the matrix is never materialized, so a resident session
+  costs ~KB instead of the RTM's GBs (tomoCAM, arxiv 2304.12934;
+  arxiv 2104.13248).
+
+This package is the blessed home for raw RTM contractions (lint SL007):
+the dense/implicit primitives live here and in ``ops/``; everything else
+goes through the operator contract.
+"""
+
+from sartsolver_tpu.operators.base import ProjectionOperator
+from sartsolver_tpu.operators.dense import DenseOperator
+from sartsolver_tpu.operators.geometry import (
+    Camera, GeometryRecord, GeometryVoxelGrid, load_geometry,
+    save_geometry,
+)
+from sartsolver_tpu.operators.implicit import (
+    ImplicitOperator, ImplicitSpec, implicit_back, implicit_forward,
+    implicit_ray_stats, implicit_subset_density, materialize_rtm,
+    pick_implicit_panel,
+)
+from sartsolver_tpu.operators.tileskip import TileSkipOperator
+
+__all__ = [
+    "ProjectionOperator", "DenseOperator", "TileSkipOperator",
+    "ImplicitOperator", "ImplicitSpec",
+    "Camera", "GeometryRecord", "GeometryVoxelGrid",
+    "load_geometry", "save_geometry",
+    "implicit_forward", "implicit_back", "implicit_ray_stats",
+    "implicit_subset_density", "materialize_rtm", "pick_implicit_panel",
+]

@@ -1,0 +1,436 @@
+"""Matrix-free geometry-driven projection (the implicit operator).
+
+The dense solver stages ``H`` as a ``[npixel, nvoxel]`` matrix and
+expresses ``H f`` / ``H^T w`` as MXU contractions (ops/projection.py).
+This module never materializes ``H``: each matrix entry ``H[p, v]`` is
+the length of ray ``p``'s segment inside voxel ``v``'s axis-aligned box
+— a pure function of the packed ray table (``[npixel, 6]`` origin xyz +
+unit direction xyz) and the regular grid record — recomputed on the fly
+with the slab method (per-axis near/far plane distances; tomoCAM, arxiv
+2304.12934; arxiv 2104.13248). Rays are O(npixel) bytes, so a resident
+session costs ~KB where the dense RTM costs GBs.
+
+Compute shape: plain XLA, chunked per voxel panel. One panel of
+``panel_voxels`` columns is rebuilt as a ``[P_local, panel]`` block and
+immediately contracted, so the largest live tensor is panel-sized — the
+same occupancy knob as the fused panel sweep (ops/fused_sweep.py), which
+is what lets the implicit path compose with the panel psum plan and the
+scheduler's one-compiled-program contract. Back-projection returns the
+LOCAL partial sum; the caller issues the single pixel-axis psum exactly
+where the dense path does, so the sharded program's collective budget is
+unchanged (audit entry ``sharded_implicit_batch``).
+
+Masking conventions match the dense staging exactly: zero-padded ray
+rows (direction norm 0) and padding columns (``vox >= grid_voxels``)
+produce all-zero matrix entries, i.e. zero ray length / zero ray
+density, and are then inert under the Eq. 6 masks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from sartsolver_tpu.analysis.registry import (
+    AUDIT_P, AUDIT_V, register_audit_entry,
+)
+from sartsolver_tpu.operators.base import ProjectionOperator
+from sartsolver_tpu.operators.geometry import GeometryRecord
+from sartsolver_tpu.parallel.mesh import COL_ALIGN, padded_size
+
+# Slab-method guards. EPS: a direction component smaller than this is
+# treated as axis-parallel (the ray never crosses that axis's planes —
+# division would overflow); BIG stands in for +inf so the min/max plane
+# algebra stays finite in fp32.
+_EPS = 1e-7
+_BIG = 1e30
+
+# Panel ceiling: one rebuilt [P_local, panel] block should stay well
+# inside VMEM-scale working sets; 1024 columns matches the fused sweep's
+# default occupancy sweet spot (docs/PERFORMANCE.md).
+_MAX_PANEL = 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class ImplicitSpec:
+    """Hashable trace-time record selecting the implicit projection path.
+
+    Passed as a STATIC argument through the solver (the
+    ``tile_occupancy`` precedent): two solves share a compiled program
+    iff their specs are equal. ``nvoxel`` is the padded, traced voxel
+    extent (what ``f`` carries); ``grid_voxels = nx*ny*nz`` is the
+    logical grid — columns in between are padding and project to zero.
+    """
+
+    grid_shape: Tuple[int, int, int]
+    origin: Tuple[float, float, float]
+    spacing: Tuple[float, float, float]
+    nvoxel: int
+    grid_voxels: int
+    panel_voxels: int
+    version: int = 1
+
+    def __post_init__(self):
+        nx, ny, nz = self.grid_shape
+        if nx * ny * nz != self.grid_voxels:
+            raise ValueError(
+                f"ImplicitSpec grid_shape {self.grid_shape} does not "
+                f"multiply out to grid_voxels={self.grid_voxels}"
+            )
+        if self.grid_voxels > self.nvoxel:
+            raise ValueError(
+                f"ImplicitSpec nvoxel={self.nvoxel} smaller than the "
+                f"grid ({self.grid_voxels} voxels)"
+            )
+        if self.panel_voxels < 1 or self.nvoxel % self.panel_voxels:
+            raise ValueError(
+                f"ImplicitSpec panel_voxels={self.panel_voxels} must "
+                f"divide nvoxel={self.nvoxel}"
+            )
+
+    @property
+    def n_panels(self) -> int:
+        return self.nvoxel // self.panel_voxels
+
+
+def pick_implicit_panel(padded_nvoxel: int) -> int:
+    """Largest lane-aligned panel width (multiple of COL_ALIGN, at most
+    ``_MAX_PANEL``) that divides the padded voxel extent."""
+    if padded_nvoxel < 1 or padded_nvoxel % COL_ALIGN:
+        raise ValueError(
+            f"padded nvoxel {padded_nvoxel} is not a multiple of "
+            f"{COL_ALIGN}"
+        )
+    for cand in range(min(_MAX_PANEL, padded_nvoxel), 0, -COL_ALIGN):
+        if padded_nvoxel % cand == 0:
+            return cand
+    return COL_ALIGN  # unreachable: COL_ALIGN always divides
+
+
+def panel_lengths(rays, start, spec: ImplicitSpec):
+    """Rebuild one RTM panel: ``[P_local, panel_voxels]`` ray/voxel
+    intersection lengths for voxel columns ``[start, start+panel)``.
+
+    ``start`` may be a traced index (the fori_loop panel cursor).
+    """
+    dtype = rays.dtype
+    nx, ny, nz = spec.grid_shape
+    del nx  # x index is the slowest axis; only ny/nz enter the unravel
+    vox = start + jnp.arange(spec.panel_voxels, dtype=jnp.int32)
+    # flat voxel id -> (ix, iy, iz), x slowest / z fastest — the
+    # GeometryVoxelGrid / io.voxelgrid cell convention
+    ix = vox // (ny * nz)
+    iy = (vox // nz) % ny
+    iz = vox % nz
+    idx = jnp.stack([ix, iy, iz], axis=-1).astype(dtype)  # [panel, 3]
+    origin = jnp.asarray(spec.origin, dtype)
+    spacing = jnp.asarray(spec.spacing, dtype)
+    lo = origin + idx * spacing  # [panel, 3] box corners
+    hi = lo + spacing
+    o = rays[:, None, :3]  # [P, 1, 3]
+    d = rays[:, None, 3:]
+    # slab method: entry/exit distances against each axis's plane pair
+    parallel = jnp.abs(d) < _EPS
+    inv = 1.0 / jnp.where(parallel, jnp.asarray(1.0, dtype), d)
+    t1 = (lo[None] - o) * inv  # [P, panel, 3]
+    t2 = (hi[None] - o) * inv
+    near = jnp.minimum(t1, t2)
+    far = jnp.maximum(t1, t2)
+    # axis-parallel rays: the slab constrains nothing if the origin sits
+    # between the planes, everything if it doesn't. Half-open ([lo, hi))
+    # so a ray riding exactly on a shared face belongs to ONE cell —
+    # the floor-cell convention — instead of double-counting both.
+    between = (o >= lo[None]) & (o < hi[None])
+    near = jnp.where(parallel, jnp.where(between, -_BIG, _BIG), near)
+    far = jnp.where(parallel, jnp.where(between, _BIG, -_BIG), far)
+    # the ray starts at its origin: clamp the entry distance at 0 so
+    # matter "behind" the camera never contributes
+    tmin = jnp.maximum(jnp.max(near, axis=-1), 0.0)
+    tmax = jnp.min(far, axis=-1)
+    seg = jnp.maximum(tmax - tmin, 0.0)
+    # zero-padded ray rows (|d| = 0) and padding columns project to zero
+    live = jnp.sum(rays[:, 3:] * rays[:, 3:], axis=-1) > 0.5  # [P]
+    in_grid = vox < spec.grid_voxels  # [panel]
+    return seg * live[:, None].astype(dtype) * in_grid[None, :].astype(dtype)
+
+
+def implicit_forward(rays, solution, spec: ImplicitSpec, *,
+                     accum_dtype=jnp.float32):
+    """``fitted = H @ f`` without ``H``: rays ``[P, 6]``; solution
+    ``[V]`` or ``[B, V]`` -> ``[P]`` or ``[B, P]``.
+
+    fori_loop over voxel panels, each panel rebuilt and contracted
+    against its slice of ``f`` — mirror of
+    :func:`~sartsolver_tpu.ops.projection.forward_project` including the
+    fp32 accumulation contract.
+    """
+    if solution.shape[-1] != spec.nvoxel:
+        raise ValueError(
+            f"solution voxel extent {solution.shape[-1]} != spec.nvoxel "
+            f"{spec.nvoxel}"
+        )
+    npix = rays.shape[0]
+    bs = spec.panel_voxels
+    out_shape = solution.shape[:-1] + (npix,)
+
+    def body(j, acc):
+        panel = panel_lengths(rays, j * bs, spec)
+        f_panel = lax.dynamic_slice_in_dim(
+            solution, j * bs, bs, axis=solution.ndim - 1
+        )
+        dims = (((solution.ndim - 1,), (1,)), ((), ()))
+        return acc + lax.dot_general(
+            f_panel, panel, dimension_numbers=dims,
+            preferred_element_type=accum_dtype,
+        )
+
+    return lax.fori_loop(
+        0, spec.n_panels, body, jnp.zeros(out_shape, accum_dtype)
+    )
+
+
+def implicit_back(rays, pixel_values, spec: ImplicitSpec, *,
+                  accum_dtype=jnp.float32):
+    """LOCAL ``H^T @ w`` without ``H``: rays ``[P_local, 6]``;
+    pixel_values ``[P_local]`` or ``[B, P_local]`` -> ``[V]`` or
+    ``[B, V]``.
+
+    Returns the local pixel-shard partial sum — the caller psums over
+    the pixel axis exactly where it psums the dense
+    :func:`~sartsolver_tpu.ops.projection.back_project`, keeping the
+    sharded program's collective count unchanged.
+    """
+    bs = spec.panel_voxels
+    out_shape = pixel_values.shape[:-1] + (spec.nvoxel,)
+
+    def body(j, acc):
+        panel = panel_lengths(rays, j * bs, spec)
+        dims = (((pixel_values.ndim - 1,), (0,)), ((), ()))
+        chunk = lax.dot_general(
+            pixel_values, panel, dimension_numbers=dims,
+            preferred_element_type=accum_dtype,
+        )
+        return lax.dynamic_update_slice_in_dim(
+            acc, chunk, j * bs, axis=pixel_values.ndim - 1
+        )
+
+    return lax.fori_loop(
+        0, spec.n_panels, body, jnp.zeros(out_shape, accum_dtype)
+    )
+
+
+def implicit_ray_stats(rays, spec: ImplicitSpec, *, dtype=jnp.float32,
+                       axis_name: Optional[str] = None):
+    """rho / lambda for the Eq. 6 masks, panel by panel.
+
+    Returns ``(ray_density [V], ray_length [P_local])``: column sums
+    (psummed over ``axis_name`` when pixel-sharded — density is a
+    global per-voxel quantity) and local row sums (per-pixel, stays
+    local like the staged dense ``ray_length``).
+    """
+    npix = rays.shape[0]
+    bs = spec.panel_voxels
+
+    def body(j, carry):
+        dens, length = carry
+        panel = panel_lengths(rays, j * bs, spec).astype(dtype)
+        dens = lax.dynamic_update_slice_in_dim(
+            dens, jnp.sum(panel, axis=0), j * bs, axis=0
+        )
+        return dens, length + jnp.sum(panel, axis=1)
+
+    dens, length = lax.fori_loop(
+        0, spec.n_panels, body,
+        (jnp.zeros((spec.nvoxel,), dtype), jnp.zeros((npix,), dtype)),
+    )
+    if axis_name is not None:
+        dens = lax.psum(dens, axis_name)
+    return dens, length
+
+
+def implicit_subset_density(rays, spec: ImplicitSpec, n_subsets: int, *,
+                            dtype=jnp.float32,
+                            axis_name: Optional[str] = None):
+    """Per-subset ray density ``[n_subsets, V]`` for OS-SART.
+
+    Subset ``t`` is pixel rows ``t::n_subsets`` — the same interleave as
+    the dense ``rtm.reshape(P//os, os, V)`` stacking, so the implicit OS
+    cycle visits identical subsets.
+    """
+    npix = rays.shape[0]
+    if npix % n_subsets:
+        raise ValueError(
+            f"{npix} pixel rows not divisible into {n_subsets} subsets"
+        )
+    bs = spec.panel_voxels
+
+    def body(j, dens):
+        panel = panel_lengths(rays, j * bs, spec).astype(dtype)
+        sub = jnp.sum(
+            panel.reshape(npix // n_subsets, n_subsets, bs), axis=0
+        )  # [os, panel]
+        return lax.dynamic_update_slice(dens, sub, (0, j * bs))
+
+    dens = lax.fori_loop(
+        0, spec.n_panels, body,
+        jnp.zeros((n_subsets, spec.nvoxel), dtype),
+    )
+    if axis_name is not None:
+        dens = lax.psum(dens, axis_name)
+    return dens
+
+
+def materialize_rtm(rays, spec: ImplicitSpec) -> np.ndarray:
+    """The dense ``[npixel, grid_voxels]`` matrix the implicit kernel
+    applies — built panel-by-panel with the SAME traced slab kernel, so
+    parity gates compare against bit-identical entries. Host-side /
+    test-side only; never on a hot path."""
+    rays = jnp.asarray(rays)
+    bs = spec.panel_voxels
+    blocks = [
+        panel_lengths(rays, j * bs, spec) for j in range(spec.n_panels)
+    ]
+    full = np.asarray(jnp.concatenate(blocks, axis=1))
+    return full[:, :spec.grid_voxels]
+
+
+class ImplicitOperator(ProjectionOperator):
+    """Geometry-driven matrix-free operator: the whole state is a
+    :class:`~sartsolver_tpu.operators.geometry.GeometryRecord`."""
+
+    kind = "implicit"
+
+    def __init__(self, record: GeometryRecord, *, dtype=np.float32):
+        self.record = record
+        self._dtype = np.dtype(dtype)
+
+    @property
+    def npixel(self) -> int:
+        return self.record.npixel
+
+    @property
+    def nvoxel(self) -> int:
+        return self.record.nvoxel
+
+    def payload(self) -> np.ndarray:
+        """The packed ``[npixel, 6]`` ray table (pixel rows in the
+        repo-wide camera order) — what the solver stages in place of the
+        RTM block."""
+        return np.ascontiguousarray(
+            self.record.build_rays().astype(self._dtype)
+        )
+
+    def spec(self, *, padded_nvoxel: Optional[int] = None,
+             panel_voxels: Optional[int] = None) -> ImplicitSpec:
+        if padded_nvoxel is None:
+            padded_nvoxel = padded_size(self.record.nvoxel, COL_ALIGN)
+        if panel_voxels is None:
+            panel_voxels = pick_implicit_panel(padded_nvoxel)
+        return ImplicitSpec(
+            grid_shape=self.record.grid_shape,
+            origin=self.record.origin,
+            spacing=self.record.spacing,
+            nvoxel=int(padded_nvoxel),
+            grid_voxels=self.record.nvoxel,
+            panel_voxels=int(panel_voxels),
+            version=self.record.version,
+        )
+
+    def resident_nbytes(self) -> int:
+        """Bytes of the staged ray table — O(npixel), not O(npixel x
+        nvoxel). (Row padding adds at most one row block; the estimate
+        charges the logical table, mirroring the dense estimate's use of
+        logical shape.)"""
+        return self.record.npixel * 6 * self._dtype.itemsize
+
+    def cache_key(self) -> str:
+        blob = json.dumps(self.record.to_dict(), sort_keys=True)
+        digest = hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+        return (
+            f"implicit:{self.npixel}x{self.nvoxel}:"
+            f"{self._dtype.name}:{digest}"
+        )
+
+    def materialize(self) -> np.ndarray:
+        return materialize_rtm(self.payload(), self.spec())
+
+
+# --------------------------------------------------------------------------
+# compile-audit self-registration (analysis/registry.py). The implicit
+# sweep's defining property is what is ABSENT: no RTM-sized tensor may
+# exist anywhere in the program — the largest live block is one
+# [AUDIT_P, panel] rebuild — and the single-device program stays
+# collective-free and f64-free like the dense sweep. The cost golden
+# additionally pins the recompute arithmetic: the slab kernel trades
+# bytes (no matrix operand) for flops, and that ratio drifting is
+# exactly what the golden should catch.
+
+
+def _audit_implicit_spec() -> ImplicitSpec:
+    # (8, 8, 16) grid = 1024 voxels = AUDIT_V exactly (no padding
+    # columns), four 256-voxel panels — panel chunking is visible in the
+    # lowering without inflating the fixture.
+    return ImplicitSpec(
+        grid_shape=(8, 8, 16), origin=(0.0, 0.0, 0.0),
+        spacing=(1.0, 1.0, 1.0), nvoxel=AUDIT_V, grid_voxels=AUDIT_V,
+        panel_voxels=256,
+    )
+
+
+@register_audit_entry(
+    "implicit_sweep",
+    description="matrix-free (geometry-driven) batched iteration sweep: "
+                "the slab projector rebuilds H panel-by-panel inside the "
+                "while body — panel-sized live blocks only, no "
+                "RTM-sized copies/converts, zero collectives "
+                "single-device",
+    loop_copy_threshold=AUDIT_P * AUDIT_V,
+    loop_convert_threshold=AUDIT_P * AUDIT_V,
+    loop_collective_budget={
+        "all-reduce": 0, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+)
+def _audit_implicit_sweep():
+    import functools
+
+    from sartsolver_tpu.config import SolverOptions
+    from sartsolver_tpu.models.sart import (
+        SARTProblem, _solve_normalized_batch_impl,
+    )
+
+    spec = _audit_implicit_spec()
+    problem = SARTProblem(
+        jax.ShapeDtypeStruct((AUDIT_P, 6), jnp.float32),
+        jax.ShapeDtypeStruct((AUDIT_V,), jnp.float32),
+        jax.ShapeDtypeStruct((AUDIT_P,), jnp.float32),
+        None,
+        None,
+    )
+    opts = SolverOptions(
+        max_iterations=8, conv_tolerance=1e-30, fused_sweep="off"
+    )
+    fn = jax.jit(functools.partial(
+        _solve_normalized_batch_impl, opts=opts, axis_name=None,
+        voxel_axis=None, use_guess=False, operator_spec=spec,
+    ))
+    return fn.lower(
+        problem,
+        jax.ShapeDtypeStruct((2, AUDIT_P), jnp.float32),
+        jax.ShapeDtypeStruct((2,), jnp.float32),
+        jax.ShapeDtypeStruct((2, AUDIT_V), jnp.float32),
+    )
+
+
+__all__ = [
+    "ImplicitOperator", "ImplicitSpec", "implicit_back",
+    "implicit_forward", "implicit_ray_stats", "implicit_subset_density",
+    "materialize_rtm", "panel_lengths", "pick_implicit_panel",
+]

@@ -282,7 +282,7 @@ class DistributedSARTSolver:
 
     def __init__(
         self,
-        rtm,
+        rtm=None,
         laplacian: Optional[LaplacianCOO] = None,
         *,
         opts: SolverOptions,
@@ -291,6 +291,7 @@ class DistributedSARTSolver:
         nvoxel: Optional[int] = None,
         rtm_scale=None,
         tile_occupancy=None,
+        operator=None,
     ):
         """``rtm`` is either a host ``np.ndarray`` (padded, cast and
         device_put here — single-host path) or an already-sharded global
@@ -312,7 +313,19 @@ class DistributedSARTSolver:
         so rho/lambda and the Eq. 6 masks always describe the thresholded
         operator the sweeps multiply by. ``sparse_rtm='auto'`` declines
         quietly on voxel-sharded meshes and index-less pre-sharded
-        matrices; an explicit numeric threshold raises."""
+        matrices; an explicit numeric threshold raises.
+
+        ``operator`` (mutually exclusive with ``rtm``): a
+        :class:`~sartsolver_tpu.operators.base.ProjectionOperator`. A
+        dense/tileskip operator unwraps to the host-staging path above
+        (its tile-occupancy index riding along); an IMPLICIT operator
+        switches the whole driver matrix-free — the staged "RTM" leaf is
+        the ``[padded_npixel, 6]`` ray table sharded over pixel rows, the
+        ray stats come from the same traced line-integral kernel the
+        sweeps use, and every compiled program threads the operator's
+        :class:`~sartsolver_tpu.operators.implicit.ImplicitSpec` as a
+        static argument (see :meth:`_init_implicit` for the mode's
+        restrictions)."""
         self.opts = opts
         self.mesh = mesh if mesh is not None else make_mesh()
         if PIXEL_AXIS not in self.mesh.shape or VOXEL_AXIS not in self.mesh.shape:
@@ -322,6 +335,28 @@ class DistributedSARTSolver:
             )
         self.n_pixel_shards = self.mesh.shape[PIXEL_AXIS]
         self.n_voxel_shards = self.mesh.shape.get(VOXEL_AXIS, 1)
+
+        self.operator = operator
+        self._operator_spec = None
+        if operator is not None:
+            if rtm is not None:
+                raise ValueError(
+                    "Pass either a matrix (rtm) or operator=, not both."
+                )
+            if operator.kind == "implicit":
+                self._init_implicit(operator, laplacian)
+                self._init_result_helpers()
+                return
+            # dense / tileskip operators unwrap onto the host-staging
+            # path: the matrix is their payload, and a tile-skip
+            # operator's occupancy index rides into the sparse plumbing
+            if tile_occupancy is None:
+                tile_occupancy = operator.tile_occupancy()
+            rtm = operator.payload()
+        elif rtm is None:
+            raise ValueError(
+                "DistributedSARTSolver needs a matrix (rtm) or operator=."
+            )
 
         dtype = jnp.dtype(opts.dtype)
         is_int8 = opts.rtm_dtype == "int8"
@@ -619,7 +654,7 @@ class DistributedSARTSolver:
             _obs_metrics.get_registry().gauge("rtm_tile_occupancy").set(
                 self._tile_occupancy.occupancy_fraction()
             )
-        self._solve_fns = {}
+        self._init_result_helpers()
         # Integrity layer (docs/RESILIENCE.md §8): keep the stats program
         # and an upload-time host snapshot of rho/lambda so the resident
         # matrix can be re-audited between frames (reaudit_ray_stats) and
@@ -632,15 +667,22 @@ class DistributedSARTSolver:
             self._ray_stats_snapshot = (
                 _fetch(ray_density).copy(), _fetch(ray_length).copy()
             )
-        # Tiny device helpers for the DeviceSolveResult path; their dispatch
-        # is asynchronous, so neither adds a synchronous host round trip.
-        # Scalars pack to fp32: status (0/-1) and iterations (<= max 2000)
-        # are exact; convergence is already computed in the device dtype.
-        # The pack output is pinned fully replicated so every process of a
-        # multi-host run reads it from its own devices (no host collective).
-        # NOT donated: the input is warm.solution_norm, whose buffer the
-        # producing DeviceSolveResult must stay able to fetch afterwards
-        # (the writer thread's lazy solution fetch)
+
+    def _init_result_helpers(self) -> None:
+        """Shared tail of both construction paths (dense and implicit):
+        the compiled-program cache and the tiny device helpers for the
+        DeviceSolveResult path. The helpers' dispatch is asynchronous, so
+        none adds a synchronous host round trip. Scalars pack to fp32:
+        status (0/-1) and iterations (<= max 2000) are exact; convergence
+        is already computed in the device dtype. The pack output is pinned
+        fully replicated so every process of a multi-host run reads it
+        from its own devices (no host collective). The rescale helper is
+        NOT donated: the input is warm.solution_norm, whose buffer the
+        producing DeviceSolveResult must stay able to fetch afterwards
+        (the writer thread's lazy solution fetch)."""
+        self._solve_fns = {}
+        self._ray_stats_fn = None
+        self._ray_stats_snapshot = None
         self._rescale_fn = jax.jit(  # sart-lint: disable=SL004
             lambda f, s: f * s[:, None].astype(f.dtype))
         self._pack_fn = jax.jit(
@@ -660,6 +702,101 @@ class DistributedSARTSolver:
         self._replicate_fn = jax.jit(
             lambda sol: sol, out_shardings=NamedSharding(self.mesh, P())
         )
+
+    def _init_implicit(self, operator, laplacian) -> None:
+        """Matrix-free construction: stage the ray table, derive the
+        padded :class:`ImplicitSpec`, and compute rho/lambda with the
+        SAME traced line-integral kernel the sweeps will use (Eq. 6
+        self-consistency without a matrix).
+
+        Mode restrictions (every one a polite ``SartInputError`` — all
+        reachable from CLI flags): pixel-sharded meshes only (the panel
+        back-projection's psum composition assumes whole voxel rows per
+        device), single-process only, and no int8 storage / integrity
+        ABFT / Laplacian smoothing / explicit block-sparse threshold /
+        forced Pallas fusion — each of those is a property OF the
+        materialized matrix."""
+        from sartsolver_tpu.config import SartInputError
+        from sartsolver_tpu.operators.implicit import implicit_ray_stats
+
+        opts = self.opts
+        if self.n_voxel_shards > 1:
+            raise SartInputError(
+                "The implicit (matrix-free) operator shards pixel rows "
+                "only; voxel-sharded meshes are not supported — use a "
+                "pixel-major mesh (--voxel_shards 1) or a materialized "
+                "matrix."
+            )
+        if jax.process_count() > 1:
+            raise SartInputError(
+                "The implicit (matrix-free) operator does not support "
+                "multi-host meshes; run single-process or materialize "
+                "the matrix."
+            )
+        if opts.rtm_dtype == "int8":
+            raise SartInputError(
+                "rtm_dtype='int8' quantizes a materialized matrix; the "
+                "implicit (matrix-free) operator has none — drop "
+                "--rtm_dtype int8 or materialize the matrix."
+            )
+        if opts.integrity:
+            raise SartInputError(
+                "integrity=True re-audits a resident matrix; the "
+                "implicit (matrix-free) operator holds none — drop "
+                "--integrity or materialize the matrix."
+            )
+        if opts.sparse_epsilon() is not None and opts.sparse_explicit():
+            raise SartInputError(
+                f"Argument sparse_rtm={opts.sparse_rtm}: the block-"
+                "sparse tile skip indexes a materialized matrix; the "
+                "implicit (matrix-free) operator has none."
+            )
+        if opts.fused_sweep in ("on", "interpret"):
+            raise SartInputError(
+                f"fused_sweep='{opts.fused_sweep}' forces the Pallas "
+                "matrix sweep, which needs a materialized matrix; the "
+                "implicit operator traces its own panel loop — use "
+                "fused_sweep='auto' or 'off'."
+            )
+        if laplacian is not None:
+            raise SartInputError(
+                "beta_laplace smoothing is not supported by the "
+                "implicit (matrix-free) operator."
+            )
+        self.npixel = int(operator.npixel)
+        self.nvoxel = int(operator.nvoxel)
+        self.padded_npixel = padded_size(
+            self.npixel, self.n_pixel_shards * ROW_ALIGN
+        )
+        self.padded_nvoxel = padded_size(self.nvoxel, COL_ALIGN)
+        self.voxel_block = self.padded_nvoxel
+        self._tile_occupancy = None
+        self._pixel_axis = PIXEL_AXIS if self.n_pixel_shards > 1 else None
+        self._voxel_axis = None
+        spec = operator.spec(padded_nvoxel=self.padded_nvoxel)
+        self._operator_spec = spec
+        # padding rows are all-zero rays: direction norm 0 fails the
+        # kernel's live-ray test, so they contribute nothing to rho and
+        # get lambda = 0 — inert under the solver's own Eq. 6 masking,
+        # exactly like a padded zero row of a materialized matrix
+        rays = np.zeros((self.padded_npixel, 6), np.float32)
+        rays[: self.npixel] = operator.payload()
+        rays_dev = _stage(rays, self.mesh, P(PIXEL_AXIS, None))
+        dtype = jnp.dtype(opts.dtype)
+        stats_fn = jax.jit(
+            shard_map(
+                functools.partial(
+                    implicit_ray_stats, spec=spec, dtype=dtype,
+                    axis_name=self._pixel_axis,
+                ),
+                mesh=self.mesh,
+                in_specs=P(PIXEL_AXIS, None),
+                out_specs=(P(VOXEL_AXIS), P(PIXEL_AXIS)),
+                check_vma=False,
+            )
+        )
+        ray_density, ray_length = stats_fn(rays_dev)
+        self.problem = SARTProblem(rays_dev, ray_density, ray_length, None)
 
     # Replicating [B, padded_nvoxel] fp32 on every device is the fast fetch
     # path, but above this per-device byte budget it would reintroduce the
@@ -730,7 +867,14 @@ class DistributedSARTSolver:
         if not faults.take_corrupt(faults.SITE_DEVICE_BUFFER):
             return
         rtm = self.problem.rtm
-        sharding = NamedSharding(self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
+        # the implicit "rtm" leaf is the ray table (pixel-sharded, its 6
+        # columns whole); perturbing element [0, 0] bends ray 0's origin
+        # while the uploaded stats stay stale — the same resident-rot
+        # signature
+        sharding = NamedSharding(self.mesh, (
+            P(PIXEL_AXIS, None) if self._operator_spec is not None
+            else P(PIXEL_AXIS, VOXEL_AXIS)
+        ))
         if rtm.dtype == jnp.int8:
             # codes live in [-127, 127]: reflect around 127 guarantees a
             # changed, in-range value for any code but 63 (the fixture
@@ -796,8 +940,14 @@ class DistributedSARTSolver:
         lap_spec = ShardedLaplacian(
             *(P(VOXEL_AXIS, None),) * len(ShardedLaplacian._fields)
         ) if has_lap else None
+        # the implicit problem's "rtm" leaf is the [P, 6] ray table:
+        # sharded over pixel rows, its 6 coordinate columns whole
+        rtm_spec = (
+            P(PIXEL_AXIS, None) if self._operator_spec is not None
+            else P(PIXEL_AXIS, VOXEL_AXIS)
+        )
         return SARTProblem(
-            P(PIXEL_AXIS, VOXEL_AXIS), P(VOXEL_AXIS), P(PIXEL_AXIS),
+            rtm_spec, P(VOXEL_AXIS), P(PIXEL_AXIS),
             lap_spec,
             P(VOXEL_AXIS) if self.problem.rtm_scale is not None else None,
         )
@@ -814,6 +964,7 @@ class DistributedSARTSolver:
         against the scoped-VMEM limit."""
         if (
             self._pixel_axis is None
+            and self._operator_spec is None
             and self.opts.fused_sweep != "off"
             and jax.default_backend() == "tpu"
         ):
@@ -854,6 +1005,7 @@ class DistributedSARTSolver:
                     fitted0=fitted0[0] if with_fitted0 else None,
                     return_fitted=True, _vmem_raised=vmem_raised,
                     tile_occupancy=self._tile_occupancy,
+                    operator_spec=self._operator_spec,
                 )
 
             fn = shard_map(
@@ -904,6 +1056,7 @@ class DistributedSARTSolver:
                     fitted0=fitted0[0] if with_fitted0 else None,
                     _vmem_raised=vmem_raised,
                     tile_occupancy=self._tile_occupancy,
+                    operator_spec=self._operator_spec,
                 )
 
             fn = shard_map(
@@ -1318,6 +1471,7 @@ class DistributedSARTSolver:
                     opts=opts, axis_name=pixel_axis, voxel_axis=voxel_axis,
                     use_guess=True, _vmem_raised=vmem_raised,
                     tile_occupancy=self._tile_occupancy,
+                    operator_spec=self._operator_spec,
                 )
 
             state_spec = self._sched_state_spec()
@@ -1825,3 +1979,60 @@ def _audit_sharded_sparse_panel_sweep():
         max_iterations=8, conv_tolerance=1e-30, fused_sweep="off",
         sparse_rtm="auto", fused_panel_voxels=_AUDIT_PANEL_VOXELS,
     ), H=H)
+
+
+@_register_audit_entry(
+    "sharded_implicit_batch",
+    description=f"pixel-sharded MATRIX-FREE batched solve step "
+                f"({_AUDIT_SHARDS}x1 mesh, fp32, geometry-traced "
+                "projections): the implicit panel loops replace both "
+                "matrix contractions, yet the loop must issue exactly the "
+                "dense sharded_batch's two designed all-reduces (back-"
+                "projection psum + convergence-metric psum) — the psum "
+                "composition invariant of the matrix-free backend",
+    # no matrix exists, so a matrix-block copy/convert cannot either —
+    # the thresholds keep the dense entries' bound, pinning that the
+    # traced kernel never materializes anything H-sized in the loop
+    loop_copy_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    loop_convert_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    # MUST equal sharded_batch's budget (ISSUE 19 acceptance): switching
+    # backends changes what a "sweep" reads, never how often devices talk
+    loop_collective_budget={
+        "all-reduce": 2, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+    min_devices=_AUDIT_SHARDS,
+)
+def _audit_sharded_implicit_batch():
+    from sartsolver_tpu.operators.geometry import Camera, GeometryRecord
+    from sartsolver_tpu.operators.implicit import ImplicitOperator
+
+    # one 8x16 camera = AUDIT_P rays; an (8, 8, 16) grid = AUDIT_V voxels
+    # (both already tile-aligned, so padding is the identity and the
+    # thresholds above describe the staged shapes exactly)
+    rec = GeometryRecord(
+        grid_shape=(8, 8, 16), origin=(0.0, 0.0, 0.0),
+        spacing=(1.0, 1.0, 1.0),
+        cameras=(Camera(
+            name="cam0", rows=8, cols=16,
+            position=(-20.0, 4.1, 8.2), target=(4.0, 4.0, 8.0),
+            pitch=0.9,
+        ),),
+    )
+    solver = DistributedSARTSolver(
+        opts=SolverOptions(max_iterations=8, conv_tolerance=1e-30,
+                           fused_sweep="off"),
+        mesh=make_mesh(_AUDIT_SHARDS, 1),
+        operator=ImplicitOperator(rec),
+    )
+    g = jax.device_put(
+        np.ones((1, solver.padded_npixel), np.float32),
+        NamedSharding(solver.mesh, P(None, PIXEL_AXIS)),
+    )
+    f0 = jax.device_put(
+        np.zeros((1, solver.padded_nvoxel), np.float32),
+        NamedSharding(solver.mesh, P(None, VOXEL_AXIS)),
+    )
+    return solver._batch_fn(True).lower(
+        solver.problem, g, jnp.ones(1, jnp.float32), f0
+    )

@@ -493,6 +493,36 @@ def test_broad_except_around_device_code_warns():
     assert not [f for f in clean if f.rule == "SL006"]
 
 
+def test_sl007_blesses_the_operator_layer_paths():
+    """SL007's path allowance: the same raw RTM contraction that is an
+    error in solver code is the operator layer's JOB inside
+    ops/fused_sweep.py, ops/projection.py, and anywhere under the
+    pluggable sartsolver_tpu/operators/ package (a backend's
+    forward/back IS the contraction everything else routes through)."""
+    src = _HEADER + textwrap.dedent(
+        """
+        def forward(rtm, f):
+            return rtm @ f
+        """
+    )
+    tripped = lint_source("sartsolver_tpu/models/sart.py", src)
+    assert [f for f in tripped if f.rule == "SL007"]
+    for blessed in (
+        "sartsolver_tpu/ops/projection.py",
+        "sartsolver_tpu/ops/fused_sweep.py",
+        "sartsolver_tpu/operators/dense.py",
+        "sartsolver_tpu/operators/implicit.py",
+        "/abs/checkout/sartsolver_tpu/operators/tileskip.py",
+    ):
+        clean = lint_source(blessed, src)
+        assert not [f for f in clean if f.rule == "SL007"], blessed
+    # near miss: a sibling package NAMED like the operators dir does not
+    # inherit the blessing (containment is on the package path, not the
+    # word "operators")
+    near = lint_source("sartsolver_tpu/sched/operators_report.py", src)
+    assert [f for f in near if f.rule == "SL007"]
+
+
 def test_sl101_acquire_guard_covers_body_not_else():
     """The `if lock.acquire(...):` guard holds the lock only in the `if`
     BODY; the else branch is the failed-acquire path — a guarded access

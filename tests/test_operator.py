@@ -1,0 +1,768 @@
+"""Matrix-free projection operators (sartsolver_tpu/operators/,
+docs/PERFORMANCE.md §11; `make operator`).
+
+Four layers, outermost last:
+
+- geometry records: round trip, validation taxonomy, the name-sorted
+  pixel-row convention, frame masks and the voxel-map surface;
+- the operator contract: payload/spec/resident-bytes/cache-key for the
+  dense and implicit backends, and the implicit kernels (forward / back
+  / ray stats / OS subset densities) against the matrix they claim to
+  apply;
+- solver parity: the implicit DistributedSARTSolver against a dense
+  solver on the materialized matrix across linear/log, ordered subsets,
+  momentum, divergence recovery, continuous batching and a pixel-sharded
+  mesh — identical statuses and iteration counts, solutions within the
+  fused-parity tolerance;
+- the serving engine: request admission of inline geometry, session
+  key/byte accounting, a geometry-built ResidentSession driven through
+  the ContinuousBatcher, and one real `sartsolve serve` process solving
+  a `submit --geometry` request on its own implicit session.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import h5py
+import numpy as np
+import pytest
+
+import fixtures as fx
+
+from sartsolver_tpu.config import DIVERGED, SartInputError, SolverOptions
+from sartsolver_tpu.operators import (
+    DenseOperator,
+    ImplicitOperator,
+    TileSkipOperator,
+)
+from sartsolver_tpu.operators.geometry import (
+    GeometryVoxelGrid,
+    load_geometry,
+    parse_geometry,
+    save_geometry,
+)
+from sartsolver_tpu.operators.implicit import (
+    ImplicitSpec,
+    implicit_back,
+    implicit_forward,
+    implicit_ray_stats,
+    implicit_subset_density,
+    pick_implicit_panel,
+)
+from sartsolver_tpu.parallel.mesh import COL_ALIGN, make_mesh, padded_size
+from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+from sartsolver_tpu.sched import ContinuousBatcher
+from sartsolver_tpu.utils.fused_parity import PARITY_RTOL
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+# The canonical test geometry: the fixture world's two cameras (camA
+# 3x4, camB 2x3 — the io/hdf5files.py row order is camA then camB) over
+# a 4x4x4 unit grid. 18 pixel rows, 64 voxels: underdetermined, so every
+# assertion below compares implicit vs DENSE-on-the-materialized-matrix,
+# never vs a ground truth the data cannot pin down.
+GEO_DICT = {
+    "format": "sart-geometry",
+    "version": 1,
+    "grid": {"shape": [4, 4, 4], "origin": [0.0, 0.0, 0.0],
+             "spacing": [1.0, 1.0, 1.0]},
+    "cameras": [
+        {"name": "camA", "rows": 3, "cols": 4,
+         "position": [-6.0, 2.1, 2.2], "target": [2.0, 2.0, 2.0],
+         "up": [0.0, 0.0, 1.0], "pitch": 0.8},
+        {"name": "camB", "rows": 2, "cols": 3,
+         "position": [2.2, -6.0, 1.9], "target": [2.0, 2.0, 2.0],
+         "up": [0.0, 0.0, 1.0], "pitch": 0.9},
+    ],
+}
+
+
+def _record():
+    return parse_geometry(json.loads(json.dumps(GEO_DICT)))
+
+
+def _case(seed=0):
+    """(record, operator, H fp64, g fp64): a consistent measurement on
+    the canonical geometry."""
+    rec = _record()
+    op = ImplicitOperator(rec)
+    H = op.materialize().astype(np.float64)
+    rng = np.random.default_rng(seed)
+    f_true = rng.uniform(0.5, 1.5, rec.nvoxel)
+    return rec, op, H, H @ f_true
+
+
+# ---------------------------------------------------------------------------
+# geometry records
+# ---------------------------------------------------------------------------
+
+def test_geometry_roundtrip(tmp_path):
+    rec = _record()
+    path = str(tmp_path / "geom.json")
+    save_geometry(rec, path)
+    back = load_geometry(path)
+    assert back == rec
+    assert ImplicitOperator(back).cache_key() == \
+        ImplicitOperator(rec).cache_key()
+    np.testing.assert_array_equal(back.build_rays(), rec.build_rays())
+
+
+def test_geometry_cameras_sorted_by_name():
+    """Pixel-row order is the repo-wide convention (cameras sorted by
+    name, row-major within each camera) regardless of record order."""
+    shuffled = json.loads(json.dumps(GEO_DICT))
+    shuffled["cameras"].reverse()
+    rec = parse_geometry(shuffled)
+    assert rec.camera_names == ("camA", "camB")
+    np.testing.assert_array_equal(rec.build_rays(), _record().build_rays())
+    # rays are unit-direction (origin xyz, direction xyz) rows
+    rays = rec.build_rays()
+    assert rays.shape == (rec.npixel, 6)
+    np.testing.assert_allclose(
+        np.linalg.norm(rays[:, 3:], axis=1), 1.0, rtol=1e-12)
+
+
+def _mutate(path, value):
+    payload = json.loads(json.dumps(GEO_DICT))
+    node = payload
+    *parents, leaf = path
+    for key in parents:
+        node = node[key]
+    if value is _DROP:
+        del node[leaf]
+    else:
+        node[leaf] = value
+    return payload
+
+
+_DROP = object()
+
+BAD_RECORDS = [
+    (["format"], "sart-rtm", "format"),
+    (["version"], 99, "version"),
+    (["grid"], _DROP, "grid"),
+    (["grid", "shape"], [4, 4], "grid.shape"),
+    (["grid", "shape"], [4, 0, 4], "grid.shape"),
+    (["grid", "spacing"], [1.0, -1.0, 1.0], "grid.spacing"),
+    (["grid", "spacing"], _DROP, "grid.spacing"),
+    (["cameras"], [], "cameras"),
+    (["cameras", 0, "name"], "", "name"),
+    (["cameras", 0, "rows"], 0, "rows"),
+    (["cameras", 0, "pitch"], 0.0, "pitch"),
+    (["cameras", 0, "position"], [2.0, 2.0, 2.0], "coincide"),
+    (["cameras", 0, "up"], [-8.0, 0.1, 0.2], "parallel"),
+    (["cameras", 0, "position"], [1.0, "x", 0.0], "position"),
+    (["cameras", 1, "name"], "camA", "unique"),
+]
+
+
+@pytest.mark.parametrize("path,value,match", BAD_RECORDS,
+                         ids=[m for *_, m in BAD_RECORDS])
+def test_geometry_validation(path, value, match):
+    with pytest.raises(SartInputError, match=match):
+        parse_geometry(_mutate(path, value))
+
+
+def test_geometry_rejects_non_json_and_unknown_version_text():
+    with pytest.raises(SartInputError, match="JSON"):
+        parse_geometry("{not json")
+    with pytest.raises(SartInputError, match="object"):
+        parse_geometry([1, 2, 3])
+
+
+def test_geometry_frame_masks_and_voxel_grid():
+    rec = _record()
+    masks = rec.frame_masks()
+    assert set(masks) == {"camA", "camB"}
+    assert masks["camA"].shape == (3, 4) and masks["camA"].all()
+    assert masks["camB"].shape == (2, 3) and masks["camB"].all()
+    grid = GeometryVoxelGrid(rec)
+    assert grid.nvox == rec.nvoxel == 64
+    np.testing.assert_array_equal(grid.voxmap, np.arange(64))
+    assert (grid.nx, grid.ny, grid.nz) == (4, 4, 4)
+    assert grid.xmax == pytest.approx(4.0)
+
+
+# ---------------------------------------------------------------------------
+# the operator contract
+# ---------------------------------------------------------------------------
+
+def test_operator_identity_and_accounting():
+    rec, op, H, _g = _case()
+    assert op.kind == "implicit"
+    assert op.shape == (18, 64)
+    payload = op.payload()
+    assert payload.shape == (18, 6) and payload.dtype == np.float32
+    # the whole point: rays are O(npixel) bytes, the matrix is O(P*V)
+    assert op.resident_nbytes() == 18 * 6 * 4 == 432
+    dense = DenseOperator(H.astype(np.float32))
+    assert dense.resident_nbytes() == 18 * 64 * 4
+    assert op.resident_nbytes() < dense.resident_nbytes() / 10
+    # cache keys pin backend + shapes + dtype + geometry digest
+    key = op.cache_key()
+    assert key.startswith("implicit:18x64:float32:")
+    assert key == ImplicitOperator(_record()).cache_key()
+    moved = json.loads(json.dumps(GEO_DICT))
+    moved["cameras"][0]["position"][0] -= 0.5
+    assert ImplicitOperator(parse_geometry(moved)).cache_key() != key
+    assert dense.cache_key() != key
+    np.testing.assert_array_equal(dense.materialize(),
+                                  H.astype(np.float32))
+
+
+def test_implicit_spec_validation():
+    with pytest.raises(ValueError, match="multiply out"):
+        ImplicitSpec(grid_shape=(4, 4, 4), origin=(0, 0, 0),
+                     spacing=(1, 1, 1), nvoxel=128, grid_voxels=65,
+                     panel_voxels=128)
+    with pytest.raises(ValueError, match="smaller than the"):
+        ImplicitSpec(grid_shape=(8, 8, 8), origin=(0, 0, 0),
+                     spacing=(1, 1, 1), nvoxel=128, grid_voxels=512,
+                     panel_voxels=128)
+    with pytest.raises(ValueError, match="divide"):
+        ImplicitSpec(grid_shape=(4, 4, 4), origin=(0, 0, 0),
+                     spacing=(1, 1, 1), nvoxel=128, grid_voxels=64,
+                     panel_voxels=96)
+
+
+def test_pick_implicit_panel():
+    assert pick_implicit_panel(128) == 128
+    assert pick_implicit_panel(1024) == 1024
+    # 2048 splits into two 1024 panels; 1280 into 256-wide panels
+    assert pick_implicit_panel(2048) == 1024
+    assert 1280 % pick_implicit_panel(1280) == 0
+    assert pick_implicit_panel(1280) % COL_ALIGN == 0
+    with pytest.raises(ValueError, match="multiple"):
+        pick_implicit_panel(100)
+
+
+def test_matrix_entries_are_ray_segment_lengths():
+    """Physical sanity of the slab kernel: entries are nonnegative, a
+    ray's row sum equals its chord length through the grid (at most the
+    grid diagonal), and rays that miss the grid give all-zero rows."""
+    _rec, op, H, _g = _case()
+    assert (H >= 0).all()
+    # every live entry is at most one voxel's diagonal
+    assert H.max() <= np.sqrt(3.0) + 1e-6
+    chords = H.sum(axis=1)
+    assert chords.max() <= np.sqrt(3.0) * 4 + 1e-6
+    # the two cameras look at the grid center: most rays hit
+    assert (chords > 0).sum() >= 12
+
+
+def test_implicit_kernels_match_materialized_matrix():
+    """forward/back/ray-stats/subset-density against the dense matrix
+    the operator claims to apply, including padded rows and columns."""
+    rec, op, H, _g = _case()
+    spec = op.spec()
+    V_pad = spec.nvoxel
+    assert V_pad == padded_size(64, COL_ALIGN) == 128
+    rays = np.zeros((24, 6), np.float32)  # 6 zero-padded ray rows
+    rays[:18] = op.payload()
+    rng = np.random.default_rng(1)
+    f = np.zeros(V_pad, np.float32)
+    f[:64] = rng.uniform(0.0, 2.0, 64)
+    got = np.asarray(implicit_forward(rays, f, spec))
+    want = H @ f[:64].astype(np.float64)
+    np.testing.assert_allclose(got[:18], want, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(got[18:], 0.0)
+
+    w = rng.uniform(0.0, 1.0, 24).astype(np.float32)
+    w[18:] = 0.0
+    got_b = np.asarray(implicit_back(rays, w, spec))
+    want_b = H.T @ w[:18].astype(np.float64)
+    np.testing.assert_allclose(got_b[:64], want_b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(got_b[64:], 0.0)
+
+    dens, length = implicit_ray_stats(rays, spec)
+    np.testing.assert_allclose(np.asarray(dens)[:64], H.sum(axis=0),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(dens)[64:], 0.0)
+    np.testing.assert_allclose(np.asarray(length)[:18], H.sum(axis=1),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(length)[18:], 0.0)
+
+    # OS subsets: subset t is ray rows t::os — the dense reshape stacking
+    sub = np.asarray(implicit_subset_density(rays, spec, 3))
+    H_pad = np.zeros((24, 128))
+    H_pad[:18, :64] = H
+    want_sub = H_pad.reshape(8, 3, 128).sum(axis=0)
+    np.testing.assert_allclose(sub, want_sub, rtol=1e-5, atol=1e-6)
+
+
+def test_batched_forward_matches_per_frame():
+    rec, op, _H, _g = _case()
+    spec = op.spec()
+    rays = op.payload()
+    rng = np.random.default_rng(2)
+    fb = rng.uniform(0.0, 1.0, (3, spec.nvoxel)).astype(np.float32)
+    got = np.asarray(implicit_forward(rays, fb, spec))
+    for b in range(3):
+        np.testing.assert_allclose(
+            got[b], np.asarray(implicit_forward(rays, fb[b], spec)),
+            rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------------------------
+# solver parity matrix: implicit vs dense-on-the-materialized-matrix
+# ---------------------------------------------------------------------------
+
+def _opts(**kw):
+    # conv_tolerance=0.0 disables the stall test outright so both
+    # backends run to max_iterations: at any positive tolerance the
+    # |conv - conv_prev| comparison sits on an fp32 noise boundary (an
+    # exact plateau on one backend but not the other) and the two can
+    # retire iterations apart — exactly the flake the fused-parity
+    # harness avoids too
+    kw.setdefault("max_iterations", 40)
+    kw.setdefault("conv_tolerance", 0.0)
+    kw.setdefault("fused_sweep", "off")
+    return SolverOptions(**kw)
+
+
+def _assert_parity(imp_res, ref_res, nvoxel=64, rtol=PARITY_RTOL):
+    assert int(imp_res.status) == int(ref_res.status)
+    assert int(imp_res.iterations) == int(ref_res.iterations)
+    a = np.asarray(imp_res.solution)[:nvoxel]
+    b = np.asarray(ref_res.solution)[:nvoxel]
+    scale = max(np.max(np.abs(b)), 1e-12)
+    assert np.max(np.abs(a - b)) <= rtol * scale
+
+
+PARITY_LEGS = [
+    ("linear", {}),
+    ("log", {"logarithmic": True}),
+    ("os", {"os_subsets": 3}),
+    ("momentum", {"momentum": "nesterov"}),
+    ("auto-declines", {"fused_sweep": "auto", "sparse_rtm": "auto"}),
+]
+
+
+@pytest.mark.parametrize("name,kw", PARITY_LEGS,
+                         ids=[n for n, _ in PARITY_LEGS])
+def test_parity_vs_dense(name, kw):
+    """Same opts, same measurements, same mesh: the matrix-free solve
+    must land on the dense solve's answer with identical per-frame
+    statuses and iteration counts (fused-parity tolerance)."""
+    _rec, op, H, g = _case()
+    opts = _opts(**kw)
+    imp = DistributedSARTSolver(operator=op, opts=opts,
+                                mesh=make_mesh(1, 1))
+    dense = DistributedSARTSolver(H.astype(np.float32), opts=opts,
+                                  mesh=make_mesh(1, 1))
+    try:
+        for scale in (1.0, 1.3):
+            _assert_parity(imp.solve(g * scale), dense.solve(g * scale))
+    finally:
+        imp.close()
+        dense.close()
+
+
+def test_parity_pixel_sharded_mesh():
+    """Implicit on a (4, 1) pixel-sharded mesh vs dense single-device:
+    one tolerance covers both the backend and the sharding."""
+    _rec, op, H, g = _case()
+    opts = _opts()
+    imp = DistributedSARTSolver(operator=op, opts=opts,
+                                mesh=make_mesh(4, 1))
+    dense = DistributedSARTSolver(H.astype(np.float32), opts=opts,
+                                  mesh=make_mesh(1, 1))
+    try:
+        _assert_parity(imp.solve(g), dense.solve(g))
+        # warm-started chain, the CLI's frame loop shape
+        w_imp = imp.solve(g * 1.2, f0=imp.solve(g).solution)
+        w_dense = dense.solve(g * 1.2, f0=dense.solve(g).solution)
+        _assert_parity(w_imp, w_dense)
+    finally:
+        imp.close()
+        dense.close()
+
+
+def test_parity_divergence_recovery():
+    """The rollback/relaxation ladder walks identically matrix-free.
+    The convergence metric is scale-invariant (Eq. 5 normalizes by
+    ||g||^2), so the deterministic trigger is a non-finite metric: a
+    NaN-poisoned measurement exhausts the ladder to DIVERGED on both
+    backends, same iteration count, finite iterates."""
+    _rec, op, H, g = _case()
+    opts = _opts(divergence_recovery=3)
+    imp = DistributedSARTSolver(operator=op, opts=opts,
+                                mesh=make_mesh(1, 1))
+    dense = DistributedSARTSolver(H.astype(np.float32), opts=opts,
+                                  mesh=make_mesh(1, 1))
+    try:
+        g_bad = g.copy()
+        g_bad[4] = np.nan
+        ri = imp.solve(g_bad)
+        rd = dense.solve(g_bad)
+        assert int(ri.status) == int(rd.status) == DIVERGED
+        assert int(ri.iterations) == int(rd.iterations)
+        assert np.isfinite(np.asarray(ri.solution)).all()
+        assert np.isfinite(np.asarray(rd.solution)).all()
+        # and clean data still solves cleanly with recovery armed
+        _assert_parity(imp.solve(g), dense.solve(g))
+    finally:
+        imp.close()
+        dense.close()
+
+
+def test_parity_continuous_batching():
+    """ContinuousBatcher lanes over the implicit solver vs the same
+    batcher over the dense solver: emission order, statuses, iteration
+    counts identical; solutions within the parity tolerance."""
+    _rec, op, H, g = _case()
+    rng = np.random.default_rng(3)
+    frames = [np.maximum(g * s + 0.01 * rng.standard_normal(18), 0.0)
+              for s in (1.0, 0.7, 1.4, 1.1, 0.9)]
+    items = [(fr, float(i), [float(i)]) for i, fr in enumerate(frames)]
+    opts = _opts(schedule_stride=4)
+
+    def _drive(solver):
+        out = []
+
+        def on_result(ftime, _ct, status, iters, _conv, fetcher, _ms):
+            out.append((ftime, status, iters, fetcher()))
+
+        def on_failed(ftime, _ct, err):
+            raise AssertionError(f"frame {ftime} failed: {err}")
+
+        b = ContinuousBatcher(solver, lanes=2, on_result=on_result,
+                              on_failed=on_failed)
+        b.run(iter(list(items)))
+        return out
+
+    imp = DistributedSARTSolver(operator=op, opts=opts,
+                                mesh=make_mesh(2, 1))
+    dense = DistributedSARTSolver(H.astype(np.float32), opts=opts,
+                                  mesh=make_mesh(2, 1))
+    try:
+        got = _drive(imp)
+        want = _drive(dense)
+    finally:
+        imp.close()
+        dense.close()
+    assert [r[:3] for r in got] == [r[:3] for r in want]
+    for (_t, _s, _i, a), (_t2, _s2, _i2, b) in zip(got, want):
+        a, b = np.asarray(a)[:64], np.asarray(b)[:64]
+        assert np.max(np.abs(a - b)) <= \
+            PARITY_RTOL * max(np.max(np.abs(b)), 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# implicit-mode restrictions (all polite input errors)
+# ---------------------------------------------------------------------------
+
+RESTRICTION_LEGS = [
+    ("voxel-sharded", {}, (1, 2), "voxel-sharded"),
+    ("int8", {"rtm_dtype": "int8"}, (1, 1), "int8"),
+    ("integrity", {"integrity": True}, (1, 1), "integrity"),
+    ("sparse-explicit", {"sparse_rtm": "1e-8"}, (1, 1), "block-"),
+    ("fused-on", {"fused_sweep": "on"}, (1, 1), "fused_sweep"),
+    ("fused-interpret", {"fused_sweep": "interpret"}, (1, 1),
+     "fused_sweep"),
+]
+
+
+@pytest.mark.parametrize("name,kw,mesh_shape,match", RESTRICTION_LEGS,
+                         ids=[leg[0] for leg in RESTRICTION_LEGS])
+def test_implicit_restrictions(name, kw, mesh_shape, match):
+    _rec, op, _H, _g = _case()
+    base = dict(max_iterations=5, conv_tolerance=1e-30)
+    if "fused_sweep" not in kw:
+        base["fused_sweep"] = "off"
+    with pytest.raises(SartInputError, match=match):
+        DistributedSARTSolver(operator=op, opts=SolverOptions(**base, **kw),
+                              mesh=make_mesh(*mesh_shape))
+
+
+def test_implicit_rejects_laplacian_and_matrix_conflicts():
+    from sartsolver_tpu.ops.laplacian import make_laplacian
+
+    _rec, op, H, _g = _case()
+    lap = make_laplacian(np.array([0]), np.array([0]),
+                         np.array([1.0], np.float32), dtype="float32")
+    with pytest.raises(SartInputError, match="beta_laplace"):
+        DistributedSARTSolver(operator=op, laplacian=lap, opts=_opts(),
+                              mesh=make_mesh(1, 1))
+    with pytest.raises(ValueError, match="not both"):
+        DistributedSARTSolver(H.astype(np.float32), operator=op,
+                              opts=_opts(), mesh=make_mesh(1, 1))
+
+
+# ---------------------------------------------------------------------------
+# engine integration: request admission, session accounting, CLI, serve
+# ---------------------------------------------------------------------------
+
+def test_request_carries_validated_geometry():
+    from sartsolver_tpu.engine.request import RequestError, parse_request
+
+    req = parse_request({"id": "g1", "geometry": GEO_DICT})
+    # stored canonicalized (validated + name-sorted), so the journal's
+    # replay rebuilds the identical operator byte-for-byte
+    assert req.geometry == _record().to_dict()
+    assert req.to_dict()["geometry"] == req.geometry
+    bad = json.loads(json.dumps(GEO_DICT))
+    bad["version"] = 7
+    with pytest.raises(RequestError, match="geometry"):
+        parse_request({"id": "g2", "geometry": bad})
+    assert parse_request({"id": "p1"}).geometry is None
+
+
+def _image_files_for(rec, tmp, n_frames=2):
+    """Write image files matching the geometry's cameras, frame t scaled
+    by (1 + 0.1 t), measurement consistent with the materialized H."""
+    H = ImplicitOperator(rec).materialize().astype(np.float64)
+    rng = np.random.default_rng(0)
+    g = H @ rng.uniform(0.5, 1.5, rec.nvoxel)
+    paths, off = [], 0
+    for cam in rec.cameras:
+        block = g[off:off + cam.npixel]
+        frames = [block.reshape(cam.rows, cam.cols) * (1.0 + 0.1 * t)
+                  for t in range(n_frames)]
+        times = [0.1 + 0.1 * t for t in range(n_frames)]
+        p = os.path.join(tmp, f"img_{cam.name}.h5")
+        fx._write_image_file(p, cam.name, frames, times)
+        paths.append(p)
+        off += cam.npixel
+    return paths, g
+
+
+def _geometry_args(paths, geo_path, **kw):
+    ns = argparse.Namespace(
+        input_files=list(paths), geometry=geo_path, laplacian_file=None,
+        logarithmic=False, ray_density_threshold=0.0,
+        ray_length_threshold=0.0, conv_tolerance=0.0, beta_laplace=0.0,
+        relaxation=1.0, relaxation_decay=1.0, max_iterations=40,
+        divergence_recovery=False, integrity=False, os_subsets=1,
+        momentum="off", fused_sweep="off", use_cpu=False, rtm_dtype=None,
+        sparse_rtm="off", pixel_shards=2, voxel_shards=None,
+        max_cached_frames=10, raytransfer_name="with_reflections",
+        wavelength_threshold=1.0, batch_frames=None,
+    )
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def test_geometry_session_accounting_and_batched_parity(tmp_path):
+    """ResidentSession.build from a geometry record: the session's cache
+    key is the operator's, its byte charge is the ray table (not a
+    phantom RTM), and a request attached through the ContinuousBatcher
+    solves to dense parity with identical statuses."""
+    from sartsolver_tpu.engine.request import parse_request
+    from sartsolver_tpu.engine.session import (
+        ResidentSession, key_of, session_nbytes,
+    )
+
+    rec = _record()
+    geo_path = str(tmp_path / "geom.json")
+    save_geometry(rec, geo_path)
+    paths, g = _image_files_for(rec, str(tmp_path))
+    sess = ResidentSession.build(_geometry_args(paths, geo_path))
+    try:
+        assert session_nbytes(sess) == 432  # 18 rays x 6 x fp32
+        assert session_nbytes(sess) < 18 * 64 * 4  # << dense RTM
+        key = key_of(sess)
+        assert key.startswith("implicit:18x64:float32:")
+        assert key.endswith(":2x1")  # mesh shape rides the cache key
+        req = parse_request({"id": "r1", "geometry": GEO_DICT})
+        image = sess.attach(req)
+        assert sess.n_frames(image) == 2
+
+        dense = DistributedSARTSolver(
+            ImplicitOperator(rec).materialize().astype(np.float32),
+            opts=_opts(), mesh=make_mesh(2, 1))
+        results = {}
+
+        def on_result(ftime, _ct, status, iters, _conv, fetcher, _ms):
+            results[ftime] = (status, iters, fetcher())
+
+        def on_failed(ftime, _ct, err):
+            raise AssertionError(f"frame {ftime} failed: {err}")
+
+        b = ContinuousBatcher(sess.solver, lanes=2, on_result=on_result,
+                              on_failed=on_failed)
+        b.run(iter(list(sess.frame_items(image, None))))
+        assert len(results) == 2
+        for t, (status, iters, sol) in sorted(results.items()):
+            scale = 1.0 + 0.1 * round((t - 0.1) / 0.1)
+            ref = dense.solve(g * scale)
+            assert int(status) == int(ref.status)
+            assert int(iters) == int(ref.iterations)
+            a = np.asarray(sol)[:64]
+            bref = np.asarray(ref.solution)[:64]
+            assert np.max(np.abs(a - bref)) <= \
+                PARITY_RTOL * max(np.max(np.abs(bref)), 1e-12)
+        dense.close()
+    finally:
+        sess.close()
+
+
+def test_dense_session_accounting_unchanged(tmp_path):
+    """The default (matrix-file) session keeps the legacy session_key
+    string and the npixel*nvoxel byte estimate — the operator layer must
+    not perturb dense serving identity."""
+    from sartsolver_tpu.cli import _validate
+    from sartsolver_tpu.engine.cli import build_serve_parser
+    from sartsolver_tpu.engine.session import (
+        ResidentSession, key_of, session_key, session_nbytes,
+    )
+
+    paths, *_ = fx.write_world(str(tmp_path), n_frames=2)
+    args = build_serve_parser().parse_args([
+        "--engine_dir", "/nonexistent-unused", "--use_cpu", "-m", "10",
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+    ])
+    _validate(args)
+    sess = ResidentSession.build(args)
+    try:
+        assert sess.operator is not None and sess.operator.kind in (
+            "dense", "tileskip")
+        if sess.operator.kind == "dense":
+            dtype = sess.opts.rtm_dtype or sess.opts.dtype
+            assert key_of(sess) == session_key(
+                sess.npixel, sess.nvoxel, dtype, sess.mesh_shape)
+        assert session_nbytes(sess) == sess.operator.resident_nbytes()
+        assert session_nbytes(sess) >= \
+            sess.npixel * sess.nvoxel * 4  # the full matrix footprint
+    finally:
+        sess.close()
+
+
+def test_cli_geometry_end_to_end(tmp_path):
+    """One-shot `sartsolve --geometry`: solves image files matrix-free,
+    writes the standard solution HDF5, warm-start chain at dense
+    parity."""
+    from sartsolver_tpu.cli import main
+
+    rec = _record()
+    geo_path = str(tmp_path / "geom.json")
+    save_geometry(rec, geo_path)
+    paths, g = _image_files_for(rec, str(tmp_path))
+    out = str(tmp_path / "sol.h5")
+    # the CLI requires a positive tolerance; 1e-30 never trips the stall
+    # test at 40 iterations on this data, and the parity assertion below
+    # compares solutions only (2e-4 dwarfs a +/-1 iteration wobble)
+    code = main(["--geometry", geo_path, "-o", out,
+                 "--max_iterations", "40", "--conv_tolerance", "1e-30",
+                 "--fused_sweep", "off", *paths])
+    assert code == 0
+    with h5py.File(out, "r") as f:
+        sol = f["solution/value"][...]
+        times = f["solution/time"][...]
+    assert sol.shape == (2, 64)
+    np.testing.assert_allclose(times, [0.1, 0.2], atol=1e-9)
+
+    dense = DistributedSARTSolver(
+        ImplicitOperator(rec).materialize().astype(np.float32),
+        opts=_opts(), mesh=make_mesh(1, 1))
+    prev = None
+    for i, scale in enumerate((1.0, 1.1)):
+        res = dense.solve(g * scale, f0=prev)
+        prev = res.solution
+        ref = np.asarray(res.solution)[:64]
+        assert np.max(np.abs(sol[i] - ref)) <= \
+            PARITY_RTOL * max(np.max(np.abs(ref)), 1e-12)
+    dense.close()
+
+
+def test_cli_geometry_rejects_matrix_files(tmp_path):
+    """--geometry replaces the RTM files: passing both is a polite input
+    error, not a silent preference."""
+    from sartsolver_tpu.cli import main
+
+    rec = _record()
+    geo_path = str(tmp_path / "geom.json")
+    save_geometry(rec, geo_path)
+    paths, *_ = fx.write_world(str(tmp_path), n_frames=2)
+    out = str(tmp_path / "sol.h5")
+    assert main(["--geometry", geo_path, "-o", out,
+                 paths["rtm_a1"], paths["img_a"], paths["img_b"]]) == 1
+    # and a geometry whose cameras don't match the image files fails
+    other = json.loads(json.dumps(GEO_DICT))
+    other["cameras"][1]["name"] = "camC"
+    other_path = str(tmp_path / "geom2.json")
+    with open(other_path, "w") as f:
+        json.dump(other, f)
+    assert main(["--geometry", other_path, "-o", out,
+                 paths["img_a"], paths["img_b"]]) == 1
+
+
+# ---------------------------------------------------------------------------
+# real-process serve + submit --geometry
+# ---------------------------------------------------------------------------
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("SART_TEST_JOURNAL_DELAY", None)
+    env.pop("SART_FAULT", None)
+    return env
+
+
+def test_serve_submit_geometry_attach(tmp_path):
+    """THE acceptance drill: a real `sartsolve serve` resident on the
+    dense world accepts `submit --geometry`, builds the request its own
+    implicit session (432 resident bytes vs the dense session's KBs),
+    solves it to completion, and keys it by geometry digest."""
+    td = str(tmp_path)
+    paths, *_ = fx.write_world(td, n_frames=4)
+    eng = os.path.join(td, "eng")
+    geo_path = os.path.join(td, "geom.json")
+    save_geometry(_record(), geo_path)
+    env = _env()
+    serve_cmd = [
+        sys.executable, "-m", "sartsolver_tpu.cli", "serve",
+        "--engine_dir", eng, "--use_cpu", "-m", "40", "-c", "1e-12",
+        "--lanes", "2", "--poll_interval", "0.05",
+        paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+        paths["img_a"], paths["img_b"],
+    ]
+    proc = subprocess.Popen(serve_cmd, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    lines = []
+    for line in proc.stdout:
+        lines.append(line)
+        if "session resident" in line:
+            break
+    else:
+        proc.kill()
+        raise AssertionError("serve never became resident:\n"
+                             + "".join(lines))
+    threading.Thread(target=lambda: lines.extend(proc.stdout),
+                     daemon=True).start()
+    try:
+        for rid, extra in (("d1", []), ("g1", ["--geometry", geo_path])):
+            done = subprocess.run(
+                [sys.executable, "-m", "sartsolver_tpu.cli", "submit",
+                 "--engine_dir", eng, "--id", rid, *extra,
+                 "--wait", "120"],
+                env=env, capture_output=True, text=True, timeout=180)
+            assert done.returncode == 0, done.stdout + done.stderr
+            rec = json.loads(done.stdout)
+            assert rec["outcome"]["status"] == "completed", rec
+            assert rec["outcome"]["frames"] == 4
+        out = os.path.join(eng, "outputs", "g1.h5")
+        with h5py.File(out, "r") as f:
+            sol = f["solution/value"][...]
+        assert sol.shape[-1] == 64 and np.isfinite(sol).all()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=60)
+    assert rc == 4
+    text = "".join(lines)
+    # the geometry request got its OWN implicit session, charged at ray-
+    # table bytes, keyed by the record digest
+    assert "operator=implicit" in text
+    assert "resident_bytes=432" in text
+    assert "session-attach: key=geometry:" in text
