@@ -1029,6 +1029,120 @@ def _worker_main() -> int:
             )
         return out
 
+    def run_lowrank(timed_reps: int) -> dict:
+        """Low-rank + sparse factored RTM vs dense vs tile-skip on the
+        SAME matrix (ISSUE 20, docs/PERFORMANCE.md §12): a fixed-shape
+        synthetic RTM whose sparse core occupies half the voxel panels
+        plus a dense rank-8 reflection floor — the floor puts signal in
+        EVERY tile, so the tile-skip path degenerates to occupancy 1.0
+        (its floor) while the factorization splits the fill into two
+        skinny matmuls. Records iter/s for all three paths and the
+        MEASURED per-step FLOPs of each compiled batch step (XLA cost
+        analysis of the staged solve, the same probe the audit goldens
+        pin); detail.lowrank.flop_reduction is gated run-over-run by
+        `sartsolve metrics --diff --threshold` in `make bench-smoke`,
+        parity-asserted at the shared fused-parity tolerance."""
+        from sartsolver_tpu.operators.lowrank import build_lowrank_operator
+        from sartsolver_tpu.parallel.mesh import make_mesh
+        from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
+        from sartsolver_tpu.utils.fused_parity import PARITY_RTOL
+
+        # FIXED overdetermined shape (pixels > voxels), independent of
+        # the sweep env so smoke/TPU rounds stay comparable: the
+        # solve-parity gate compares SOLUTIONS, and an underdetermined
+        # system would let fp32 rounding wander in the null space
+        Ps, Vs, Bs, bs = 2048, 1024, 8, 128
+        lrng = np.random.default_rng(20)
+        n_panels = Vs // bs
+        Hs = np.zeros((Ps, Vs), np.float32)
+        for j in range(n_panels // 2):
+            lo = j * bs
+            Hs[:, lo:lo + bs] = (
+                lrng.random((Ps, bs), dtype=np.float32) * 0.9 + 0.1
+            )
+        u_fl = (0.002 * lrng.standard_normal((Ps, 8))).astype(np.float32)
+        v_fl = lrng.standard_normal((Vs, 8)).astype(np.float32)
+        Hs = (Hs + u_fl @ v_fl.T).astype(np.float32)  # sub-eps floor
+        f_lr = lrng.random((Bs, Vs), dtype=np.float32) + 0.5
+        G_lr = (f_lr.astype(np.float64)
+                @ Hs.astype(np.float64).T).astype(np.float32)
+
+        op, reason = build_lowrank_operator(Hs, rank=8)
+        if op is None:
+            return {"error": f"lowrank factorization declined: {reason}"}
+
+        def measure(build):
+            solver = build()
+            try:
+                res = solver.solve_batch(G_lr)  # compile + warm
+                sol = np.asarray(res.solution)
+                n_done = max(int(np.asarray(res.iterations)[0]), 1)
+                best = float("inf")
+                for _ in range(timed_reps):
+                    t_rep = time.perf_counter()
+                    res = solver.solve_batch(G_lr)
+                    sol = np.asarray(res.solution)
+                    best = min(best, time.perf_counter() - t_rep)
+                # measured per-step FLOPs of the compiled batch-1 step —
+                # the number the lowrank_sweep/sweep cost goldens pin
+                cost = solver._batch_fn(True).lower(
+                    solver.problem,
+                    jnp.ones((1, solver.padded_npixel), jnp.float32),
+                    jnp.ones(1, jnp.float32),
+                    jnp.zeros((1, solver.padded_nvoxel), jnp.float32),
+                ).compile().cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0]
+                return n_done / best, sol[0, :Vs], float(cost["flops"])
+            finally:
+                solver.close()
+
+        base = dict(max_iterations=min(iters, 50), conv_tolerance=0.0,
+                    fused_sweep="auto")
+        den_rate, den_sol, den_flops = measure(
+            lambda: DistributedSARTSolver(
+                Hs, opts=SolverOptions(**base), mesh=make_mesh(1, 1)))
+        ts_rate, ts_sol, ts_flops = measure(
+            lambda: DistributedSARTSolver(
+                Hs, opts=SolverOptions(**base, sparse_rtm="0",
+                                       fused_panel_voxels=bs),
+                mesh=make_mesh(1, 1)))
+        lr_rate, lr_sol, lr_flops = measure(
+            lambda: DistributedSARTSolver(
+                operator=op, opts=SolverOptions(**base),
+                mesh=make_mesh(1, 1)))
+        d_lr = float(np.max(np.abs(lr_sol - den_sol)))
+        d_ts = float(np.max(np.abs(ts_sol - den_sol)))
+        scale = float(np.max(np.abs(den_sol)))
+        parity = bool(max(d_lr, d_ts) <= PARITY_RTOL * max(scale, 1.0))
+        out = {
+            "npixel": Ps, "nvoxel": Vs, "rank": op.rank,
+            "core_occupancy": round(
+                op.tile_occupancy().occupancy_fraction(), 4),
+            "iter_s_dense": round(den_rate, 2),
+            "iter_s_tileskip": round(ts_rate, 2),
+            "iter_s_lowrank": round(lr_rate, 2),
+            "step_flops_dense": den_flops,
+            "step_flops_tileskip": ts_flops,
+            "step_flops_lowrank": lr_flops,
+            "flop_reduction": round(den_flops / max(lr_flops, 1.0), 3),
+            "flop_reduction_vs_tileskip": round(
+                ts_flops / max(lr_flops, 1.0), 3),
+            "parity_max_abs_diff": round(max(d_lr, d_ts), 9),
+            "parity": parity,
+        }
+        if not parity:
+            out["error"] = (
+                f"lowrank/tileskip-vs-dense parity FAILED: "
+                f"max|d|={max(d_lr, d_ts):.3e} vs scale {scale:.3e}"
+            )
+        elif lr_flops >= min(den_flops, ts_flops):
+            out["error"] = (
+                f"factored step FLOPs {lr_flops:g} are not below the "
+                f"dense ({den_flops:g}) / tile-skip ({ts_flops:g}) floor"
+            )
+        return out
+
     def run_probe() -> dict:
         """~0.35 s fixed-shape bandwidth probe (VERDICT r4 next #5): a
         50-step power iteration over the staged fp32 matrix using the
@@ -1196,6 +1310,8 @@ def _worker_main() -> int:
                 data = run_sparse(item["occ"], item["reps"])
             elif item["kind"] == "operator":
                 data = run_operator(item["reps"])
+            elif item["kind"] == "lowrank":
+                data = run_lowrank(item["reps"])
             elif item["kind"] == "probe":
                 data = run_probe()
             else:
@@ -1533,6 +1649,16 @@ def main() -> int:
     items.append({"kind": "operator", "id": "operator:implicit",
                   "reps": 2, "deadline": budget_s + 240,
                   "timeout": cfg_timeout})
+    # low-rank + sparse factored RTM section (ISSUE 20, docs/
+    # PERFORMANCE.md §12): factored vs dense vs tile-skip iter/s plus
+    # the measured per-step FLOP ratio on a matrix whose dense
+    # reflection floor defeats the tile-skip; detail.lowrank.
+    # flop_reduction is gated run-over-run by `sartsolve metrics --diff
+    # --threshold` in `make bench-smoke`. Runs in quick mode too (plain
+    # XLA — no TPU needed).
+    items.append({"kind": "lowrank", "id": "lowrank:factored",
+                  "reps": 2, "deadline": budget_s + 240,
+                  "timeout": cfg_timeout})
     # session-variance anchor (VERDICT r4 next #5): a power-iteration
     # bandwidth probe brackets the sweep — never deadline-skipped, so
     # every artifact carries both ends even on a cut budget
@@ -1633,6 +1759,12 @@ def main() -> int:
         # PERFORMANCE.md §11); `sartsolve metrics --diff` tracks
         # detail.operator.iter_s_implicit run-over-run
         detail["operator"] = oper
+    lowrank = results.get("lowrank:factored")
+    if lowrank is not None:
+        # factored-vs-dense-vs-tileskip backend (ISSUE 20, docs
+        # PERFORMANCE.md §12); `sartsolve metrics --diff` gates
+        # detail.lowrank.flop_reduction run-over-run
+        detail["lowrank"] = lowrank
     probes = {end: results[f"probe:{end}"] for end in ("start", "end")
               if f"probe:{end}" in results}
     if probes:

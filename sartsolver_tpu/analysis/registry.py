@@ -94,6 +94,7 @@ AUDIT_P, AUDIT_V = 128, 1024
 ENTRY_MODULES = (
     "sartsolver_tpu.models.sart",
     "sartsolver_tpu.operators.implicit",
+    "sartsolver_tpu.operators.lowrank",
     "sartsolver_tpu.ops.fused_sweep",
     "sartsolver_tpu.parallel.sharded",
     "sartsolver_tpu.resilience.degrade",

@@ -218,6 +218,20 @@ def build_parser() -> argparse.ArgumentParser:
                           "voxel-sharded meshes and multi-process runs; "
                           "an explicit EPS fails loudly there. Also via "
                           "SART_SPARSE_RTM.")
+    tpu.add_argument("--lowrank_rtm", default=None, metavar="auto|off|RANK",
+                     help="Factored RTM mode (PERFORMANCE.md §12): "
+                          "approximate H ~= S + U V^T at ingest — a "
+                          "tile-thresholded sparse core S plus a "
+                          "rank-RANK randomized-SVD factorization of the "
+                          "sub-threshold residual — and run every solve "
+                          "on the composed factored operator (the fill "
+                          "costs RANK*(npixel+nvoxel) MACs per "
+                          "projection instead of npixel*nvoxel). 'auto' "
+                          "walks a doubling rank ladder and declines "
+                          "loudly to dense when no rank passes the "
+                          "Frobenius + solve-parity quality gate; an "
+                          "explicit RANK that fails the gate aborts "
+                          "before staging. Also via SART_LOWRANK_RTM.")
     tpu.add_argument("--debug_nans", action="store_true",
                      help="Enable jax debug-NaN checking: abort with a "
                           "traceback at the first NaN-producing op instead "
@@ -424,6 +438,36 @@ def _validate(args) -> None:
         fail("Argument sparse_rtm engages the block-sparse panel sweep; "
              f"--fused_sweep {args.fused_sweep} cannot be honored there — "
              "use auto or off.")
+    if args.lowrank_rtm is None:
+        # flag > SART_LOWRANK_RTM env > off (the sparse_rtm pattern)
+        import os as _os_lowrank
+
+        args.lowrank_rtm = _os_lowrank.environ.get("SART_LOWRANK_RTM", "off")
+    if args.lowrank_rtm not in ("auto", "off"):
+        try:
+            ok = int(args.lowrank_rtm) >= 1
+        except ValueError:
+            ok = False
+        if not ok:
+            fail("Argument lowrank_rtm must be 'auto', 'off' or a "
+                 f"positive integer factorization rank, "
+                 f"{args.lowrank_rtm!r} given.")
+        if args.use_cpu:
+            fail("Argument lowrank_rtm needs the fp32 device profile; an "
+                 "explicit rank cannot be combined with --use_cpu "
+                 "(use 'auto', which declines there).")
+    if args.lowrank_rtm != "off":
+        if args.fused_sweep in ("on", "interpret"):
+            fail("Argument lowrank_rtm runs the factored (S + U V^T) "
+                 f"sweep; --fused_sweep {args.fused_sweep} cannot be "
+                 "honored there — use auto or off.")
+        if getattr(args, "geometry", None):
+            fail("Argument lowrank_rtm factorizes a stored matrix; "
+                 "--geometry has none to factorize.")
+        if args.sparse_rtm not in ("auto", "off"):
+            fail("Arguments lowrank_rtm and an explicit sparse_rtm "
+                 "threshold both claim the stored matrix; the factored "
+                 "core already thresholds it — drop one.")
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -810,6 +854,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rtm_dtype=args.rtm_dtype,
                 fused_sweep=args.fused_sweep,
                 sparse_rtm=args.sparse_rtm,
+                lowrank_rtm=args.lowrank_rtm,
             )
             devices = jax.devices()
 
@@ -943,6 +988,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"compute={opts.dtype} "
                 f"fused_sweep={args.fused_sweep}->{opts.fused_sweep} "
                 f"sparse_rtm={opts.sparse_rtm} "
+                f"lowrank_rtm={opts.lowrank_rtm} "
                 f"os_subsets={opts.os_subsets} momentum={opts.momentum} "
                 f"processes={jax.process_count()}"
             )
@@ -1026,6 +1072,24 @@ def main(argv: Optional[List[str]] = None) -> int:
             sparse_tile_stats_or_decline,
         )
 
+        # Factored path (docs/PERFORMANCE.md §12): the whole-matrix host
+        # read + thresholded-core split + randomized SVD happen behind
+        # the shared gate — 'auto' declines loudly to the dense branch
+        # (lowrank_op stays None), an explicit rank fails before
+        # anything is staged.
+        lowrank_op = None
+        if geometry_record is None and opts.lowrank_rank() is not None:
+            from sartsolver_tpu.parallel.multihost import (
+                lowrank_operator_or_decline,
+            )
+
+            with obs_trace.span("ingest.lowrank_factorize",
+                                npixel=npixel, nvoxel=nvoxel):
+                lowrank_op = lowrank_operator_or_decline(
+                    opts, sorted_matrix_files, rtm_name, npixel,
+                    nvoxel, n_vox, laplacian=lap,
+                )
+
         if geometry_record is not None:
             # matrix-free path: no RTM ingest at all — the operator's
             # whole device state is the [npixel, 6] ray table
@@ -1044,6 +1108,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                 f"({operator.resident_nbytes()} bytes; a materialized "
                 f"RTM would stage "
                 f"{npixel * nvoxel * np.dtype(np.float32).itemsize})"
+            )
+        elif lowrank_op is not None:
+            tile_occ = None
+            ingest_stats = None
+            with obs_trace.span("ingest.lowrank", npixel=npixel,
+                                nvoxel=nvoxel, rank=lowrank_op.rank):
+                solver = DistributedSARTSolver(
+                    operator=lowrank_op, opts=opts, mesh=mesh
+                )
+            occ = lowrank_op.tile_occupancy()
+            print(
+                f"lowrank: factored operator H ~= S + U V^T "
+                f"rank={lowrank_op.rank} (core occupancy "
+                f"{occ.occupancy_fraction():.3f}, eps {occ.epsilon:g}, "
+                f"digest {occ.digest:#010x}; the residual fill costs "
+                f"{lowrank_op.rank}*(npixel+nvoxel) MACs per projection "
+                f"instead of npixel*nvoxel)"
             )
         else:
             tile_stats = sparse_tile_stats_or_decline(
@@ -1124,6 +1205,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "post-upload ray-stats verification: "
                         + "; ".join(issues)
                     )
+        # operator-kind provenance, resolved only now (gates may have
+        # declined): rides the meta record AND every frame record, so
+        # `sartsolve metrics --diff` refuses to compare solve-ms /
+        # convergence behavior across operator backends (the solver-
+        # variant contract) even on sliced artifacts
+        telem.set_run_info(
+            operator=("implicit" if geometry_record is not None else
+                      "lowrank" if lowrank_op is not None else
+                      "tileskip" if tile_occ is not None else "dense"),
+        )
         _mark("ingest RTM + upload")
 
         if geometry_record is not None:

@@ -275,6 +275,16 @@ class SolverOptions:
     # sweep cannot engage (voxel-sharded meshes, fp64 compute, no index
     # for a pre-sharded matrix); a numeric threshold raises instead.
     sparse_rtm: str = "off"
+    # Low-rank + sparse RTM factorization (operators/lowrank.py,
+    # docs/PERFORMANCE.md §12): "off" (default) stages H as-is; "auto"
+    # factors H ~= S + U V^T at ingest behind the quality gate
+    # (Frobenius residual AND end-to-end solve parity vs dense) and
+    # declines LOUDLY to dense when no candidate rank passes; a positive
+    # integer pins the factorization rank — a pinned rank that fails the
+    # gate raises SartInputError pre-staging instead of running
+    # degraded. The factored sweep replaces the Pallas kernel (like the
+    # block-sparse path), so an explicit fused_sweep conflicts.
+    lowrank_rtm: str = "off"
     # In-solve divergence recovery (resilience layer, docs/RESILIENCE.md):
     # the iteration body watches the residual metric for non-finite or
     # exploding values; a tripped frame rolls back to its last good
@@ -354,6 +364,22 @@ class SolverOptions:
         inability to engage the sparse sweep raises instead of quietly
         running dense (the fused_sweep='on' contract, applied here)."""
         return self.sparse_rtm not in ("off", "auto")
+
+    def lowrank_rank(self) -> int | str | None:
+        """The requested factorization rank: ``None`` when the low-rank
+        backend is off, the string ``"auto"`` for gate-driven rank
+        selection, else the pinned positive integer."""
+        if self.lowrank_rtm == "off":
+            return None
+        if self.lowrank_rtm == "auto":
+            return "auto"
+        return int(self.lowrank_rtm)
+
+    def lowrank_explicit(self) -> bool:
+        """A pinned integer ``lowrank_rtm`` rank was requested:
+        inability to engage the factored operator raises instead of
+        quietly running dense (the fused_sweep='on' contract)."""
+        return self.lowrank_rtm not in ("off", "auto")
 
     def __post_init__(self) -> None:
         if self.ray_density_threshold < 0:
@@ -438,6 +464,36 @@ class SolverOptions:
                 "sweep, which replaces the Pallas kernel; an explicit "
                 f"fused_sweep='{self.fused_sweep}' cannot be honored "
                 "there — use 'auto' or 'off'."
+            )
+        if self.lowrank_rtm not in ("auto", "off"):
+            try:
+                rank = int(self.lowrank_rtm)
+            except ValueError:
+                raise ValueError(
+                    "Attribute lowrank_rtm must be 'auto', 'off' or a "
+                    "positive integer factorization rank, "
+                    f"{self.lowrank_rtm!r} given."
+                ) from None
+            if rank < 1:
+                raise ValueError(
+                    "Attribute lowrank_rtm rank must be >= 1, "
+                    f"{self.lowrank_rtm!r} given."
+                )
+        if self.lowrank_rtm != "off" and self.fused_sweep in (
+            "on", "interpret"
+        ):
+            raise ValueError(
+                "Attribute lowrank_rtm engages the factored "
+                "(S + U V^T) sweep, which replaces the Pallas kernel; "
+                f"an explicit fused_sweep='{self.fused_sweep}' cannot "
+                "be honored there — use 'auto' or 'off'."
+            )
+        if self.lowrank_rtm != "off" and self.sparse_explicit():
+            raise ValueError(
+                "Attributes lowrank_rtm and an explicit sparse_rtm "
+                "threshold both claim the stored matrix: the factored "
+                "backend already tile-thresholds its sparse core — "
+                "drop one of the two."
             )
         if self.divergence_recovery < 0:
             raise ValueError(

@@ -134,6 +134,7 @@ class ResidentSession:
             opts = SolverOptions(
                 rtm_dtype=args.rtm_dtype,
                 sparse_rtm=getattr(args, "sparse_rtm", None) or "off",
+                lowrank_rtm=getattr(args, "lowrank_rtm", None) or "off",
                 **kw,
             )
             devices = jax.devices()
@@ -165,8 +166,46 @@ class ResidentSession:
         # the same call, so solve and serve can never disagree on when
         # an explicit threshold refuses vs 'auto' declines)
         from sartsolver_tpu.parallel.multihost import (
+            lowrank_operator_or_decline,
             sparse_tile_stats_or_decline,
         )
+
+        # factored-RTM session (docs/PERFORMANCE.md §12) — the SAME
+        # shared gate as the one-shot CLI: 'auto' declines loudly to
+        # the dense ingest below, an explicit rank fails before staging.
+        # The LowRankOperator doubles as the session's cache descriptor:
+        # its cache_key() is content-addressed (lowrank:<P>x<V>:<dtype>:
+        # <rank>:<digest12>) and resident_nbytes() charges the true
+        # device footprint of S + U + V.
+        lowrank_op = lowrank_operator_or_decline(
+            opts, sorted_matrix_files, rtm_name, npixel, nvoxel, n_vox,
+            laplacian=lap,
+        )
+        if lowrank_op is not None:
+            solver = DistributedSARTSolver(
+                operator=lowrank_op, opts=opts, mesh=mesh
+            )
+            grid = make_voxel_grid(
+                next(iter(sorted_matrix_files.values())), "rtm/voxel_map"
+            )
+            print(
+                f"engine: session resident — mesh={n_pix}x{n_vox} "
+                f"backend={jax.default_backend()} operator=lowrank "
+                f"rank={lowrank_op.rank} "
+                f"rtm_dtype={opts.rtm_dtype or opts.dtype} "
+                f"compute={opts.dtype} npixel={npixel} nvoxel={nvoxel} "
+                f"resident_bytes={lowrank_op.resident_nbytes()}"
+            )
+            return cls(
+                solver=solver, grid=grid, opts=opts,
+                camera_names=list(sorted_image_files),
+                sorted_image_files=sorted_image_files,
+                rtm_frame_masks=rtm_frame_masks,
+                npixel=npixel, nvoxel=nvoxel,
+                max_cached_frames=args.max_cached_frames,
+                mesh_shape=(n_pix, n_vox),
+                operator=lowrank_op,
+            )
 
         tile_stats = sparse_tile_stats_or_decline(
             opts, mesh, npixel, nvoxel, n_vox

@@ -69,6 +69,13 @@ from sartsolver_tpu.operators.implicit import (
     implicit_ray_stats,
     implicit_subset_density,
 )
+from sartsolver_tpu.operators.lowrank import (
+    LowRankSpec,
+    lowrank_back,
+    lowrank_forward,
+    lowrank_ray_stats,
+    lowrank_subset_density,
+)
 
 
 class SARTProblem(NamedTuple):
@@ -90,6 +97,16 @@ class SARTProblem(NamedTuple):
     # Per-voxel dequantization scales when the RTM is int8-quantized
     # (H_ij = rtm_scale[j] * rtm[i, j]); None for fp32/bf16 storage.
     rtm_scale: Optional[Array] = None  # [V], fp32
+    # Low-rank factor term of the factored operator H ~= S + U V^T
+    # (operators/lowrank.py; the rtm leaf then holds the sparse core S).
+    # None on every other backend — the trailing defaults keep the
+    # pytree structure, and hence every compiled program and audit
+    # golden, byte-identical when the factored path is not engaged.
+    factor_u: Optional[Array] = None  # [P_local, r]
+    factor_v: Optional[Array] = None  # [V, r]
+    # Per-rank-component dequantization scales when the factors are
+    # int8-quantized (row 0: U's, row 1: V's); None for fp storage.
+    factor_scale: Optional[Array] = None  # [2, r], fp32
 
 
 class SolveResult(NamedTuple):
@@ -502,6 +519,72 @@ def make_implicit_problem(
     return SARTProblem(rays, dens, length, None)
 
 
+def make_lowrank_problem(
+    s_matrix,
+    u,
+    v,
+    spec: LowRankSpec,
+    *,
+    opts: SolverOptions,
+    axis_name=None,
+) -> SARTProblem:
+    """Factored-operator analogue of :func:`make_problem`: stage the
+    sparse core ``S`` as the problem's ``rtm`` leaf with the skinny
+    factors ``U``/``V`` riding as the trailing leaves, and derive
+    rho/lambda from the COMPOSED operator ``S + U V^T`` — the Eq. 6
+    masks are self-consistent with what the sweeps multiply by.
+
+    Inputs are already padded to ``spec.nvoxel`` columns (zero voxel
+    padding, like every staged matrix block). On the int8 path ``S`` is
+    quantized per voxel (:func:`quantize_rtm`, exact in-loop panel
+    dequant) and each factor per rank component (``factor_scale[0]`` =
+    U's scales, ``[1]`` = V's); stats come from the QUANTIZED operator.
+    """
+    dtype = jnp.dtype(opts.dtype)
+    s_matrix = jnp.asarray(s_matrix, jnp.float32)
+    u = jnp.asarray(u, jnp.float32)
+    v = jnp.asarray(v, jnp.float32)
+    if s_matrix.ndim != 2 or s_matrix.shape[1] != spec.nvoxel:
+        raise ValueError(
+            f"sparse core has shape {tuple(s_matrix.shape)} — expected "
+            f"[P_local, {spec.nvoxel}] (pad voxel columns first)."
+        )
+    if u.shape != (s_matrix.shape[0], spec.rank) or v.shape != (
+        spec.nvoxel, spec.rank
+    ):
+        raise ValueError(
+            f"factor shapes {tuple(u.shape)} / {tuple(v.shape)} do not "
+            f"match the [{s_matrix.shape[0]}, {spec.nvoxel}] core at "
+            f"rank {spec.rank}."
+        )
+    if (opts.rtm_dtype or "") == "int8":
+        P_, V_ = s_matrix.shape
+        if max(P_, V_) > INT8_MAX_CONTRACTION:
+            raise ValueError(
+                f"rtm_dtype='int8': RTM extent {max(P_, V_)} exceeds "
+                f"the int32-accumulation bound {INT8_MAX_CONTRACTION} "
+                "of the integer projections; use fp32/bfloat16 storage."
+            )
+        codes, scale = quantize_rtm(s_matrix)
+        u_codes, su = _quantize_sym(u, axis=0)
+        v_codes, sv = _quantize_sym(v, axis=0)
+        factor_scale = jnp.concatenate([su, sv], axis=0)  # [2, r]
+        dens, length = lowrank_ray_stats(
+            codes,
+            u_codes.astype(jnp.float32) * su,
+            v_codes.astype(jnp.float32) * sv,
+            spec, scale=scale, dtype=dtype, axis_name=axis_name,
+        )
+        return SARTProblem(codes, dens, length, None, scale,
+                           u_codes, v_codes, factor_scale)
+    rtm_dtype = jnp.dtype(opts.rtm_dtype or opts.dtype)
+    staged = s_matrix.astype(rtm_dtype)
+    dens, length = lowrank_ray_stats(
+        staged, u, v, spec, dtype=dtype, axis_name=axis_name
+    )
+    return SARTProblem(staged, dens, length, None, None, u, v)
+
+
 def solve_normalized(
     problem: SARTProblem,
     g: Array,
@@ -634,7 +717,7 @@ def solve_normalized_batch(
     rtm = problem.rtm
     options = None
     if (
-        operator_spec is None  # the implicit projector never fuses
+        operator_spec is None  # implicit/factored projectors never fuse
         and jax.default_backend() == "tpu"  # raised limit: TPU-only flag
         and _resolve_fused(opts, axis_name, rtm, g.shape[0], vmem_raised=True)
         == "compiled"
@@ -775,10 +858,35 @@ class _SweepContext:
         # Matrix-free mode (operators/implicit.py): the problem's rtm
         # leaf carries the packed [P_local, 6] ray table and the static
         # spec names the grid — the voxel extent comes from the spec,
-        # never from the staged array. None = the dense contraction,
-        # traced exactly as before the operator layer existed.
-        self.implicit = operator_spec
-        if operator_spec is not None:
+        # never from the staged array. Factored mode (operators/
+        # lowrank.py): the rtm leaf holds the sparse core S and the
+        # skinny factors ride as the problem's trailing leaves; the spec
+        # carries the static panel-skip predicate and the rank. None =
+        # the dense contraction, traced exactly as before the operator
+        # layer existed.
+        self.lowrank = (
+            operator_spec if isinstance(operator_spec, LowRankSpec)
+            else None
+        )
+        self.implicit = (
+            None if self.lowrank is not None else operator_spec
+        )
+        if self.lowrank is not None:
+            if rtm.ndim != 2 or rtm.shape[1] != operator_spec.nvoxel:
+                raise ValueError(
+                    f"lowrank operator_spec expects the [P_local, "
+                    f"{operator_spec.nvoxel}] sparse core as problem."
+                    f"rtm, got shape {tuple(rtm.shape)} "
+                    "(make_lowrank_problem)."
+                )
+            if problem.factor_u is None or problem.factor_v is None:
+                raise ValueError(
+                    "lowrank operator_spec given but the problem "
+                    "carries no factor_u/factor_v leaves — build it "
+                    "with make_lowrank_problem."
+                )
+            nvoxel = self.nvoxel = int(operator_spec.nvoxel)
+        elif operator_spec is not None:
             if rtm.ndim != 2 or rtm.shape[1] != 6:
                 raise ValueError(
                     f"implicit operator_spec given but problem.rtm has "
@@ -793,9 +901,13 @@ class _SweepContext:
         self.problem = problem
         self.has_pen = problem.laplacian is not None
         if self.has_pen and operator_spec is not None:
+            backend = (
+                "factored (lowrank)" if self.lowrank is not None
+                else "implicit (matrix-free)"
+            )
             raise ValueError(
-                "beta_laplace smoothing is not supported by the implicit "
-                "(matrix-free) operator; drop the Laplacian or use a "
+                f"beta_laplace smoothing is not supported by the "
+                f"{backend} operator; drop the Laplacian or use a "
                 "materialized RTM."
             )
 
@@ -817,6 +929,14 @@ class _SweepContext:
         # happens. Python-gated: integrity=False traces byte-identically.
         self.integrity = bool(opts.integrity)
         if self.integrity and operator_spec is not None:
+            if self.lowrank is not None:
+                raise ValueError(
+                    "integrity=True (in-solve ABFT) is not supported by "
+                    "the factored (lowrank) operator: the checksum "
+                    "tolerance model certifies a single stored-matrix "
+                    "contraction, not the composed S + U V^T products. "
+                    "Disable integrity or use a materialized RTM."
+                )
             raise ValueError(
                 "integrity=True (in-solve ABFT) is not supported by the "
                 "implicit operator: the checksummed identities certify a "
@@ -859,6 +979,20 @@ class _SweepContext:
                 )
             self.scale = problem.rtm_scale.astype(dtype)
 
+        # Factored operator: dequantize the skinny factors ONCE, here —
+        # loop-invariant (O(r * (P + V)) elements, so holding them fp
+        # costs nothing next to S), which keeps the iteration body free
+        # of factor-sized converts (the lowrank_sweep audit pins this).
+        if self.lowrank is not None:
+            u, v = problem.factor_u, problem.factor_v
+            if problem.factor_scale is not None:
+                su = problem.factor_scale[0].astype(dtype)
+                sv = problem.factor_scale[1].astype(dtype)
+                u = u.astype(dtype) * su[None, :]
+                v = v.astype(dtype) * sv[None, :]
+            self.u = u.astype(dtype)
+            self.v = v.astype(dtype)
+
         # Ordered-subsets cycle (docs/PERFORMANCE.md §9): per-subset ray
         # densities and masks. Subset t is the INTERLEAVED row set
         # ``t::os`` of this device's pixel rows (ops/fused_sweep.py
@@ -880,7 +1014,16 @@ class _SweepContext:
                     f"os_subsets={self.os} must divide the (per-shard, "
                     f"padded) pixel extent {P_local}."
                 )
-            if operator_spec is not None:
+            if self.lowrank is not None:
+                # same interleave on both terms: subset t's column sums
+                # are the occupied-panel sums of S's rows t::os plus the
+                # factor term's U-row subset against V^T
+                dens_sub = lowrank_subset_density(
+                    rtm, self.u, self.v, operator_spec, self.os,
+                    scale=self.scale if self.is_int8 else None,
+                    dtype=dtype, axis_name=axis_name,
+                )
+            elif operator_spec is not None:
                 # same interleave (subset t = ray rows t::os), column
                 # sums rebuilt panel-by-panel from the slab kernel
                 dens_sub = implicit_subset_density(
@@ -928,7 +1071,11 @@ class _SweepContext:
         sparse_eps = opts.sparse_epsilon()
         if sparse_eps is not None and operator_spec is not None:
             # the tile index skips stored-matrix panels; the implicit
-            # projector stores none — auto declines, explicit raises
+            # projector stores none and the factored backend already
+            # tile-thresholds its own sparse core — auto declines,
+            # explicit raises (SolverOptions rejects explicit sparse +
+            # lowrank at construction, so only implicit reaches here
+            # explicitly)
             if opts.sparse_explicit():
                 raise ValueError(
                     f"sparse_rtm='{opts.sparse_rtm}' requested but the "
@@ -1048,7 +1195,24 @@ class _SweepContext:
         # dots with the panel scan's int8 dequant idiom — so the fused
         # resolution is skipped there (SolverOptions rejects an explicit
         # 'on'/'interpret' with os_subsets > 1 at construction).
-        if operator_spec is not None:
+        if self.lowrank is not None:
+            # The factored sweep is its own one-pass composition: the
+            # occupied-panel dots over S plus two skinny factor matmuls
+            # replace both the Pallas kernel and the dense two-matmul
+            # path (SolverOptions already rejects an explicit
+            # fused_sweep='on'/'interpret' with lowrank_rtm).
+            if opts.fused_sweep in ("on", "interpret"):
+                raise ValueError(
+                    f"fused_sweep='{opts.fused_sweep}' requested but "
+                    "the operator is factored (lowrank); the composed "
+                    "S + U V^T sweep replaces the fused kernel. Use "
+                    "fused_sweep='auto'/'off'."
+                )
+            fused = self.fused = None
+            FUSED_ENGAGEMENT["last"] = (
+                "lowrank-os" if self.os > 1 else "lowrank"
+            )
+        elif operator_spec is not None:
             # The implicit projector IS a one-pass panel sweep: it
             # rebuilds H panel-by-panel inside the loop, so the fused
             # machinery (which reads a stored matrix) never engages.
@@ -1083,7 +1247,11 @@ class _SweepContext:
                 opts, axis_name, rtm, B, vmem_raised=_vmem_raised
             )
             FUSED_ENGAGEMENT["last"] = fused or "off"
-        if self.is_int8 and fused is None and self.os == 1:
+        if (self.is_int8 and fused is None and self.os == 1
+                and self.lowrank is None):
+            # (the factored path is exempt: its panel dots dequantize S
+            # exactly in-loop like the panel scan, and the factors were
+            # dequantized once above — no per-iteration requantization)
             # The two-matmul loop would have to re-quantize w/f every
             # iteration (extra error) or dequantize the matrix (4x the
             # memory the user chose int8 to avoid) — int8 storage is a
@@ -1163,6 +1331,12 @@ class _SweepContext:
         the single back-projection seam every core path routes through
         (the caller psums over the pixel axis, identically for every
         backend)."""
+        if self.lowrank is not None:
+            return lowrank_back(
+                self.rtm, self.u, self.v, w_, self.lowrank,
+                scale=self.scale if self.is_int8 else None,
+                accum_dtype=self.dtype,
+            )
         if self.implicit is not None:
             return implicit_back(self.rtm, w_, self.implicit,
                                  accum_dtype=self.dtype)
@@ -1174,6 +1348,12 @@ class _SweepContext:
     def fp_any(self, f_):
         """``H f`` on whatever operator the problem carries (pre-voxel-
         psum under 2-D meshes) — the forward-projection seam."""
+        if self.lowrank is not None:
+            return lowrank_forward(
+                self.rtm, self.u, self.v, f_, self.lowrank,
+                scale=self.scale if self.is_int8 else None,
+                accum_dtype=self.dtype,
+            )
         if self.implicit is not None:
             return implicit_forward(self.rtm, f_, self.implicit,
                                     accum_dtype=self.dtype)
@@ -1222,7 +1402,20 @@ class _SweepContext:
             m_t = os_subset_pixels(meas_mask, t, self.os)
             il_t = os_subset_pixels(self.inv_length, t, self.os)[None, :]
             w_t = jnp.where(m_t, g_t, 0) * il_t
-            if self.implicit is not None:
+            if self.lowrank is not None:
+                # subset t of S + U V^T is S's rows t::os plus U's rows
+                # t::os against V^T — os_subset_rows slices both (S's
+                # int8 codes come back bf16, the panel dots' dequant
+                # idiom; the scales still apply inside lowrank_back)
+                obs_t = _psum(
+                    lowrank_back(
+                        panel, os_subset_rows(self.u, t, self.os),
+                        self.v, w_t, self.lowrank,
+                        scale=scale, accum_dtype=self.dtype,
+                    ),
+                    self.axis_name,
+                )
+            elif self.implicit is not None:
                 # the subset's ray rows drive the same slab kernel —
                 # os_subset_rows slices [P, 6] as readily as [P, V]
                 obs_t = _psum(
@@ -1281,7 +1474,14 @@ class _SweepContext:
                 "sparse_os",
             )
 
-        def subset_fwd(panel, x):
+        def subset_fwd(panel, x, t):
+            if self.lowrank is not None:
+                # subset t of the composed operator: S's rows t::os
+                # (the panel) plus U's rows t::os against V^T
+                return lowrank_forward(
+                    panel, os_subset_rows(self.u, t, self.os), self.v,
+                    x, self.lowrank, scale=scale, accum_dtype=dtype,
+                )
             if self.implicit is not None:
                 # `panel` holds the subset's ray rows; the slab kernel
                 # projects any ray set
@@ -1293,7 +1493,16 @@ class _SweepContext:
                 )
             return os_subset_forward(panel, x, scale)
 
-        def subset_back(panel, w_):
+        def subset_back(panel, w_, t):
+            if self.lowrank is not None:
+                return _psum(
+                    lowrank_back(
+                        panel, os_subset_rows(self.u, t, self.os),
+                        self.v, w_, self.lowrank, scale=scale,
+                        accum_dtype=dtype,
+                    ),
+                    self.axis_name,
+                )
             if self.implicit is not None:
                 return _psum(
                     implicit_back(panel, w_, self.implicit,
@@ -1316,10 +1525,10 @@ class _SweepContext:
             vm_t = lax.dynamic_index_in_dim(
                 self.vmask_sub, t, axis=0, keepdims=False
             )[None, :]
-            fitted_t = _psum(subset_fwd(panel, f), self.voxel_axis)
+            fitted_t = _psum(subset_fwd(panel, f, t), self.voxel_axis)
             if opts.logarithmic:
                 w = jnp.where(m_t, fitted_t, 0) * il_t
-                fit = subset_back(panel, w)
+                fit = subset_back(panel, w, t)
                 fit = jnp.where(vm_t, fit, 0)
                 obs_t = lax.dynamic_index_in_dim(
                     obs_sub, t, axis=1, keepdims=False
@@ -1340,7 +1549,7 @@ class _SweepContext:
                 w = w * dk
             if ascale is not None:
                 w = w * ascale[:, None]
-            bp = subset_back(panel, w)
+            bp = subset_back(panel, w, t)
             invd_t = lax.dynamic_index_in_dim(
                 self.inv_density_sub, t, axis=0, keepdims=False
             )[None, :]
@@ -1358,7 +1567,7 @@ class _SweepContext:
         # parts[t][:, q], i.e. stack on a trailing subset axis + reshape.
         if self.is_int8:
             parts = [
-                subset_fwd(os_subset_rows(self.rtm, t, self.os), f_upd)
+                subset_fwd(os_subset_rows(self.rtm, t, self.os), f_upd, t)
                 for t in range(self.os)
             ]
             fitted_upd = jnp.stack(parts, axis=2).reshape(

@@ -104,7 +104,7 @@ def summarize(records: List[dict]) -> dict:
     # artifact — frames without their meta line — still declares its
     # variant; fall back to the first frame that has them.
     meta = records[0] if records and records[0].get("type") == "meta" else {}
-    variant_keys = ("os_subsets", "momentum", "logarithmic")
+    variant_keys = ("os_subsets", "momentum", "logarithmic", "operator")
     variant = {k: meta[k] for k in variant_keys if k in meta}
     if not variant:
         for fr in frames:
@@ -261,6 +261,21 @@ def summarize(records: List[dict]) -> dict:
                 }
                 for name, sec in sparse.items() if isinstance(sec, dict)
             }
+        # low-rank factored-RTM section (bench.py lowrank item, docs
+        # §12): the measured FLOP reduction of the factored step over
+        # the dense one is a gated rate — a run-over-run drop means the
+        # factorization stopped paying (a fatter core, a densified
+        # factor path), which raw iter/s never isolates
+        lowrank = (bench[0].get("detail") or {}).get("lowrank")
+        if isinstance(lowrank, dict):
+            out["lowrank"] = {
+                "flop_reduction": lowrank.get("flop_reduction"),
+                "flop_reduction_vs_tileskip": lowrank.get(
+                    "flop_reduction_vs_tileskip"),
+                "core_occupancy": lowrank.get("core_occupancy"),
+                "rank": lowrank.get("rank"),
+                "parity": lowrank.get("parity"),
+            }
         # roofline section (bench.py + obs/roofline.py): the headline
         # config's achieved-vs-peak MXU and HBM-bandwidth fractions —
         # gated rates like the headline itself (a utilization drop is a
@@ -356,6 +371,15 @@ def _print_summary(path: str, summary: dict) -> None:
                       f"vs dense (occupancy "
                       f"{sec.get('tile_occupancy')}, parity="
                       f"{sec.get('parity')})")
+    if "lowrank" in summary:
+        sec = summary["lowrank"]
+        if sec.get("flop_reduction") is not None:
+            print(f"  lowrank rank {sec.get('rank')}: "
+                  f"{sec['flop_reduction']:g}x fewer step FLOPs vs dense "
+                  f"({sec.get('flop_reduction_vs_tileskip')}x vs "
+                  f"tile-skip, core occupancy "
+                  f"{sec.get('core_occupancy')}, parity="
+                  f"{sec.get('parity')})")
 
 
 def diff(old: dict, new: dict) -> dict:
@@ -458,6 +482,23 @@ def diff(old: dict, new: dict) -> dict:
         name for name, sec in (new.get("sparse") or {}).items()
         if isinstance(sec, dict) and sec.get("parity") is False
     )
+    # low-rank factored-RTM FLOP reduction (bench detail.lowrank, docs
+    # §12): a rate, gated like the bench value — a drop means the
+    # factorization stopped cutting FLOPs below the tile-skip floor
+    lowrank_pct = None
+    a = (old.get("lowrank") or {}).get("flop_reduction")
+    b = (new.get("lowrank") or {}).get("flop_reduction")
+    if a and b and a > 0:
+        lowrank_pct = 100.0 * (b / a - 1.0)
+        out["lowrank"] = {"old": a, "new": b}
+    out["lowrank_flop_reduction_pct"] = lowrank_pct
+    # lowrank parity is a hard gate like tts/sparse parity: a factored
+    # solve that drifted from the dense reference is a correctness
+    # regression whatever the FLOP ratio says
+    out["lowrank_parity_failed"] = bool(
+        isinstance(new.get("lowrank"), dict)
+        and new["lowrank"].get("parity") is False
+    )
     # solver-variant guard: run artifacts from different convergence
     # accelerators (os_subsets/momentum/logarithmic) are different
     # algorithms — their convergence-behavior and solve-ms gates are
@@ -551,7 +592,7 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
         notes.append(f"solver-variant meta missing from the {side} "
                      "artifact — variant comparability unknown")
     for section in ("bench", "straggler", "integrity", "roofline", "tts",
-                    "sparse", "engine"):
+                    "sparse", "lowrank", "engine"):
         if (section in old) != (section in new):
             side = "baseline" if section in new else "new"
             notes.append(f"{section} section missing from the {side} "
@@ -605,6 +646,13 @@ def _diff_notes(old: dict, new: dict) -> List[str]:
             if not (a or 0) > 0:
                 notes.append(f"{side} sparse occ50 speedup is zero/"
                              "absent — its rate gate skipped")
+    if "lowrank" in old and "lowrank" in new:
+        for side, summ in (("baseline", old), ("new", new)):
+            a = summ["lowrank"].get("flop_reduction")
+            if not (a or 0) > 0:
+                notes.append(f"{side} lowrank FLOP reduction is zero/"
+                             "absent — its rate gate skipped")
+                break
     for section, key, label in zero_checks:
         if (section in old and section in new
                 and not (old[section].get(key) or 0) > 0):
@@ -713,6 +761,11 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                       f"{delta['sparse']['old']:g}x -> "
                       f"{delta['sparse']['new']:g}x "
                       f"({delta['sparse_occ50_speedup_pct']:+.1f}%)")
+            if delta["lowrank_flop_reduction_pct"] is not None:
+                print(f"  lowrank step-FLOP reduction: "
+                      f"{delta['lowrank']['old']:g}x -> "
+                      f"{delta['lowrank']['new']:g}x "
+                      f"({delta['lowrank_flop_reduction_pct']:+.1f}%)")
             for key in ("mxu_util", "hbm_util"):
                 if delta[f"roofline_{key}_pct"] is not None:
                     d = delta["roofline"][key]
@@ -815,6 +868,20 @@ def metrics_main(argv: Optional[List[str]] = None) -> int:
                 print(f"sartsolve metrics: block-sparse occ50 speedup "
                       f"regression "
                       f"{delta['sparse_occ50_speedup_pct']:+.1f}% "
+                      f"exceeds the {args.threshold:g}% threshold.",
+                      file=sys.stderr)
+                return 2
+            if delta.get("lowrank_parity_failed"):
+                print("sartsolve metrics: low-rank factored-RTM parity "
+                      "FAILED in the new artifact (bench lowrank item).",
+                      file=sys.stderr)
+                return 2
+            if (delta["lowrank_flop_reduction_pct"] is not None
+                    and delta["lowrank_flop_reduction_pct"]
+                    < -args.threshold):
+                print(f"sartsolve metrics: low-rank factored-RTM FLOP-"
+                      f"reduction regression "
+                      f"{delta['lowrank_flop_reduction_pct']:+.1f}% "
                       f"exceeds the {args.threshold:g}% threshold.",
                       file=sys.stderr)
                 return 2

@@ -187,7 +187,8 @@ class RunTelemetry:
             # record never leaves its artifact, but downstream tooling
             # slices/merges artifacts — `sartsolve metrics --diff` must be
             # able to see a variant mismatch even on a frame subset
-            for key in ("os_subsets", "momentum", "logarithmic"):
+            for key in ("os_subsets", "momentum", "logarithmic",
+                        "operator"):
                 if key in self._run_info:
                     extra[key] = self._run_info[key]
             self._frames.append(schema.make_frame_record(

@@ -18,6 +18,12 @@ instead of assuming a materialized dense RTM. Three implementations:
   contract) — the matrix is never materialized, so a resident session
   costs ~KB instead of the RTM's GBs (tomoCAM, arxiv 2304.12934;
   arxiv 2104.13248).
+- :class:`LowRankOperator` — NEW: the factored ``H ~= S + U V^T``
+  backend (arxiv 1705.07497; arxiv 2003.12677). A tile-thresholded
+  sparse core rides the block-skip panel dots while the sub-threshold
+  reflection fill is compressed into two skinny rank-``r`` factors —
+  the fill costs ``r * (P + V)`` MACs per projection instead of
+  ``P * V``, beating the tile-skip floor on reflective RTMs.
 
 This package is the blessed home for raw RTM contractions (lint SL007):
 the dense/implicit primitives live here and in ``ops/``; everything else
@@ -35,11 +41,20 @@ from sartsolver_tpu.operators.implicit import (
     implicit_ray_stats, implicit_subset_density, materialize_rtm,
     pick_implicit_panel,
 )
+from sartsolver_tpu.operators.lowrank import (
+    LowRankOperator, LowRankSpec, build_lowrank_operator, lowrank_back,
+    lowrank_forward, lowrank_ray_stats, lowrank_static_decline_reason,
+    lowrank_subset_density, randomized_svd,
+)
 from sartsolver_tpu.operators.tileskip import TileSkipOperator
 
 __all__ = [
     "ProjectionOperator", "DenseOperator", "TileSkipOperator",
     "ImplicitOperator", "ImplicitSpec",
+    "LowRankOperator", "LowRankSpec", "build_lowrank_operator",
+    "lowrank_forward", "lowrank_back", "lowrank_ray_stats",
+    "lowrank_subset_density", "lowrank_static_decline_reason",
+    "randomized_svd",
     "Camera", "GeometryRecord", "GeometryVoxelGrid",
     "load_geometry", "save_geometry",
     "implicit_forward", "implicit_back", "implicit_ray_stats",

@@ -289,6 +289,56 @@ def sparse_tile_stats_or_decline(opts, mesh, npixel: int, nvoxel: int,
     return make_tile_stats(npixel, nvoxel, mesh)
 
 
+def lowrank_operator_or_decline(opts, sorted_matrix_files, rtm_name,
+                                npixel: int, nvoxel: int, n_vox: int,
+                                laplacian=None):
+    """The drivers' shared factored-RTM ingest gate: the one definition
+    of 'factorize, decline quietly, or refuse loudly' consumed by BOTH
+    the one-shot CLI and the serving engine (the
+    :func:`sparse_tile_stats_or_decline` precedent — they must never
+    disagree). Returns a
+    :class:`~sartsolver_tpu.operators.lowrank.LowRankOperator` to hand
+    the solver ctor, or None when lowrank mode is off / declined
+    ('auto' — with a stderr warning naming the reason). An explicit
+    pinned rank raises ``SartInputError`` with the actual reason, both
+    for static obstacles and for quality-gate failures inside
+    ``build_lowrank_operator``. The whole-matrix host read goes through
+    the same retried stripe reader as the dense ingest."""
+    import sys
+
+    from sartsolver_tpu.config import SartInputError
+    from sartsolver_tpu.operators.lowrank import (
+        build_lowrank_operator, lowrank_static_decline_reason,
+    )
+
+    rank = opts.lowrank_rank()
+    if rank is None:
+        return None
+    reason = lowrank_static_decline_reason(
+        opts, jax.process_count(), n_voxel_shards=n_vox,
+        has_laplacian=laplacian is not None,
+    )
+    op = None
+    if reason is None:
+        H = _read_stripe_retried(
+            sorted_matrix_files, rtm_name, npixel, nvoxel, 0
+        )
+        # explicit-rank quality-gate failures raise SartInputError
+        # inside (pre-staging); only 'auto' reaches the decline print
+        op, reason = build_lowrank_operator(H, rank=rank)
+    if reason is not None:
+        if opts.lowrank_explicit():
+            raise SartInputError(
+                f"Argument lowrank_rtm={opts.lowrank_rtm}: {reason}."
+            )
+        print(
+            f"Warning: lowrank_rtm declines here ({reason}); running "
+            "dense.", file=sys.stderr,
+        )
+        return None
+    return op
+
+
 def _read_stripe_retried(
     sorted_matrix_files, rtm_name, n, nvoxel, r0, **kwargs
 ) -> np.ndarray:

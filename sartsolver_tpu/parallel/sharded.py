@@ -347,6 +347,10 @@ class DistributedSARTSolver:
                 self._init_implicit(operator, laplacian)
                 self._init_result_helpers()
                 return
+            if operator.kind == "lowrank":
+                self._init_lowrank(operator, laplacian)
+                self._init_result_helpers()
+                return
             # dense / tileskip operators unwrap onto the host-staging
             # path: the matrix is their payload, and a tile-skip
             # operator's occupancy index rides into the sparse plumbing
@@ -798,6 +802,154 @@ class DistributedSARTSolver:
         ray_density, ray_length = stats_fn(rays_dev)
         self.problem = SARTProblem(rays_dev, ray_density, ray_length, None)
 
+    def _init_lowrank(self, operator, laplacian) -> None:
+        """Factored construction (operators/lowrank.py): stage the
+        sparse core ``S`` row-sharded like any matrix block, the skinny
+        factors ``U`` (row-sharded — its rows are pixel rows) and ``V``
+        (replicated: O(V * r) bytes, and every shard's back-projection
+        needs all of it), and compute rho/lambda with the SAME composed
+        kernel the sweeps will use. The bp psum already folds the factor
+        term's contribution (lowrank_back returns the local composed
+        partial), so the collective budget is the audited dense
+        ``sharded_batch`` one — ``sharded_lowrank_batch`` pins it.
+
+        Mode restrictions mirror the implicit backend's (pixel-sharded,
+        single-process, no integrity / explicit sparse / forced fusion /
+        Laplacian), EXCEPT int8: the factored path quantizes ``S`` per
+        voxel and each factor per rank component, host-side (the global
+        column maxima are in hand here)."""
+        from sartsolver_tpu.config import SartInputError
+        from sartsolver_tpu.operators.lowrank import lowrank_ray_stats
+
+        opts = self.opts
+        if self.n_voxel_shards > 1:
+            raise SartInputError(
+                "The factored (lowrank) operator shards pixel rows "
+                "only; voxel-sharded meshes are not supported — use a "
+                "pixel-major mesh (--voxel_shards 1) or a materialized "
+                "matrix."
+            )
+        if jax.process_count() > 1:
+            raise SartInputError(
+                "The factored (lowrank) operator does not support "
+                "multi-host meshes; run single-process or materialize "
+                "the matrix."
+            )
+        if opts.integrity:
+            raise SartInputError(
+                "integrity=True certifies a single stored-matrix "
+                "contraction; the factored (lowrank) operator composes "
+                "S + U V^T products — drop --integrity or materialize "
+                "the matrix."
+            )
+        if opts.sparse_epsilon() is not None and opts.sparse_explicit():
+            raise SartInputError(
+                f"Argument sparse_rtm={opts.sparse_rtm}: the factored "
+                "(lowrank) operator already tile-thresholds its sparse "
+                "core — drop the explicit threshold."
+            )
+        if opts.fused_sweep in ("on", "interpret"):
+            raise SartInputError(
+                f"fused_sweep='{opts.fused_sweep}' forces the Pallas "
+                "matrix sweep; the factored (lowrank) operator traces "
+                "its own composed sweep — use fused_sweep='auto' or "
+                "'off'."
+            )
+        if laplacian is not None:
+            raise SartInputError(
+                "beta_laplace smoothing is not supported by the "
+                "factored (lowrank) operator."
+            )
+        self.npixel = int(operator.npixel)
+        self.nvoxel = int(operator.nvoxel)
+        self.padded_npixel = padded_size(
+            self.npixel, self.n_pixel_shards * ROW_ALIGN
+        )
+        self.padded_nvoxel = padded_size(self.nvoxel, COL_ALIGN)
+        self.voxel_block = self.padded_nvoxel
+        self._tile_occupancy = None
+        self._pixel_axis = PIXEL_AXIS if self.n_pixel_shards > 1 else None
+        self._voxel_axis = None
+        spec = operator.spec(padded_nvoxel=self.padded_nvoxel)
+        self._operator_spec = spec
+        # zero padding everywhere: zero S rows and zero U rows are inert
+        # (lambda = 0, no rho contribution), zero S/V columns pad the
+        # voxel extent exactly like a padded materialized matrix
+        s_host = np.zeros(
+            (self.padded_npixel, self.padded_nvoxel), np.float32
+        )
+        s_host[: self.npixel, : self.nvoxel] = operator.payload()
+        u_raw, v_raw = operator.factors()
+        u_host = np.zeros((self.padded_npixel, spec.rank), np.float32)
+        u_host[: self.npixel] = u_raw
+        v_host = np.zeros((self.padded_nvoxel, spec.rank), np.float32)
+        v_host[: self.nvoxel] = v_raw
+        dtype = jnp.dtype(opts.dtype)
+        is_int8 = opts.rtm_dtype == "int8"
+        scale_dev = fscale_dev = None
+        if is_int8:
+            # host-side quantization: the global per-voxel column maxima
+            # exist here (single-process), so the scales match the
+            # unsharded models.sart.quantize_rtm recipe exactly
+            def _q(x):
+                amax = np.max(np.abs(x), axis=0)
+                s = np.where(amax > 0, amax / 127.0, 1.0).astype(np.float32)
+                codes = np.clip(
+                    np.round(x / s[None, :]), -127, 127
+                ).astype(np.int8)
+                return codes, s
+
+            s_host, s_scale = _q(s_host)
+            u_host, su = _q(u_host)
+            v_host, sv = _q(v_host)
+            f_scale = np.stack([su, sv])  # [2, r]
+            scale_dev = _stage(s_scale, self.mesh, P(VOXEL_AXIS))
+            fscale_dev = _stage(f_scale, self.mesh, P())
+        else:
+            # reduced-precision storage applies to the core only; the
+            # factors are O(r * (P + V)) bytes and stay fp32
+            store = jnp.dtype(opts.rtm_dtype or opts.dtype)
+            if store != jnp.float32:
+                s_host = s_host.astype(store)
+        s_dev = _stage(s_host, self.mesh, P(PIXEL_AXIS, VOXEL_AXIS))
+        u_dev = _stage(u_host, self.mesh, P(PIXEL_AXIS, None))
+        v_dev = _stage(v_host, self.mesh, P())
+
+        def stats(s_blk, u_blk, v_rep, *scales):
+            if is_int8:
+                s_scale_rep, f_scale_rep = scales
+                u_fp = u_blk.astype(jnp.float32) * f_scale_rep[0]
+                v_fp = v_rep.astype(jnp.float32) * f_scale_rep[1]
+                return lowrank_ray_stats(
+                    s_blk, u_fp, v_fp, spec, scale=s_scale_rep,
+                    dtype=dtype, axis_name=self._pixel_axis,
+                )
+            return lowrank_ray_stats(
+                s_blk, u_blk, v_rep, spec, dtype=dtype,
+                axis_name=self._pixel_axis,
+            )
+
+        stats_fn = jax.jit(
+            shard_map(
+                stats,
+                mesh=self.mesh,
+                in_specs=(
+                    P(PIXEL_AXIS, VOXEL_AXIS), P(PIXEL_AXIS, None), P(),
+                    *((P(VOXEL_AXIS), P()) if is_int8 else ()),
+                ),
+                out_specs=(P(VOXEL_AXIS), P(PIXEL_AXIS)),
+                check_vma=False,
+            )
+        )
+        stats_args = (s_dev, u_dev, v_dev) + (
+            (scale_dev, fscale_dev) if is_int8 else ()
+        )
+        ray_density, ray_length = stats_fn(*stats_args)
+        self.problem = SARTProblem(
+            s_dev, ray_density, ray_length, None, scale_dev,
+            u_dev, v_dev, fscale_dev,
+        )
+
     # Replicating [B, padded_nvoxel] fp32 on every device is the fast fetch
     # path, but above this per-device byte budget it would reintroduce the
     # replicated-solution footprint that voxel sharding exists to remove
@@ -940,16 +1092,28 @@ class DistributedSARTSolver:
         lap_spec = ShardedLaplacian(
             *(P(VOXEL_AXIS, None),) * len(ShardedLaplacian._fields)
         ) if has_lap else None
-        # the implicit problem's "rtm" leaf is the [P, 6] ray table:
-        # sharded over pixel rows, its 6 coordinate columns whole
+        # the implicit problem's "rtm" leaf is the [P, 6] ray table
+        # (sharded over pixel rows, its 6 coordinate columns whole); the
+        # factored problem's is the sparse core S — an ordinary matrix
+        # block, row-sharded like the dense RTM
+        from sartsolver_tpu.operators.lowrank import LowRankSpec
+
+        is_lowrank = isinstance(self._operator_spec, LowRankSpec)
         rtm_spec = (
-            P(PIXEL_AXIS, None) if self._operator_spec is not None
+            P(PIXEL_AXIS, None)
+            if self._operator_spec is not None and not is_lowrank
             else P(PIXEL_AXIS, VOXEL_AXIS)
         )
         return SARTProblem(
             rtm_spec, P(VOXEL_AXIS), P(PIXEL_AXIS),
             lap_spec,
             P(VOXEL_AXIS) if self.problem.rtm_scale is not None else None,
+            # U's rows are pixel rows (sharded with S); V and the factor
+            # scales are replicated — the bp psum folds U^T w's reduced
+            # contribution with S^T w's, no extra collective
+            P(PIXEL_AXIS, None) if is_lowrank else None,
+            P() if is_lowrank else None,
+            P() if self.problem.factor_scale is not None else None,
         )
 
     def _compiler_options(self):
@@ -2024,6 +2188,66 @@ def _audit_sharded_implicit_batch():
                            fused_sweep="off"),
         mesh=make_mesh(_AUDIT_SHARDS, 1),
         operator=ImplicitOperator(rec),
+    )
+    g = jax.device_put(
+        np.ones((1, solver.padded_npixel), np.float32),
+        NamedSharding(solver.mesh, P(None, PIXEL_AXIS)),
+    )
+    f0 = jax.device_put(
+        np.zeros((1, solver.padded_nvoxel), np.float32),
+        NamedSharding(solver.mesh, P(None, VOXEL_AXIS)),
+    )
+    return solver._batch_fn(True).lower(
+        solver.problem, g, jnp.ones(1, jnp.float32), f0
+    )
+
+
+@_register_audit_entry(
+    "sharded_lowrank_batch",
+    description=f"pixel-sharded FACTORED (S + U V^T) batched solve step "
+                f"({_AUDIT_SHARDS}x1 mesh, fp32, rank 8, "
+                f"{_AUDIT_SPARSE_PANELS_OCCUPIED} of {_AUDIT_PANELS} "
+                "core panels occupied): the sparse-core panel dots skip "
+                "empty panels and the factor term rides two skinny "
+                "matmuls, yet the loop must issue exactly the dense "
+                "sharded_batch's two designed all-reduces — "
+                "lowrank_back returns the composed LOCAL partial, so "
+                "the one back-projection psum folds the factor term's "
+                "contribution (no extra collective for the fill)",
+    # the composed sweep touches the row-sharded core block plus two
+    # skinny factors; a matrix-block copy/convert in the loop would be
+    # a silent densification of exactly what the factorization removed
+    loop_copy_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    loop_convert_threshold=(_AUDIT_P // _AUDIT_SHARDS) * _AUDIT_V,
+    # MUST equal sharded_batch's budget (the implicit entry's psum
+    # composition invariant): factoring the matrix changes what a sweep
+    # multiplies, never how often devices talk
+    loop_collective_budget={
+        "all-reduce": 2, "all-gather": 0, "all-to-all": 0,
+        "collective-permute": 0,
+    },
+    min_devices=_AUDIT_SHARDS,
+)
+def _audit_sharded_lowrank_batch():
+    from sartsolver_tpu.operators.lowrank import (
+        LowRankOperator, split_sparse_core,
+    )
+
+    # the sparse entries' 50%-occupancy fixture as the core, plus a
+    # dense rank-8 fill — the shape build_lowrank_operator produces,
+    # constructed directly so the audit pins the compiled program, not
+    # the host-side factorization gates
+    rng = np.random.default_rng(7)
+    S = rng.random((_AUDIT_P, _AUDIT_V)).astype(np.float32)
+    S[:, _AUDIT_SPARSE_PANELS_OCCUPIED * _AUDIT_PANEL_VOXELS:] = 0.0
+    S, occ = split_sparse_core(S, epsilon=0.0)
+    u = (0.01 * rng.standard_normal((_AUDIT_P, 8))).astype(np.float32)
+    v = rng.standard_normal((_AUDIT_V, 8)).astype(np.float32)
+    solver = DistributedSARTSolver(
+        opts=SolverOptions(max_iterations=8, conv_tolerance=1e-30,
+                           fused_sweep="off"),
+        mesh=make_mesh(_AUDIT_SHARDS, 1),
+        operator=LowRankOperator(S, u, v, occupancy=occ),
     )
     g = jax.device_put(
         np.ones((1, solver.padded_npixel), np.float32),

@@ -17,7 +17,11 @@ Four layers, outermost last:
 - the serving engine: request admission of inline geometry, session
   key/byte accounting, a geometry-built ResidentSession driven through
   the ContinuousBatcher, and one real `sartsolve serve` process solving
-  a `submit --geometry` request on its own implicit session.
+  a `submit --geometry` request on its own implicit session;
+- the factored backend (operators/lowrank.py, PERFORMANCE.md §12): the
+  same contract/kernel/parity/restriction drills over the low-rank +
+  sparse H ~= S + U V^T operator, plus its quality gate, rank
+  determinism, and the `--lowrank_rtm` session path.
 """
 
 import argparse
@@ -53,6 +57,18 @@ from sartsolver_tpu.operators.implicit import (
     implicit_ray_stats,
     implicit_subset_density,
     pick_implicit_panel,
+)
+from sartsolver_tpu.operators.lowrank import (
+    DEFAULT_TOL,
+    LowRankOperator,
+    build_lowrank_operator,
+    lowrank_back,
+    lowrank_forward,
+    lowrank_ray_stats,
+    lowrank_static_decline_reason,
+    lowrank_subset_density,
+    randomized_svd,
+    split_sparse_core,
 )
 from sartsolver_tpu.parallel.mesh import COL_ALIGN, make_mesh, padded_size
 from sartsolver_tpu.parallel.sharded import DistributedSARTSolver
@@ -766,3 +782,351 @@ def test_serve_submit_geometry_attach(tmp_path):
     assert "operator=implicit" in text
     assert "resident_bytes=432" in text
     assert "session-attach: key=geometry:" in text
+
+
+# ---------------------------------------------------------------------------
+# factored backend: low-rank + sparse H ~= S + U V^T (operators/lowrank.py)
+# ---------------------------------------------------------------------------
+
+_LOWRANK_CACHE = {}
+
+
+def _lowrank_case():
+    """A 1024x512 matrix built to factor: a dense random core on the
+    first 256 voxel columns (every 8x128 tile there is above the 5%
+    threshold) plus a rank-2 low-amplitude floor everywhere (max entry
+    ~0.035 * max|H| — below the tile threshold, so the right half of S
+    is exactly zero and the residual is exactly the planted factor).
+    Cached module-wide: the build runs the rSVD and the 20-iteration
+    solve-parity gate once."""
+    if "case" not in _LOWRANK_CACHE:
+        rng = np.random.default_rng(7)
+        P, V, r = 1024, 512, 2
+        core = np.zeros((P, V), np.float32)
+        core[:, :256] = (rng.random((P, 256)) * 0.9 + 0.1).astype(
+            np.float32)
+        u_f = (0.003 * rng.standard_normal((P, r))).astype(np.float32)
+        v_f = rng.standard_normal((V, r)).astype(np.float32)
+        H = core + (u_f @ v_f.T).astype(np.float32)
+        op, reason = build_lowrank_operator(H, rank=2)
+        assert reason is None and op is not None
+        g = (H.astype(np.float64)
+             @ rng.uniform(0.5, 1.5, V)).astype(np.float32)
+        _LOWRANK_CACHE["case"] = (H, op, g)
+    return _LOWRANK_CACHE["case"]
+
+
+def test_lowrank_operator_identity_and_accounting():
+    H, op, _g = _lowrank_case()
+    assert op.kind == "lowrank"
+    assert op.shape == (1024, 512) and op.rank == 2
+    # the core kept whole tiles of H exactly; the floor-only half is
+    # exactly zero — the factors carry it instead
+    S = op.payload()
+    assert S.dtype == np.float32 and S.shape == (1024, 512)
+    np.testing.assert_array_equal(S[:, :256], H[:, :256])
+    assert (S[:, 256:] == 0.0).all()
+    U, V = op.factors()
+    assert U.shape == (1024, 2) and V.shape == (512, 2)
+    assert U.dtype == np.float32 and V.dtype == np.float32
+    # resident bytes: the sparse core plus two skinny factors
+    assert op.resident_nbytes() == (1024 * 512 + (1024 + 512) * 2) * 4
+    # materialize round-trips H within the Frobenius gate
+    M = op.materialize()
+    assert np.linalg.norm(M - H) / np.linalg.norm(H) <= DEFAULT_TOL
+    np.testing.assert_allclose(M, S + U @ V.T, rtol=1e-6, atol=1e-7)
+    # the staged spec skips the factored half: one occupied 256-voxel
+    # panel, one skippable
+    spec = op.spec()
+    assert spec.nvoxel == 512 and spec.panel_voxels == 256
+    assert spec.occ_panels == (True, False)
+    # cache key pins backend, shapes, dtype, rank and content digest
+    key = op.cache_key()
+    assert key.startswith("lowrank:1024x512:float32:2:")
+    assert key != DenseOperator(H).cache_key()
+    H2 = H.copy()
+    H2[0, 0] += 0.25
+    op2, _ = build_lowrank_operator(H2, rank=2, check_parity=False)
+    assert op2.cache_key() != key
+
+
+def test_lowrank_kernels_match_materialized_matrix():
+    """forward/back/ray-stats/subset-density of the composed kernels
+    against the fp64 matrix they claim to apply — including the
+    statically skipped panel, which must contribute exact zeros."""
+    _H, op, _g = _lowrank_case()
+    spec = op.spec()
+    S = op.payload()
+    U, V = op.factors()
+    M = S.astype(np.float64) + U.astype(np.float64) @ V.astype(
+        np.float64).T
+    rng = np.random.default_rng(3)
+    f = rng.uniform(0.0, 2.0, 512).astype(np.float32)
+    got = np.asarray(lowrank_forward(S, U, V, f, spec))
+    np.testing.assert_allclose(got, M @ f.astype(np.float64),
+                               rtol=1e-5, atol=1e-4)
+    fb = rng.uniform(0.0, 2.0, (3, 512)).astype(np.float32)
+    got_b = np.asarray(lowrank_forward(S, U, V, fb, spec))
+    np.testing.assert_allclose(got_b, fb.astype(np.float64) @ M.T,
+                               rtol=1e-5, atol=1e-4)
+
+    w = rng.uniform(0.0, 1.0, 1024).astype(np.float32)
+    got_bp = np.asarray(lowrank_back(S, U, V, w, spec))
+    np.testing.assert_allclose(got_bp, M.T @ w.astype(np.float64),
+                               rtol=1e-5, atol=1e-4)
+
+    dens, length = lowrank_ray_stats(S, U, V, spec)
+    np.testing.assert_allclose(np.asarray(dens), M.sum(axis=0),
+                               rtol=1e-5, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(length), M.sum(axis=1),
+                               rtol=1e-5, atol=1e-4)
+
+    # OS subsets: subset t is pixel rows t::os on S and U alike
+    sub = np.asarray(lowrank_subset_density(S, U, V, spec, 4))
+    want = M.reshape(256, 4, 512).sum(axis=0)
+    np.testing.assert_allclose(sub, want, rtol=1e-5, atol=1e-4)
+
+
+def test_lowrank_rank_determinism():
+    """Fixed-seed randomized SVD: two factorizations of the same
+    residual are byte-identical, so the operator's cache key — and the
+    warm-pool hit it buys — is reproducible across sessions."""
+    H, op, _g = _lowrank_case()
+    S, _occ = split_sparse_core(H)
+    residual = H - S
+    U1, V1 = randomized_svd(residual, 2)
+    U2, V2 = randomized_svd(residual, 2)
+    assert U1.tobytes() == U2.tobytes()
+    assert V1.tobytes() == V2.tobytes()
+    op2, reason = build_lowrank_operator(H, rank=2, check_parity=False)
+    assert reason is None
+    assert op2.cache_key() == op.cache_key()
+    assert op2.factors()[0].tobytes() == op.factors()[0].tobytes()
+
+
+LOWRANK_PARITY_LEGS = [
+    ("linear", {}, 512),
+    # the log leg compares the core-determined voxels only: the right
+    # half is constrained by nothing but the rank-2 floor (two
+    # constraints for 256 voxels), and log-SART's multiplicative
+    # updates amplify fp32 rounding along those null directions — the
+    # same drift two dense summation orders show. The determined half
+    # agrees to ~6e-7.
+    ("log", {"logarithmic": True}, 256),
+    ("os", {"os_subsets": 4}, 512),
+    ("momentum", {"momentum": "nesterov"}, 512),
+]
+
+
+@pytest.mark.parametrize("name,kw,nvox", LOWRANK_PARITY_LEGS,
+                         ids=[n for n, *_ in LOWRANK_PARITY_LEGS])
+def test_lowrank_parity_vs_dense(name, kw, nvox):
+    """The factored solve against the dense solve of the original H:
+    identical statuses and iteration counts, solutions within the
+    fused-parity tolerance."""
+    H, op, g = _lowrank_case()
+    opts = _opts(**kw)
+    fac = DistributedSARTSolver(operator=op, opts=opts,
+                                mesh=make_mesh(1, 1))
+    dense = DistributedSARTSolver(H, opts=opts, mesh=make_mesh(1, 1))
+    try:
+        for scale in (1.0, 1.3):
+            _assert_parity(fac.solve(g * scale),
+                           dense.solve(g * scale), nvoxel=nvox)
+    finally:
+        fac.close()
+        dense.close()
+
+
+def test_lowrank_parity_int8_dequantized_oracle():
+    """The int8 factored path quantizes S per voxel and each factor per
+    rank component; the in-loop dequant is exact (codes @ (scale * f)).
+    So a dense fp32 solver on the DEQUANTIZED staged operator is a
+    strict oracle: the int8 composed solve must match it to fp-rounding
+    precision, not merely to quantization error (~0.4% here)."""
+    H, op, g = _lowrank_case()
+    fac = DistributedSARTSolver(
+        operator=op, opts=_opts(rtm_dtype="int8"), mesh=make_mesh(1, 1))
+    try:
+        pr = fac.problem
+        codes = np.asarray(pr.rtm, np.float32)
+        scale = np.asarray(pr.rtm_scale, np.float32)
+        fs = np.asarray(pr.factor_scale, np.float32)
+        M_dq = codes * scale[None, :] \
+            + (np.asarray(pr.factor_u, np.float32) * fs[0]) \
+            @ (np.asarray(pr.factor_v, np.float32) * fs[1]).T
+        # sanity: the dequantized operator is H to int8 precision
+        assert 1e-4 < np.max(np.abs(M_dq - H)) / np.abs(H).max() < 0.01
+        ref = DistributedSARTSolver(M_dq.astype(np.float32),
+                                    opts=_opts(), mesh=make_mesh(1, 1))
+        try:
+            for s in (1.0, 1.3):
+                _assert_parity(fac.solve(g * s), ref.solve(g * s),
+                               nvoxel=512)
+        finally:
+            ref.close()
+    finally:
+        fac.close()
+
+
+def test_lowrank_parity_pixel_sharded():
+    """A (4, 1) pixel-sharded factored solve (U row-sharded with S, V
+    replicated, ONE bp psum) against the single-device dense solve,
+    single and batched."""
+    H, op, g = _lowrank_case()
+    fac = DistributedSARTSolver(operator=op, opts=_opts(),
+                                mesh=make_mesh(4, 1))
+    dense = DistributedSARTSolver(H, opts=_opts(), mesh=make_mesh(1, 1))
+    try:
+        _assert_parity(fac.solve(g), dense.solve(g), nvoxel=512)
+        batch = np.stack([g, g * 1.3])
+        got = fac.solve_batch(batch)
+        for b, scale in enumerate((1.0, 1.3)):
+            ref = dense.solve(g * scale)
+            assert int(np.asarray(got.status)[b]) == int(ref.status)
+            a = np.asarray(got.solution)[b, :512]
+            r = np.asarray(ref.solution)[:512]
+            assert np.max(np.abs(a - r)) <= \
+                PARITY_RTOL * max(np.max(np.abs(r)), 1e-12)
+    finally:
+        fac.close()
+        dense.close()
+
+
+def test_lowrank_quality_gate():
+    """The gate refuses BEFORE staging: an explicit rank below the
+    planted rank fails the Frobenius check loudly, out-of-range and
+    non-integer ranks are input errors, and 'auto' on a matrix with no
+    sub-threshold tile declines with a reason instead of factoring
+    noise."""
+    H, _op, _g = _lowrank_case()
+    with pytest.raises(SartInputError, match="factorization gate"):
+        build_lowrank_operator(H, rank=1)
+    with pytest.raises(SartInputError, match="must lie in"):
+        build_lowrank_operator(H, rank=0)
+    with pytest.raises(SartInputError, match="must lie in"):
+        build_lowrank_operator(H, rank=10_000)
+    with pytest.raises(SartInputError, match="positive integer"):
+        build_lowrank_operator(H, rank="three")
+    rng = np.random.default_rng(11)
+    flat = (rng.random((64, 128)) * 0.9 + 0.1).astype(np.float32)
+    op, reason = build_lowrank_operator(flat, rank="auto")
+    assert op is None and "no tile fell below" in reason
+
+
+def test_lowrank_restrictions_and_int8_admission():
+    """Mode restrictions mirror the implicit backend's — EXCEPT int8,
+    which the factored path supports (it is the one backend that
+    quantizes S and the factors separately)."""
+    _H, op, g = _lowrank_case()
+    legs = [
+        ({}, (1, 2), "voxel"),
+        ({"integrity": True}, (1, 1), "integrity"),
+        ({"sparse_rtm": "1e-8"}, (1, 1), "tile-thresholds"),
+    ]
+    for kw, mesh_shape, match in legs:
+        base = dict(max_iterations=5, conv_tolerance=1e-30)
+        if "fused_sweep" not in kw:
+            base["fused_sweep"] = "off"
+        with pytest.raises(SartInputError, match=match):
+            DistributedSARTSolver(operator=op,
+                                  opts=SolverOptions(**base, **kw),
+                                  mesh=make_mesh(*mesh_shape))
+    # forced Pallas fusion is a CONFIG error once lowrank_rtm rides the
+    # options; at the solver layer the operator refuses it directly
+    for mode in ("on", "interpret"):
+        with pytest.raises(SartInputError, match="fused_sweep"):
+            DistributedSARTSolver(
+                operator=op,
+                opts=SolverOptions(max_iterations=5,
+                                   conv_tolerance=1e-30,
+                                   fused_sweep=mode),
+                mesh=make_mesh(1, 1))
+    from sartsolver_tpu.ops.laplacian import make_laplacian
+    lap = make_laplacian(np.array([0]), np.array([0]),
+                         np.array([1.0], np.float32), dtype="float32")
+    with pytest.raises(SartInputError, match="beta_laplace"):
+        DistributedSARTSolver(operator=op, laplacian=lap, opts=_opts(),
+                              mesh=make_mesh(1, 1))
+    with pytest.raises(ValueError, match="not both"):
+        DistributedSARTSolver(np.zeros((4, 4), np.float32), operator=op,
+                              opts=_opts(), mesh=make_mesh(1, 1))
+    # int8 is ADMITTED (contrast test_implicit_restrictions): smoke a
+    # short solve to force staging
+    s = DistributedSARTSolver(
+        operator=op,
+        opts=_opts(max_iterations=3, rtm_dtype="int8"),
+        mesh=make_mesh(1, 1))
+    try:
+        assert np.isfinite(np.asarray(s.solve(g).solution)).all()
+    finally:
+        s.close()
+
+
+def test_lowrank_static_decline_reason():
+    """One shared flag-only decline predicate for the CLI and the
+    serving engine — knowable before the whole-matrix read."""
+    opts = _opts()
+    assert lowrank_static_decline_reason(opts) is None
+    assert "multi-process" in lowrank_static_decline_reason(
+        opts, process_count=2)
+    assert "voxel-sharded" in lowrank_static_decline_reason(
+        opts, n_voxel_shards=2)
+    assert "checksum" in lowrank_static_decline_reason(
+        _opts(integrity=True))
+    assert "beta_laplace" in lowrank_static_decline_reason(
+        opts, has_laplacian=True)
+    # and the config layer refuses contradictory flag pairs outright
+    with pytest.raises(ValueError, match="lowrank_rtm"):
+        SolverOptions(lowrank_rtm="0")
+    with pytest.raises(ValueError, match="factored"):
+        SolverOptions(lowrank_rtm="auto", fused_sweep="on")
+    with pytest.raises(ValueError, match="sparse_rtm"):
+        SolverOptions(lowrank_rtm="auto", sparse_rtm="1e-8")
+
+
+def test_lowrank_session_build_and_cache_key(tmp_path):
+    """`--lowrank_rtm <rank>` through the real CLI arg path: the
+    ResidentSession stages a factored operator, keys the warm pool by
+    the lowrank cache key, charges factored bytes, and solves the world
+    fixture's frames to finite solutions. `--lowrank_rtm auto` on the
+    same dense-as-it-gets fixture declines LOUDLY and falls back to the
+    materialized path."""
+    from sartsolver_tpu.cli import _validate, build_parser
+    from sartsolver_tpu.engine.session import (
+        ResidentSession, key_of, session_nbytes,
+    )
+
+    paths, H, f_true, _times, _scales = fx.write_world(
+        str(tmp_path), n_frames=2)
+    inputs = [paths["rtm_a1"], paths["rtm_a2"], paths["rtm_b"],
+              paths["img_a"], paths["img_b"]]
+    args = build_parser().parse_args([
+        *inputs, "--max_iterations", "10", "--conv_tolerance", "1e-12",
+        "--fused_sweep", "off", "--pixel_shards", "1",
+        "--lowrank_rtm", "14"])
+    _validate(args)
+    sess = ResidentSession.build(args)
+    try:
+        assert sess.operator.kind == "lowrank"
+        key = key_of(sess)
+        assert key.startswith("lowrank:14x16:float32:14:")
+        assert key.endswith(":1x1")
+        assert key == sess.operator.cache_key() + ":1x1"
+        assert session_nbytes(sess) == \
+            sess.operator.resident_nbytes() == (14 * 16 + 30 * 14) * 4
+        res = sess.solver.solve(np.asarray(H @ f_true, np.float32))
+        assert np.isfinite(np.asarray(res.solution)).all()
+    finally:
+        sess.close()
+
+    args = build_parser().parse_args([
+        *inputs, "--max_iterations", "10", "--conv_tolerance", "1e-12",
+        "--fused_sweep", "off", "--pixel_shards", "1",
+        "--lowrank_rtm", "auto"])
+    _validate(args)
+    sess = ResidentSession.build(args)
+    try:
+        assert sess.operator is None or sess.operator.kind != "lowrank"
+    finally:
+        sess.close()
